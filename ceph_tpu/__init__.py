@@ -1,0 +1,23 @@
+"""ceph_tpu — a TPU-native distributed-storage framework.
+
+A ground-up rebuild of the capabilities of Ceph (reference: fullerdj/ceph
+v12.1.2) designed for TPU hardware: the dense-compute hot paths — GF(2^8)
+Reed-Solomon erasure coding, CRUSH placement, crc32c checksumming — run as
+batched JAX/XLA/Pallas kernels, and the cluster around them (monitors, OSDs,
+object store, messenger, client) is rebuilt as an async control plane that
+feeds fixed-shape device batches.
+
+Subpackages
+-----------
+ops       Kernel substrate: GF(2^8) tensor arithmetic, rjenkins1 hashing,
+          crc32c, bit-matrix matmuls on the MXU.
+ec        Erasure-code framework: ErasureCodeInterface semantics, plugin
+          registry, jerasure/isa/lrc/shec codec families.
+crush     CRUSH placement: map data structures, straw2, vmapped crush_do_rule.
+osdmap    Cluster map: pools, PG -> OSD placement pipeline, upmaps.
+cluster   Mini-RADOS: messenger, monitor, OSD daemons, object stores, client.
+parallel  Device-mesh sharding helpers (stripe-batch sharding over ICI).
+utils     Config schema, perf counters, misc runtime.
+"""
+
+__version__ = "0.1.0"

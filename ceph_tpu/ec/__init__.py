@@ -1,0 +1,12 @@
+"""Erasure-code framework: interface, plugin registry, codec families.
+
+Mirrors the capability surface of the reference's src/erasure-code/: the
+``ErasureCodeInterface`` contract (ErasureCodeInterface.h:170-462), the shared
+``ErasureCode`` base-class semantics (padding, chunk mapping, greedy
+minimum_to_decode), a plugin registry, and the jerasure / isa / lrc / shec
+codec families — with all bulk GF(2^8) math executed as batched TPU matmuls
+(see ceph_tpu.ops.gf8).
+"""
+
+from ceph_tpu.ec.interface import ErasureCodeInterface, ECError  # noqa: F401
+from ceph_tpu.ec.registry import ErasureCodePluginRegistry, factory  # noqa: F401

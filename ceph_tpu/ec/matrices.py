@@ -1,0 +1,154 @@
+"""Coding-matrix builders for every codec family.
+
+Host-side numpy; these touch k x m bytes, never data.  Constructions mirror
+the libraries the reference wraps:
+
+- reed_sol_vandermonde_coding_matrix / reed_sol_r6_coding_matrix: jerasure
+  reed_sol.c semantics (called from reference ErasureCodeJerasure.cc:199,245).
+- cauchy_original / cauchy_good: jerasure cauchy.c semantics (reference
+  ErasureCodeJerasure.cc:301 family).
+- isa_rs_matrix / isa_cauchy_matrix: ISA-L gf_gen_rs_matrix /
+  gf_gen_cauchy1_matrix semantics (reference ErasureCodeIsa.h:38-40 selects
+  kVandermonde / kCauchy).
+
+All are over GF(2^8) (w=8), the shared field of gf-complete and ISA-L.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ceph_tpu.ops import gf8
+
+
+def reed_sol_extended_vandermonde(rows: int, cols: int) -> np.ndarray:
+    """Extended Vandermonde matrix (jerasure reed_sol.c).
+
+    Row 0 is e_0, rows 1..rows-2 are [1, i, i^2, ...], last row is e_{cols-1}.
+    """
+    v = np.zeros((rows, cols), dtype=np.uint8)
+    v[0, 0] = 1
+    for i in range(1, rows - 1):
+        for j in range(cols):
+            v[i, j] = gf8.gf_pow(i, j)
+    v[rows - 1, cols - 1] = 1
+    return v
+
+
+def _systematize_vandermonde(v: np.ndarray) -> np.ndarray:
+    """Elementary column operations making the top cols x cols block identity.
+
+    Same elimination jerasure performs inside
+    reed_sol_vandermonde_coding_matrix, so the resulting parity rows match
+    its output for any (k, m) where both are defined.
+    """
+    v = v.copy()
+    rows, cols = v.shape
+    for i in range(cols):
+        if v[i, i] == 0:
+            for j in range(i + 1, cols):
+                if v[i, j] != 0:
+                    v[:, [i, j]] = v[:, [j, i]]
+                    break
+            else:
+                raise ValueError("vandermonde systematization failed")
+        if v[i, i] != 1:
+            inv = gf8.gf_inv(v[i, i])
+            v[:, i] = gf8.gf_mul(v[:, i], inv)
+        for j in range(cols):
+            if j != i and v[i, j] != 0:
+                factor = v[i, j]
+                v[:, j] ^= gf8.gf_mul(factor, v[:, i])
+    return v
+
+
+def reed_sol_vandermonde_coding_matrix(k: int, m: int) -> np.ndarray:
+    """(m, k) coding matrix: systematized extended Vandermonde, bottom m rows."""
+    v = reed_sol_extended_vandermonde(k + m, k)
+    v = _systematize_vandermonde(v)
+    assert np.array_equal(v[:k], np.eye(k, dtype=np.uint8))
+    return v[k:]
+
+
+def reed_sol_r6_coding_matrix(k: int) -> np.ndarray:
+    """RAID-6 matrix (jerasure reed_sol_r6_coding_matrix): P = XOR, Q = sum 2^j d_j."""
+    mat = np.zeros((2, k), dtype=np.uint8)
+    mat[0, :] = 1
+    for j in range(k):
+        mat[1, j] = gf8.gf_pow(2, j)
+    return mat
+
+
+def cauchy_original_coding_matrix(k: int, m: int) -> np.ndarray:
+    """matrix[i][j] = 1 / (i XOR (m + j))  (jerasure cauchy.c)."""
+    if k + m > 256:
+        raise ValueError("k+m must be <= 256 for w=8")
+    mat = np.zeros((m, k), dtype=np.uint8)
+    for i in range(m):
+        for j in range(k):
+            mat[i, j] = gf8.gf_inv(i ^ (m + j))
+    return mat
+
+
+def _n_ones(x: int) -> int:
+    """Number of ones in the 8x8 bit-matrix of multiply-by-x."""
+    return int(gf8.GF_BITMAT[x].sum())
+
+
+def cauchy_good_coding_matrix(k: int, m: int) -> np.ndarray:
+    """Cauchy matrix optimized to minimize bit-matrix ones (jerasure
+    cauchy_good_general_coding_matrix): scale each column so row 0 is all
+    ones, then scale each later row by the divisor minimizing total ones.
+    """
+    mat = cauchy_original_coding_matrix(k, m)
+    for j in range(k):
+        if mat[0, j] != 1:
+            inv = gf8.gf_inv(mat[0, j])
+            mat[:, j] = gf8.gf_mul(mat[:, j], inv)
+    for i in range(1, m):
+        best = sum(_n_ones(int(e)) for e in mat[i])
+        best_j = -1
+        for j in range(k):
+            if mat[i, j] != 1:
+                inv = gf8.gf_inv(mat[i, j])
+                total = sum(
+                    _n_ones(int(gf8.gf_mul(e, inv))) for e in mat[i]
+                )
+                if total < best:
+                    best = total
+                    best_j = j
+        if best_j != -1:
+            inv = gf8.gf_inv(mat[i, best_j])
+            mat[i] = gf8.gf_mul(mat[i], inv)
+    return mat
+
+
+def isa_rs_matrix(k: int, m: int) -> np.ndarray:
+    """(m, k) parity rows of ISA-L gf_gen_rs_matrix: row r = [g^0..g^(k-1)],
+    g = 2^r.  Row 0 is all ones (the XOR special case the reference keeps,
+    ErasureCodeIsa.cc region_xor path)."""
+    mat = np.zeros((m, k), dtype=np.uint8)
+    gen = 1
+    for r in range(m):
+        p = 1
+        for j in range(k):
+            mat[r, j] = p
+            p = int(gf8.gf_mul(p, gen))
+        gen = int(gf8.gf_mul(gen, 2))
+    return mat
+
+
+def isa_cauchy_matrix(k: int, m: int) -> np.ndarray:
+    """(m, k) parity rows of ISA-L gf_gen_cauchy1_matrix: inv(i ^ j),
+    i = k..k+m-1."""
+    mat = np.zeros((m, k), dtype=np.uint8)
+    for i in range(m):
+        for j in range(k):
+            mat[i, j] = gf8.gf_inv((k + i) ^ j)
+    return mat
+
+
+def generator_matrix(coding: np.ndarray) -> np.ndarray:
+    """Full (k+m, k) generator: identity stacked on the coding rows."""
+    m, k = coding.shape
+    return np.vstack([np.eye(k, dtype=np.uint8), coding])

@@ -1,0 +1,85 @@
+"""Erasure-code plugin registry.
+
+Mirrors reference src/erasure-code/ErasureCodePlugin.cc:92-202: a singleton
+registry mapping plugin names to factories, instantiating codecs from
+profiles.  Where the reference dlopens ``libec_<name>.so`` and calls its
+``__erasure_code_init`` entry point, we register Python factories — and
+third-party codecs can register the same way (entry-point seam preserved).
+"""
+
+from __future__ import annotations
+
+import errno
+import threading
+from typing import Callable, Dict
+
+from ceph_tpu.ec.interface import ECError, ErasureCodeInterface, ErasureCodeProfile
+
+
+class ErasureCodePluginRegistry:
+    _instance = None
+    _instance_lock = threading.Lock()
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._factories: Dict[str, Callable[[ErasureCodeProfile], ErasureCodeInterface]] = {}
+        self.disable_dlclose = False
+
+    @classmethod
+    def instance(cls) -> "ErasureCodePluginRegistry":
+        with cls._instance_lock:
+            if cls._instance is None:
+                cls._instance = cls()
+                cls._instance._register_builtins()
+        return cls._instance
+
+    def _register_builtins(self) -> None:
+        from ceph_tpu.ec.jerasure import make_jerasure
+        from ceph_tpu.ec.isa import make_isa
+
+        self.add("jerasure", make_jerasure)
+        self.add("isa", make_isa)
+        # The TPU-native flagship plugin name, so benchmark harnesses can
+        # select it like the reference selects --plugin isa/jerasure.
+        self.add("jax", make_isa)
+        try:
+            from ceph_tpu.ec.lrc import make_lrc
+
+            self.add("lrc", make_lrc)
+        except ImportError:
+            pass
+        try:
+            from ceph_tpu.ec.shec import make_shec
+
+            self.add("shec", make_shec)
+        except ImportError:
+            pass
+
+    def add(self, name: str, factory) -> None:
+        with self._lock:
+            self._factories[name] = factory
+
+    def remove(self, name: str) -> None:
+        with self._lock:
+            self._factories.pop(name, None)
+
+    def load(self, name: str):
+        with self._lock:
+            if name not in self._factories:
+                raise ECError(errno.ENOENT, f"no erasure-code plugin {name!r}")
+            return self._factories[name]
+
+    def factory(self, plugin: str, profile: ErasureCodeProfile) -> ErasureCodeInterface:
+        make = self.load(plugin)
+        return make(dict(profile))
+
+    def preload(self, plugins) -> None:
+        for name in plugins:
+            self.load(name)
+
+
+def factory(profile: ErasureCodeProfile) -> ErasureCodeInterface:
+    """Instantiate a codec from a profile's ``plugin`` key (default jerasure)."""
+    profile = dict(profile)
+    plugin = profile.get("plugin", "jerasure")
+    return ErasureCodePluginRegistry.instance().factory(plugin, profile)

@@ -1,0 +1,3 @@
+"""Kernel substrate: batched TPU primitives underlying the framework."""
+
+from ceph_tpu.ops import gf8  # noqa: F401

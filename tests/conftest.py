@@ -1,0 +1,13 @@
+"""Test configuration: force a virtual 8-device CPU mesh.
+
+Must run before jax is imported anywhere.  Multi-chip sharding tests use this
+virtual mesh; real-TPU benchmarking goes through bench.py, which does not
+import this file.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)

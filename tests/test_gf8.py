@@ -1,0 +1,89 @@
+"""GF(2^8) substrate tests: field axioms, known vectors, device == host."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ops import gf8
+
+
+def test_known_products():
+    # 2 * 0x80 = 0x100 -> reduced by 0x11d -> 0x1d
+    assert gf8.gf_mul(2, 0x80) == 0x1D
+    assert gf8.gf_mul(0, 0xAB) == 0
+    assert gf8.gf_mul(1, 0xAB) == 0xAB
+    # exp/log consistency: 2 is primitive
+    assert gf8.GF_EXP[0] == 1
+    assert gf8.GF_EXP[1] == 2
+    assert len(set(gf8.GF_EXP[:255].tolist())) == 255
+
+
+def test_field_axioms():
+    rng = np.random.default_rng(0)
+    a = rng.integers(0, 256, 64, dtype=np.uint8)
+    b = rng.integers(0, 256, 64, dtype=np.uint8)
+    c = rng.integers(0, 256, 64, dtype=np.uint8)
+    assert np.array_equal(gf8.gf_mul(a, b), gf8.gf_mul(b, a))
+    # distributive over XOR (field addition)
+    left = gf8.gf_mul(a, b ^ c)
+    right = gf8.gf_mul(a, b) ^ gf8.gf_mul(a, c)
+    assert np.array_equal(left, right)
+    # associativity
+    assert np.array_equal(
+        gf8.gf_mul(gf8.gf_mul(a, b), c), gf8.gf_mul(a, gf8.gf_mul(b, c))
+    )
+
+
+def test_inverse():
+    a = np.arange(1, 256, dtype=np.uint8)
+    assert np.all(gf8.gf_mul(a, gf8.gf_inv(a)) == 1)
+    with pytest.raises(ZeroDivisionError):
+        gf8.gf_inv(0)
+
+
+def test_gf_pow():
+    assert gf8.gf_pow(2, 0) == 1
+    assert gf8.gf_pow(2, 8) == 0x1D
+    assert gf8.gf_pow(0, 5) == 0
+    for n in range(1, 10):
+        assert gf8.gf_pow(3, n) == gf8.gf_mul(gf8.gf_pow(3, n - 1), 3)
+
+
+def test_bitmat_table():
+    # multiply-by-a as a bit matrix reproduces gf_mul for every a, x
+    rng = np.random.default_rng(1)
+    for a in [0, 1, 2, 3, 0x1D, 0x80, 0xFF] + list(rng.integers(0, 256, 8)):
+        m = gf8.GF_BITMAT[a]
+        for x in rng.integers(0, 256, 16):
+            xbits = (int(x) >> np.arange(8)) & 1
+            ybits = (m @ xbits) % 2
+            y = int((ybits << np.arange(8)).sum())
+            assert y == int(gf8.gf_mul(a, x)), (a, x)
+
+
+def test_device_matmul_matches_host():
+    rng = np.random.default_rng(2)
+    for r, k, n in [(4, 8, 256), (2, 4, 100), (6, 6, 1)]:
+        m = rng.integers(0, 256, (r, k), dtype=np.uint8)
+        d = rng.integers(0, 256, (k, n), dtype=np.uint8)
+        want = gf8.gf_matmul_ref(m, d)
+        got = np.asarray(gf8.gf_matmul(m, d))
+        assert np.array_equal(want, got)
+
+
+def test_matrix_inversion():
+    rng = np.random.default_rng(3)
+    eye = np.eye(5, dtype=np.uint8)
+    for _ in range(10):
+        a = rng.integers(0, 256, (5, 5), dtype=np.uint8)
+        try:
+            inv = gf8.gf_invert_matrix(a)
+        except gf8.SingularMatrixError:
+            continue
+        assert np.array_equal(gf8.gf_matmul_ref(a, inv), eye)
+        assert np.array_equal(gf8.gf_matmul_ref(inv, a), eye)
+
+
+def test_singular_matrix_raises():
+    a = np.zeros((3, 3), dtype=np.uint8)
+    with pytest.raises(gf8.SingularMatrixError):
+        gf8.gf_invert_matrix(a)
