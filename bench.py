@@ -1,0 +1,126 @@
+#!/usr/bin/env python
+"""Benchmark harness mirroring the reference's ceph_erasure_code_benchmark.
+
+The reference tool (src/test/erasure-code/ceph_erasure_code_benchmark.cc)
+times plugin encode/decode over an object of --size for --iterations and
+prints seconds + KiB.  This harness runs the same configs (BASELINE.json)
+against the TPU batch engine and prints ONE JSON line:
+
+    {"metric": ..., "value": GB/s, "unit": "GB/s", "vs_baseline": x}
+
+Default metric: the north star — ISA-compatible RS k=8,m=4 encode at 4KiB
+stripes, batch=4096, on one chip.  --all prints every BASELINE config (one
+JSON line each; the last line is the headline metric).
+
+Baseline constant: the reference publishes no numbers (BASELINE.md); ISA-L
+single-socket RS(8,4) encode measures in the ~5 GB/s range on contemporary
+x86 cores, which BASELINE.md designates as the to-beat figure until a
+locally-measured reference binary exists.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+BASELINE_GBPS = 5.0
+
+
+def _bench(fn, args, iters, warmup=3):
+    import jax
+
+    for _ in range(warmup):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    return dt / iters
+
+
+def bench_ec(profile, batch, chunk, workload="encode", erasures=(0,), iters=20):
+    """Returns GB/s of input data processed (matching the reference tool's
+    accounting: object bytes per iteration / seconds,
+    ceph_erasure_code_benchmark.cc:187)."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.ec import factory
+
+    codec = factory(profile)
+    k = codec.get_data_chunk_count()
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.integers(0, 256, (batch, k, chunk), dtype=np.uint8))
+    if workload == "encode":
+        secs = _bench(codec.encode_batch, (data,), iters)
+    else:
+        parity = codec.encode_batch(data)
+        full = jnp.concatenate([data, jnp.asarray(parity)], axis=1)
+        secs = _bench(codec.decode_batch, (tuple(erasures), full), iters)
+    nbytes = batch * k * chunk
+    return nbytes / secs / 1e9
+
+
+def bench_crush(n_osds=10_000, n_pgs=1_000_000, iters=5):
+    """Whole-map PG->OSD placement throughput (mappings/s)."""
+    try:
+        from ceph_tpu.crush import bench_map
+    except ImportError:
+        return None
+    return bench_map(n_osds=n_osds, n_pgs=n_pgs, iters=iters)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--all", action="store_true", help="run every BASELINE config")
+    ap.add_argument("--iterations", type=int, default=20)
+    args = ap.parse_args()
+
+    results = []
+    if args.all:
+        configs = [
+            ("ec_encode_jerasure_rsvan_k4m2_1M",
+             {"plugin": "jerasure", "technique": "reed_sol_van", "k": "4", "m": "2"},
+             dict(batch=16, chunk=262144, workload="encode")),
+            ("ec_encode_lrc_k4m2l3",
+             {"plugin": "lrc", "k": "4", "m": "2", "l": "3"},
+             dict(batch=1024, chunk=4096, workload="encode")),
+            ("ec_decode_shec_643",
+             {"plugin": "shec", "k": "6", "m": "4", "c": "3"},
+             dict(batch=1024, chunk=4096, workload="decode", erasures=(0, 3, 7))),
+            ("ec_decode_isa_k8m4_4k_e1",
+             {"plugin": "isa", "k": "8", "m": "4"},
+             dict(batch=4096, chunk=512, workload="decode", erasures=(2,))),
+        ]
+        for name, profile, kw in configs:
+            try:
+                gbps = bench_ec(profile, iters=args.iterations, **kw)
+            except Exception as e:  # plugin not yet implemented
+                print(json.dumps({"metric": name, "error": str(e)}), file=sys.stderr)
+                continue
+            results.append({"metric": name, "value": round(gbps, 3), "unit": "GB/s",
+                            "vs_baseline": round(gbps / BASELINE_GBPS, 3)})
+        pg_per_s = bench_crush()
+        if pg_per_s:
+            results.append({"metric": "crush_map_10kosd_1Mpg", "value": round(pg_per_s),
+                            "unit": "mappings/s", "vs_baseline": None})
+        for r in results:
+            print(json.dumps(r))
+
+    # headline metric (always last / only line): north-star encode config
+    gbps = bench_ec({"plugin": "isa", "k": "8", "m": "4"},
+                    batch=4096, chunk=512, workload="encode",
+                    iters=args.iterations)
+    print(json.dumps({
+        "metric": "ec_encode_isa_k8m4_4KiB_stripe_batch4096",
+        "value": round(gbps, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,16 @@
+"""Device-mesh sharding: the framework's distribution axes.
+
+Ceph distributes by declustered sharding (PGs over OSDs) and intra-object
+striping (SURVEY §2.2).  On TPU the same axes become mesh dimensions:
+
+- ``data``  — the stripe batch (independent stripes; Ceph's PG/stripe
+  parallelism).  Pure data parallelism over ICI.
+- ``shard`` — the chunk axis (Ceph's per-OSD EC shards, ghobject shard_t).
+  Tensor-parallel analog: each device group owns a subset of the k+m shards;
+  decode gathers k survivors with XLA collectives.
+"""
+
+from ceph_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    distributed_ec_step,
+)
