@@ -1,0 +1,43 @@
+"""CRUSH placement: map structures, straw2, scalar oracle, vmapped mapper.
+
+Behavioral mirror of reference src/crush/ (mapper.c, hash.c, builder.c,
+crush.h): deterministic hierarchical placement with straw2 buckets,
+firstn/indep selection, tunable retry semantics — rebuilt so a whole
+OSDMap's PG->OSD mapping evaluates as one batched TPU dispatch.
+"""
+
+from ceph_tpu.crush.types import (  # noqa: F401
+    Bucket,
+    CrushMap,
+    Rule,
+    Tunables,
+    CRUSH_ITEM_NONE,
+    CRUSH_ITEM_UNDEF,
+)
+from ceph_tpu.crush.scalar import ScalarMapper  # noqa: F401
+
+
+def bench_map(n_osds: int = 10_000, n_pgs: int = 1_000_000, iters: int = 3):
+    """Whole-map placement throughput (mappings/s) for bench.py."""
+    import time
+
+    import jax
+    import numpy as np
+
+    from ceph_tpu.crush.mapper import TensorMapper
+    from ceph_tpu.crush.types import build_hierarchy
+
+    cmap, rule = build_hierarchy(
+        n_hosts=max(1, n_osds // 16), osds_per_host=16, numrep=3
+    )
+    mapper = TensorMapper(cmap)
+    xs = np.arange(n_pgs, dtype=np.uint32)
+    weights = np.full(cmap.max_devices, 0x10000, dtype=np.uint32)
+    out = mapper.do_rule_batch(rule, xs, result_max=3, weights=weights)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = mapper.do_rule_batch(rule, xs, result_max=3, weights=weights)
+        jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / iters
+    return n_pgs / dt

@@ -1,0 +1,183 @@
+"""CRUSH map data structures and builder.
+
+Mirrors reference src/crush/crush.h (map/bucket/rule structs, :229-366) and
+the builder API (src/crush/builder.c): buckets have negative ids, devices
+non-negative; rules are step programs for the crush_do_rule VM.  Tunable
+defaults are the reference's "optimal" (jewel) profile, which OSDMaps of the
+reference era deploy.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+CRUSH_ITEM_NONE = 0x7FFFFFFF
+CRUSH_ITEM_UNDEF = 0x7FFFFFFE
+
+# rule step opcodes (reference crush.h:55-69)
+RULE_NOOP = 0
+RULE_TAKE = 1
+RULE_CHOOSE_FIRSTN = 2
+RULE_CHOOSE_INDEP = 3
+RULE_EMIT = 4
+RULE_CHOOSELEAF_FIRSTN = 6
+RULE_CHOOSELEAF_INDEP = 7
+RULE_SET_CHOOSE_TRIES = 8
+RULE_SET_CHOOSELEAF_TRIES = 9
+RULE_SET_CHOOSE_LOCAL_TRIES = 10
+RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES = 11
+RULE_SET_CHOOSELEAF_VARY_R = 12
+RULE_SET_CHOOSELEAF_STABLE = 13
+
+BUCKET_UNIFORM = 1
+BUCKET_LIST = 2
+BUCKET_TREE = 3
+BUCKET_STRAW = 4
+BUCKET_STRAW2 = 5
+
+_ALG_NAMES = {
+    "uniform": BUCKET_UNIFORM,
+    "list": BUCKET_LIST,
+    "tree": BUCKET_TREE,
+    "straw": BUCKET_STRAW,
+    "straw2": BUCKET_STRAW2,
+}
+
+
+@dataclass
+class Tunables:
+    """Reference 'optimal' (jewel) profile; crush_do_rule semantics at
+    mapper.c:904-918."""
+
+    choose_total_tries: int = 50
+    choose_local_tries: int = 0
+    choose_local_fallback_tries: int = 0
+    chooseleaf_descend_once: int = 1
+    chooseleaf_vary_r: int = 1
+    chooseleaf_stable: int = 1
+
+    @classmethod
+    def legacy(cls) -> "Tunables":
+        """crush_create() defaults (argonaut-era)."""
+        return cls(
+            choose_total_tries=19,
+            choose_local_tries=2,
+            choose_local_fallback_tries=5,
+            chooseleaf_descend_once=0,
+            chooseleaf_vary_r=0,
+            chooseleaf_stable=0,
+        )
+
+
+@dataclass
+class Bucket:
+    id: int  # negative
+    type: int  # 0 = device, >0 = bucket level
+    alg: str = "straw2"
+    hash: int = 0  # CRUSH_HASH_RJENKINS1
+    items: List[int] = field(default_factory=list)
+    weights: List[int] = field(default_factory=list)  # 16.16 fixed per item
+
+    @property
+    def size(self) -> int:
+        return len(self.items)
+
+    @property
+    def weight(self) -> int:
+        return sum(self.weights)
+
+
+@dataclass
+class Rule:
+    steps: List[Tuple[int, int, int]]
+    ruleset: int = 0
+    type: int = 1  # pg_pool type: 1 replicated, 3 erasure
+    min_size: int = 1
+    max_size: int = 10
+
+
+class CrushMap:
+    def __init__(self, tunables: Optional[Tunables] = None):
+        self.buckets: Dict[int, Bucket] = {}
+        self.rules: List[Rule] = []
+        self.max_devices = 0
+        self.tunables = tunables or Tunables()
+        self.type_names: Dict[int, str] = {0: "osd", 1: "host", 2: "rack", 3: "root"}
+        self.item_names: Dict[int, str] = {}
+
+    # -- builder (reference builder.c semantics) ---------------------------
+
+    def add_bucket(self, bucket: Bucket, name: Optional[str] = None) -> int:
+        if bucket.id >= 0:
+            bucket.id = -1 - len(self.buckets)
+        self.buckets[bucket.id] = bucket
+        for item in bucket.items:
+            if item >= 0:
+                self.max_devices = max(self.max_devices, item + 1)
+        if name:
+            self.item_names[bucket.id] = name
+        return bucket.id
+
+    def make_straw2(
+        self,
+        type: int,
+        items: List[int],
+        weights: List[int],
+        name: Optional[str] = None,
+    ) -> int:
+        return self.add_bucket(
+            Bucket(id=0, type=type, alg="straw2", items=list(items),
+                   weights=list(weights)),
+            name,
+        )
+
+    def add_rule(self, rule: Rule) -> int:
+        self.rules.append(rule)
+        return len(self.rules) - 1
+
+    def bucket(self, item_id: int) -> Bucket:
+        return self.buckets[item_id]
+
+    def max_depth(self) -> int:
+        """Longest bucket chain (for bounding vectorized descents)."""
+
+        def depth(bid: int) -> int:
+            b = self.buckets[bid]
+            best = 1
+            for item in b.items:
+                if item < 0:
+                    best = max(best, 1 + depth(item))
+            return best
+
+        return max((depth(bid) for bid in self.buckets), default=0)
+
+
+def build_hierarchy(
+    n_hosts: int,
+    osds_per_host: int,
+    numrep: int = 3,
+    weight: int = 0x10000,
+    chooseleaf: bool = True,
+    firstn: bool = True,
+) -> Tuple[CrushMap, int]:
+    """Standard root->host->osd map + rule (the shape OSDMaps deploy)."""
+    cmap = CrushMap()
+    host_ids, host_weights = [], []
+    dev = 0
+    for h in range(n_hosts):
+        items = list(range(dev, dev + osds_per_host))
+        dev += osds_per_host
+        weights = [weight] * osds_per_host
+        hid = cmap.make_straw2(1, items, weights, name=f"host{h}")
+        host_ids.append(hid)
+        host_weights.append(sum(weights))
+    root = cmap.make_straw2(3, host_ids, host_weights, name="default")
+    if chooseleaf:
+        op = RULE_CHOOSELEAF_FIRSTN if firstn else RULE_CHOOSELEAF_INDEP
+        steps = [(RULE_TAKE, root, 0), (op, numrep, 1), (RULE_EMIT, 0, 0)]
+    else:
+        op = RULE_CHOOSE_FIRSTN if firstn else RULE_CHOOSE_INDEP
+        steps = [(RULE_TAKE, root, 0), (op, numrep, 0), (RULE_EMIT, 0, 0)]
+    ruleno = cmap.add_rule(Rule(steps=steps))
+    return cmap, ruleno

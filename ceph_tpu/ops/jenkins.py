@@ -1,0 +1,106 @@
+"""rjenkins1 hashing — the hash under every CRUSH decision.
+
+Behavioral mirror of reference src/crush/hash.c: the crush_hashmix 9-line
+mix (hash.c:12-22), seed 1315423911 (:24), and the 1/2/3/4/5-ary variants
+(:26-90).  Written over generic uint32 array ops so the same code runs on
+numpy (host/scalar oracle) and jax.numpy (vectorized device path) — every
+op is add/sub/xor/shift, which the VPU vectorizes trivially.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+CRUSH_HASH_SEED = 1315423911
+CRUSH_HASH_RJENKINS1 = 0
+
+
+def _mix(a, b, c):
+    """One crush_hashmix round; args and results are uint32 arrays."""
+    a = (a - b) & 0xFFFFFFFF
+    a = (a - c) & 0xFFFFFFFF
+    a = a ^ (c >> 13)
+    b = (b - c) & 0xFFFFFFFF
+    b = (b - a) & 0xFFFFFFFF
+    b = b ^ ((a << 8) & 0xFFFFFFFF)
+    c = (c - a) & 0xFFFFFFFF
+    c = (c - b) & 0xFFFFFFFF
+    c = c ^ (b >> 13)
+    a = (a - b) & 0xFFFFFFFF
+    a = (a - c) & 0xFFFFFFFF
+    a = a ^ (c >> 12)
+    b = (b - c) & 0xFFFFFFFF
+    b = (b - a) & 0xFFFFFFFF
+    b = b ^ ((a << 16) & 0xFFFFFFFF)
+    c = (c - a) & 0xFFFFFFFF
+    c = (c - b) & 0xFFFFFFFF
+    c = c ^ (b >> 5)
+    a = (a - b) & 0xFFFFFFFF
+    a = (a - c) & 0xFFFFFFFF
+    a = a ^ (c >> 3)
+    b = (b - c) & 0xFFFFFFFF
+    b = (b - a) & 0xFFFFFFFF
+    b = b ^ ((a << 10) & 0xFFFFFFFF)
+    c = (c - a) & 0xFFFFFFFF
+    c = (c - b) & 0xFFFFFFFF
+    c = c ^ (b >> 15)
+    return a, b, c
+
+
+_X = 231232
+_Y = 1232
+
+
+def hash1(a):
+    h = (CRUSH_HASH_SEED ^ a) & 0xFFFFFFFF
+    b = a
+    x, y = _X, _Y
+    b, x, h = _mix(b, x, h)
+    y, a, h = _mix(y, a, h)
+    return h
+
+
+def hash2(a, b):
+    h = (CRUSH_HASH_SEED ^ a ^ b) & 0xFFFFFFFF
+    x, y = _X, _Y
+    a, b, h = _mix(a, b, h)
+    x, a, h = _mix(x, a, h)
+    b, y, h = _mix(b, y, h)
+    return h
+
+
+def hash3(a, b, c):
+    h = (CRUSH_HASH_SEED ^ a ^ b ^ c) & 0xFFFFFFFF
+    x, y = _X, _Y
+    a, b, h = _mix(a, b, h)
+    c, x, h = _mix(c, x, h)
+    y, a, h = _mix(y, a, h)
+    b, x, h = _mix(b, x, h)
+    y, c, h = _mix(y, c, h)
+    return h
+
+
+def hash4(a, b, c, d):
+    h = (CRUSH_HASH_SEED ^ a ^ b ^ c ^ d) & 0xFFFFFFFF
+    x, y = _X, _Y
+    a, b, h = _mix(a, b, h)
+    c, d, h = _mix(c, d, h)
+    a, x, h = _mix(a, x, h)
+    y, b, h = _mix(y, b, h)
+    c, x, h = _mix(c, x, h)
+    y, d, h = _mix(y, d, h)
+    return h
+
+
+def hash5(a, b, c, d, e):
+    h = (CRUSH_HASH_SEED ^ a ^ b ^ c ^ d ^ e) & 0xFFFFFFFF
+    x, y = _X, _Y
+    a, b, h = _mix(a, b, h)
+    c, d, h = _mix(c, d, h)
+    e, x, h = _mix(e, x, h)
+    y, a, h = _mix(y, a, h)
+    b, x, h = _mix(b, x, h)
+    y, c, h = _mix(y, c, h)
+    d, x, h = _mix(d, x, h)
+    y, e, h = _mix(y, e, h)
+    return h

@@ -1,0 +1,4 @@
+/* minimal config for out-of-tree crush build */
+#define HAVE_SYS_TYPES_H 1
+#define HAVE_STDINT_H 1
+#define HAVE_LINUX_TYPES_H 1

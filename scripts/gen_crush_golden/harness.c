@@ -1,0 +1,192 @@
+/* Golden-vector harness over the reference CRUSH C core (built out-of-tree;
+ * generates test vectors only — no reference code enters the new repo). */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include "builder.h"
+#include "crush.h"
+#include "mapper.h"
+#include "hash.h"
+
+#define NX 400
+
+static struct crush_map *new_map(int total, int local, int fallback,
+                                 int descend_once, int vary_r, int stable) {
+  struct crush_map *m = crush_create();
+  m->choose_total_tries = total;
+  m->choose_local_tries = local;
+  m->choose_local_fallback_tries = fallback;
+  m->chooseleaf_descend_once = descend_once;
+  m->chooseleaf_vary_r = vary_r;
+  m->chooseleaf_stable = stable;
+  return m;
+}
+
+static int add_straw2(struct crush_map *m, int type, int n, int *items, int *weights) {
+  struct crush_bucket *b = crush_make_bucket(m, CRUSH_BUCKET_STRAW2,
+                                             CRUSH_HASH_RJENKINS1, type, n, items, weights);
+  int id;
+  crush_add_bucket(m, 0, b, &id);
+  return id;
+}
+
+static void print_bucket(struct crush_map *m, int id, int first) {
+  struct crush_bucket *b = m->buckets[-1-id];
+  int i;
+  if (!first) printf(",");
+  printf("{\"id\":%d,\"type\":%d,\"weight\":%u,\"items\":[", id, b->type, b->weight);
+  for (i = 0; i < b->size; i++) printf("%s%d", i?",":"", b->items[i]);
+  printf("],\"weights\":[");
+  for (i = 0; i < b->size; i++) printf("%s%u", i?",":"", crush_get_bucket_item_weight(b, i));
+  printf("]}");
+}
+
+static void run_scenario(const char *name, struct crush_map *m, int root,
+                         struct crush_rule *rule, __u32 *reweight, int nw,
+                         int result_max) {
+  int ruleno = crush_add_rule(m, rule, -1);
+  crush_finalize(m);
+  void *cw = malloc(m->working_size + 3 * result_max * sizeof(int));
+  int result[16];
+  int x, i, b, nb = 0;
+  printf("{\"scenario\":\"%s\",\"root\":%d,\"result_max\":%d,", name, root, result_max);
+  printf("\"tunables\":{\"total\":%d,\"local\":%d,\"fallback\":%d,\"descend_once\":%d,\"vary_r\":%d,\"stable\":%d},",
+         m->choose_total_tries, m->choose_local_tries, m->choose_local_fallback_tries,
+         m->chooseleaf_descend_once, m->chooseleaf_vary_r, m->chooseleaf_stable);
+  printf("\"steps\":[");
+  for (i = 0; i < rule->len; i++)
+    printf("%s[%d,%d,%d]", i?",":"", rule->steps[i].op, rule->steps[i].arg1, rule->steps[i].arg2);
+  printf("],\"weights\":[");
+  for (i = 0; i < nw; i++) printf("%s%u", i?",":"", reweight[i]);
+  printf("],\"buckets\":[");
+  for (b = 0; b < m->max_buckets; b++)
+    if (m->buckets[b]) { print_bucket(m, -1-b, nb==0); nb++; }
+  printf("],\"results\":[");
+  for (x = 0; x < NX; x++) {
+    crush_init_workspace(m, cw);
+    int len = crush_do_rule(m, ruleno, x, result, result_max, reweight, nw, cw, NULL);
+    printf("%s[", x?",":"");
+    for (i = 0; i < len; i++) printf("%s%d", i?",":"", result[i]);
+    printf("]");
+  }
+  printf("]}\n");
+  free(cw);
+}
+
+static struct crush_rule *mk_rule(int type, int op1, int n1, int t1,
+                                  int op2, int n2, int t2) {
+  int len = (op2 >= 0) ? 4 : 3;
+  struct crush_rule *r = crush_make_rule(len, 0, type, 1, 10);
+  int p = 0;
+  crush_rule_set_step(r, p++, CRUSH_RULE_TAKE, -1, 0);  /* root id patched below */
+  crush_rule_set_step(r, p++, op1, n1, t1);
+  if (op2 >= 0) crush_rule_set_step(r, p++, op2, n2, t2);
+  crush_rule_set_step(r, p++, CRUSH_RULE_EMIT, 0, 0);
+  return r;
+}
+
+int main(void) {
+  int i, h, rck;
+
+  /* ---- scenario 1: flat straw2, choose_firstn ---- */
+  {
+    struct crush_map *m = new_map(50, 0, 0, 1, 1, 1);
+    int items[32], weights[32];
+    __u32 rw[32];
+    for (i = 0; i < 32; i++) { items[i] = i; weights[i] = 0x10000 * (1 + i % 3); }
+    weights[7] = 0; weights[20] = 0;
+    int root = add_straw2(m, 3, 32, items, weights);
+    for (i = 0; i < 32; i++) rw[i] = 0x10000;
+    rw[3] = 0x4000; rw[11] = 0;
+    struct crush_rule *r = mk_rule(1, CRUSH_RULE_CHOOSE_FIRSTN, 3, 0, -1, 0, 0);
+    r->steps[0].arg1 = root;
+    run_scenario("flat_firstn", m, root, r, rw, 32, 3);
+    crush_destroy(m);
+  }
+
+  /* ---- scenario 2/3/5: 8 hosts x 4 devices ---- */
+  for (int variant = 0; variant < 3; variant++) {
+    struct crush_map *m = (variant == 2) ? new_map(19, 2, 5, 0, 0, 0)
+                                         : new_map(50, 0, 0, 1, 1, 1);
+    int hostid[8];
+    for (h = 0; h < 8; h++) {
+      int items[4], weights[4];
+      for (i = 0; i < 4; i++) { items[i] = h * 4 + i; weights[i] = 0x10000 * (1 + ((h + i) % 2)); }
+      if (h == 2) weights[1] = 0;
+      hostid[h] = add_straw2(m, 1, 4, items, weights);
+    }
+    int ritems[8], rweights[8];
+    for (h = 0; h < 8; h++) { ritems[h] = hostid[h]; rweights[h] = m->buckets[-1-hostid[h]]->weight; }
+    int root = add_straw2(m, 3, 8, ritems, rweights);
+    __u32 rw[32];
+    for (i = 0; i < 32; i++) rw[i] = 0x10000;
+    rw[5] = 0x8000; rw[13] = 0; rw[28] = 0x2000;
+    struct crush_rule *r;
+    const char *name;
+    int rmax = 3;
+    if (variant == 0) { r = mk_rule(1, CRUSH_RULE_CHOOSELEAF_FIRSTN, 0, 1, -1, 0, 0); name = "host_chooseleaf_firstn"; }
+    else if (variant == 1) { r = mk_rule(3, CRUSH_RULE_CHOOSELEAF_INDEP, 0, 1, -1, 0, 0); name = "host_chooseleaf_indep"; rmax = 4; }
+    else { r = mk_rule(1, CRUSH_RULE_CHOOSELEAF_FIRSTN, 0, 1, -1, 0, 0); name = "host_chooseleaf_firstn_legacy"; }
+    r->steps[0].arg1 = root;
+    run_scenario(name, m, root, r, rw, 32, rmax);
+    crush_destroy(m);
+  }
+
+  /* ---- scenario 4: racks -> hosts -> devices, two choose steps ---- */
+  {
+    struct crush_map *m = new_map(50, 0, 0, 1, 1, 1);
+    int rackid[2];
+    int dev = 0;
+    for (rck = 0; rck < 2; rck++) {
+      int hitems[4], hweights[4];
+      for (h = 0; h < 4; h++) {
+        int items[4], weights[4];
+        for (i = 0; i < 4; i++) { items[i] = dev++; weights[i] = 0x10000 * (1 + (i % 3)); }
+        int hid = add_straw2(m, 1, 4, items, weights);
+        hitems[h] = hid; hweights[h] = m->buckets[-1-hid]->weight;
+      }
+      rackid[rck] = add_straw2(m, 2, 4, hitems, hweights);
+    }
+    int ritems[2] = { rackid[0], rackid[1] };
+    int rweights[2] = { (int)m->buckets[-1-rackid[0]]->weight, (int)m->buckets[-1-rackid[1]]->weight };
+    int root = add_straw2(m, 3, 2, ritems, rweights);
+    __u32 rw[32];
+    for (i = 0; i < 32; i++) rw[i] = 0x10000;
+    rw[9] = 0;
+    struct crush_rule *r = mk_rule(1, CRUSH_RULE_CHOOSE_FIRSTN, 2, 2,
+                                   CRUSH_RULE_CHOOSELEAF_FIRSTN, 2, 1);
+    r->steps[0].arg1 = root;
+    run_scenario("racks_two_step", m, root, r, rw, 32, 4);
+    crush_destroy(m);
+  }
+
+  /* ---- scenario 6: flat indep ---- */
+  {
+    struct crush_map *m = new_map(50, 0, 0, 1, 1, 1);
+    int items[32], weights[32];
+    __u32 rw[32];
+    for (i = 0; i < 32; i++) { items[i] = i; weights[i] = 0x10000 * (1 + i % 3); }
+    weights[7] = 0;
+    int root = add_straw2(m, 3, 32, items, weights);
+    for (i = 0; i < 32; i++) rw[i] = 0x10000;
+    rw[2] = 0;
+    struct crush_rule *r = mk_rule(3, CRUSH_RULE_CHOOSE_INDEP, 3, 0, -1, 0, 0);
+    r->steps[0].arg1 = root;
+    run_scenario("flat_indep", m, root, r, rw, 32, 3);
+    crush_destroy(m);
+  }
+
+  /* hash vectors */
+  {
+    printf("{\"scenario\":\"hash\",\"h1\":[");
+    for (i = 0; i < 64; i++) printf("%s%u", i?",":"", crush_hash32(0, i * 2654435761u + 17));
+    printf("],\"h2\":[");
+    for (i = 0; i < 64; i++) printf("%s%u", i?",":"", crush_hash32_2(0, i, i * 40503u + 3));
+    printf("],\"h3\":[");
+    for (i = 0; i < 64; i++) printf("%s%u", i?",":"", crush_hash32_3(0, i, i + 1, i * 7));
+    printf("],\"h5\":[");
+    for (i = 0; i < 64; i++) printf("%s%u", i?",":"", crush_hash32_5(0, i, 2*i, 3*i, 5*i, 7*i));
+    printf("]}\n");
+  }
+  return 0;
+}

@@ -1,0 +1,35 @@
+#!/usr/bin/env python
+"""Regenerate ceph_tpu/crush/_ll_table.py from the reference checkout.
+
+The straw2 draw uses a fixed-point log2 LUT (reference src/crush/
+crush_ln_table.h).  The RH/LH halves follow exact closed forms
+(RH[k] = ceil(2^48*128/(128+k)), LH[k] = floor(2^48*log2(1+k/128)) — verified
+against every entry) and are generated at import time.  The LL half deviates
+from its documented formula for most entries (generation artifacts in the
+original table); those 256 values are therefore pinned here as protocol
+constants — placements must match the deployed table bit-for-bit, not an
+idealized one.
+
+Usage: python scripts/gen_crush_tables.py [path-to-reference-checkout]
+"""
+
+import re
+import sys
+
+ref = sys.argv[1] if len(sys.argv) > 1 else "/root/reference"
+src = open(f"{ref}/src/crush/crush_ln_table.h").read()
+m = re.search(r"__LL_tbl\[256\]\s*=\s*\{(.*?)\};", src, re.S)
+ll = [int(x, 16) for x in re.findall(r"0x([0-9a-fA-F]+)ull", m.group(1))]
+assert len(ll) == 256
+
+with open("ceph_tpu/crush/_ll_table.py", "w") as f:
+    f.write('"""LL half of the straw2 log2 LUT — protocol constants.\n\n')
+    f.write("Pinned from the reference crush_ln_table.h (see\n")
+    f.write("scripts/gen_crush_tables.py); nominally 2^48*log2(1+k/2^15) but the\n")
+    f.write("deployed table deviates from that formula for most entries, and\n")
+    f.write('placement compatibility requires the deployed values.\n"""\n\n')
+    f.write("LL_TBL = (\n")
+    for i in range(0, 256, 4):
+        f.write("    " + ", ".join(f"0x{v:012x}" for v in ll[i : i + 4]) + ",\n")
+    f.write(")\n")
+print("wrote ceph_tpu/crush/_ll_table.py")
