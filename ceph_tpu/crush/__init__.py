@@ -25,10 +25,12 @@ def bench_map(n_osds: int = 10_000, n_pgs: int = 1_000_000, iters: int = 3):
     import numpy as np
 
     from ceph_tpu.crush.mapper import TensorMapper
-    from ceph_tpu.crush.types import build_hierarchy
+    from ceph_tpu.crush.types import build_three_level
 
-    cmap, rule = build_hierarchy(
-        n_hosts=max(1, n_osds // 16), osds_per_host=16, numrep=3
+    # 10k OSDs as deployed: root -> 40 racks -> 16 hosts -> 16 osds
+    n_racks = max(1, n_osds // 256)
+    cmap, rule = build_three_level(
+        n_racks=n_racks, hosts_per_rack=16, osds_per_host=16, numrep=3
     )
     mapper = TensorMapper(cmap)
     xs = np.arange(n_pgs, dtype=np.uint32)

@@ -1,0 +1,552 @@
+"""Vectorized CRUSH mapper: whole-OSDMap placement as one TPU dispatch.
+
+The TPU-native replacement for per-PG scalar crush_do_rule calls (reference
+mapper.c:883): every PG is a lane, and the firstn/indep retry loops become
+masked fixed-trip loops (SURVEY §3.3's vectorization plan).  Exactness
+contract: identical outputs to ScalarMapper (and therefore to the reference
+C) for straw2 maps with zero local retries — the reference's 'optimal'
+tunables profile.  Straw2 draws use uint32-pair arithmetic with pack-time
+Granlund-Montgomery reciprocals (ops/u64pair.py) instead of emulated s64.
+
+Supported: straw2 buckets; TAKE / CHOOSE(LEAF)_FIRSTN / CHOOSE(LEAF)_INDEP /
+EMIT / SET_* steps; vary_r / stable / descend_once semantics.  Uniform/list/
+tree/straw buckets and nonzero local-retry tunables fall back to the scalar
+oracle at the OSDMap layer.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ceph_tpu.crush.ln import LH_TBL, RH_TBL
+from ceph_tpu.crush._ll_table import LL_TBL
+from ceph_tpu.crush.types import (
+    CRUSH_ITEM_NONE,
+    CRUSH_ITEM_UNDEF,
+    CrushMap,
+    RULE_CHOOSELEAF_FIRSTN,
+    RULE_CHOOSELEAF_INDEP,
+    RULE_CHOOSE_FIRSTN,
+    RULE_CHOOSE_INDEP,
+    RULE_EMIT,
+    RULE_SET_CHOOSELEAF_STABLE,
+    RULE_SET_CHOOSELEAF_TRIES,
+    RULE_SET_CHOOSELEAF_VARY_R,
+    RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES,
+    RULE_SET_CHOOSE_LOCAL_TRIES,
+    RULE_SET_CHOOSE_TRIES,
+    RULE_TAKE,
+)
+from ceph_tpu.ops import jenkins, u64pair
+
+U32 = jnp.uint32
+I32 = jnp.int32
+
+
+def _split_u64(vals) -> Tuple[np.ndarray, np.ndarray]:
+    v = np.asarray(vals, dtype=np.object_)
+    hi = np.array([int(x) >> 32 for x in v], dtype=np.uint32)
+    lo = np.array([int(x) & 0xFFFFFFFF for x in v], dtype=np.uint32)
+    return hi, lo
+
+
+class TensorMapper:
+    def __init__(self, cmap: CrushMap, chunk: int = 1 << 16):
+        self.map = cmap
+        self.chunk = chunk
+        t = cmap.tunables
+        if t.choose_local_tries or t.choose_local_fallback_tries:
+            raise NotImplementedError(
+                "vectorized mapper requires zero local retries (optimal "
+                "tunables); use ScalarMapper for legacy profiles")
+        ids = sorted(cmap.buckets, reverse=True)
+        self.nb = len(ids)
+        assert ids == [-1 - i for i in range(self.nb)], "bucket ids must be dense"
+        max_sz = max(b.size for b in cmap.buckets.values())
+        items = np.zeros((self.nb, max_sz), dtype=np.int32)
+        weights = np.zeros((self.nb, max_sz), dtype=np.uint32)
+        sizes = np.zeros(self.nb, dtype=np.int32)
+        btypes = np.zeros(self.nb, dtype=np.int32)
+        recip_hi = np.zeros((self.nb, max_sz), dtype=np.uint32)
+        recip_lo = np.zeros((self.nb, max_sz), dtype=np.uint32)
+        for bid, b in cmap.buckets.items():
+            row = -1 - bid
+            if b.alg != "straw2":
+                raise NotImplementedError(
+                    f"vectorized mapper supports straw2 buckets, not {b.alg}")
+            sizes[row] = b.size
+            btypes[row] = b.type
+            items[row, : b.size] = b.items
+            weights[row, : b.size] = b.weights
+            for i, w in enumerate(b.weights):
+                if w == 1:
+                    r = 2**64 - 1
+                elif w > 1:
+                    r = 2**64 // w
+                else:
+                    r = 0
+                recip_hi[row, i] = r >> 32
+                recip_lo[row, i] = r & 0xFFFFFFFF
+        self.items = jnp.asarray(items)
+        self.iweights = jnp.asarray(weights)
+        self.sizes = jnp.asarray(sizes)
+        self.btypes = jnp.asarray(btypes)
+        self.recip_hi = jnp.asarray(recip_hi)
+        self.recip_lo = jnp.asarray(recip_lo)
+        self.max_devices = cmap.max_devices
+        self.max_depth = cmap.max_depth()
+        rh_hi, rh_lo = _split_u64(RH_TBL)
+        lh_hi, lh_lo = _split_u64(LH_TBL)
+        ll_hi, ll_lo = _split_u64(LL_TBL)
+        self._rh = (jnp.asarray(rh_hi), jnp.asarray(rh_lo))
+        self._lh = (jnp.asarray(lh_hi), jnp.asarray(lh_lo))
+        self._ll = (jnp.asarray(ll_hi), jnp.asarray(ll_lo))
+        self._rh_np = _split_u64(RH_TBL)
+        self._lh_np = _split_u64(LH_TBL)
+        self._ll_np = _split_u64(LL_TBL)
+        # precomputed |ln| table (512 KiB): one gather on the hot path.
+        # (A select-tree variant, _ln_neg_tree, is exact and ~14x faster per
+        # element but blows up compile time when inlined in the retry loops;
+        # a Pallas straw2 kernel is the planned fix.)
+        from ceph_tpu.crush.ln import crush_ln
+
+        ln_neg = [0x1000000000000 - crush_ln(u) for u in range(0x10000)]
+        lnn_hi, lnn_lo = _split_u64(ln_neg)
+        self._lnn = (jnp.asarray(lnn_hi), jnp.asarray(lnn_lo))
+        # bound per-dispatch memory: lanes * max_bucket_size * ~32 u32 temps
+        self.chunk = max(512, min(chunk, (1 << 24) // max(max_sz, 1)))
+        self._compiled: Dict = {}
+
+    # ------------------------------------------------------------------ ln
+
+    @staticmethod
+    def _tree_lookup(table: np.ndarray, idx, nbits: int):
+        """Constant-select-tree table lookup: TPU gathers scalarize, but a
+        log2(N)-deep where-tree over scalar constants fuses into one
+        elementwise pass (~14x faster than gather at 16M elements)."""
+        n = 1 << nbits
+        level = [np.uint32(int(v)) for v in table] + \
+                [np.uint32(0)] * (n - len(table))
+        bits = [(idx >> b) & 1 for b in range(nbits)]
+        for b in range(nbits):
+            sel = bits[b] == 1
+            level = [jnp.where(sel, level[j + 1], level[j])
+                     for j in range(0, len(level), 2)]
+        return level[0]
+
+    def _ln_neg_tree(self, u):
+        """Gather-free |ln|: arithmetic path with select-tree LUTs."""
+        x = (u + 1).astype(U32)
+        no_msb = (x & 0x18000) == 0
+        bits = (jax.lax.clz((x & 0x1FFFF).astype(U32)).astype(I32) - 16)
+        bits = jnp.where(no_msb, bits, 0).astype(U32)
+        x = (x << bits).astype(U32)
+        iexpon = (15 - bits.astype(I32)).astype(U32)
+        k = (x >> 8) - 128
+        rh_hi = self._tree_lookup(self._rh_np[0], k, 8)
+        rh_lo = self._tree_lookup(self._rh_np[1], k, 8)
+        r0 = rh_lo & 0xFFFF
+        r1 = rh_lo >> 16
+        r2 = rh_hi & 0xFFFF
+        r3 = rh_hi >> 16
+        p0 = x * r0
+        t1 = x * r1 + (p0 >> 16)
+        t2 = x * r2 + (t1 >> 16)
+        t3 = x * r3 + (t2 >> 16)
+        index2 = t3 & 0xFF
+        lh = (self._tree_lookup(self._lh_np[0], k, 8),
+              self._tree_lookup(self._lh_np[1], k, 8))
+        ll = (self._tree_lookup(self._ll_np[0], index2, 8),
+              self._tree_lookup(self._ll_np[1], index2, 8))
+        s = u64pair.shr(u64pair.add(lh, ll), 4)
+        res = u64pair.add((iexpon << 12, jnp.zeros_like(x)), s)
+        return u64pair.sub((jnp.full_like(x, 0x10000), jnp.zeros_like(x)), res)
+
+    def _ln_neg(self, u):
+        """|ln| = 0x1000000000000 - crush_ln(u), as a uint32 pair.
+
+        Exact mirror of reference mapper.c:248-290 in 32-bit ops.
+        """
+        x = (u + 1).astype(U32)
+        no_msb = (x & 0x18000) == 0
+        bits = (jax.lax.clz((x & 0x1FFFF).astype(U32)).astype(I32) - 16)
+        bits = jnp.where(no_msb, bits, 0).astype(U32)
+        x = (x << bits).astype(U32)
+        iexpon = (15 - bits.astype(I32)).astype(U32)
+        k = (x >> 8) - 128
+        rh_hi = self._rh[0][k]
+        rh_lo = self._rh[1][k]
+        # xl64 = (x * RH) >> 48 via 16-bit limbs of RH
+        r0 = rh_lo & 0xFFFF
+        r1 = rh_lo >> 16
+        r2 = rh_hi & 0xFFFF
+        r3 = rh_hi >> 16
+        p0 = x * r0
+        t1 = x * r1 + (p0 >> 16)
+        t2 = x * r2 + (t1 >> 16)
+        t3 = x * r3 + (t2 >> 16)
+        index2 = t3 & 0xFF
+        s = u64pair.add((self._lh[0][k], self._lh[1][k]),
+                        (self._ll[0][index2], self._ll[1][index2]))
+        s = u64pair.shr(s, 4)
+        res = u64pair.add((iexpon << 12, jnp.zeros_like(x)), s)
+        return u64pair.sub((jnp.full_like(x, 0x10000), jnp.zeros_like(x)), res)
+
+    # -------------------------------------------------------------- straw2
+
+    def _straw2(self, bno, x, r):
+        """bucket_straw2_choose (mapper.c:322-367) over a lane batch.
+
+        bno (L,), x (L,) uint32, r (L,) int32 -> chosen item (L,) int32.
+        """
+        it = self.items[bno]                      # (L, S)
+        wt = self.iweights[bno]
+        sz = self.sizes[bno]
+        u = jenkins.hash3(x[:, None], it.astype(U32), r.astype(U32)[:, None]) & 0xFFFF
+        n = (self._lnn[0][u], self._lnn[1][u])
+        qh, ql = u64pair.div_by_recip(
+            n, wt, self.recip_hi[bno], self.recip_lo[bno])
+        pos = jnp.arange(it.shape[1], dtype=I32)
+        invalid = (wt == 0) | (pos[None, :] >= sz[:, None])
+        qh = jnp.where(invalid, jnp.uint32(0xFFFFFFFF), qh)
+        ql = jnp.where(invalid, jnp.uint32(0xFFFFFFFF), ql)
+        # first-occurrence two-level argmin (draw > high_draw semantics)
+        m1 = qh.min(axis=1, keepdims=True)
+        c1 = qh == m1
+        ql2 = jnp.where(c1, ql, jnp.uint32(0xFFFFFFFF))
+        m2 = ql2.min(axis=1, keepdims=True)
+        winner = c1 & (ql2 == m2)
+        idx = jnp.argmax(winner, axis=1)
+        return jnp.take_along_axis(it, idx[:, None], axis=1)[:, 0]
+
+    # ------------------------------------------------------------- helpers
+
+    def _is_out(self, weights, item, x):
+        """is_out (mapper.c:407-421); item (L,) int32 device ids."""
+        idx = jnp.clip(item, 0, self.max_devices - 1)
+        w = weights[idx]
+        over = item >= self.max_devices
+        hashed = (jenkins.hash2(x, item.astype(U32)) & 0xFFFF) >= w
+        return over | (w == 0) | ((w < 0x10000) & hashed)
+
+    def _descend(self, start, x, r, type_):
+        """Descend intervening buckets until an item of type_ (or dead end).
+
+        Returns (item, hit_empty).  Mirrors the retry_bucket descent of
+        choose_firstn/indep (same r at every level for straw2 maps).
+        """
+        cur = start
+        hit_empty = jnp.zeros(x.shape, dtype=bool)
+        for _ in range(self.max_depth):
+            is_b = cur < 0
+            bno = jnp.clip(-1 - cur, 0, self.nb - 1)
+            need = is_b & (self.btypes[bno] != type_)
+            empty = need & (self.sizes[bno] == 0)
+            hit_empty = hit_empty | empty
+            nxt = self._straw2(bno, x, r)
+            cur = jnp.where(need & ~empty, nxt, cur)
+        return cur, hit_empty
+
+    def _bad_item(self, cur, type_):
+        bno = jnp.clip(-1 - cur, 0, self.nb - 1)
+        wrong_bucket = (cur < 0) & (self.btypes[bno] != type_)
+        wrong_dev = (cur >= 0) & ((type_ != 0) | (cur >= self.max_devices))
+        return wrong_bucket | wrong_dev
+
+    # -------------------------------------------------------------- firstn
+
+    def _leaf_firstn(self, host, x, inner_rep, sub_r, tries, out2, cnt, act):
+        """Recursive chooseleaf descent (single stable rep).
+
+        Mirrors the recursive crush_choose_firstn call at mapper.c:556-573.
+        Returns (leaf, ok).
+        """
+        already = host >= 0  # "we already have a leaf"
+        leaf = jnp.where(already, host, CRUSH_ITEM_NONE)
+        done = ~act | already
+        lftotal = jnp.zeros_like(x, dtype=I32)
+
+        def cond(s):
+            leaf, done, lftotal = s
+            return jnp.any(~done & (lftotal < tries))
+
+        def body(s):
+            leaf, done, lftotal = s
+            live = ~done & (lftotal < tries)
+            r2 = inner_rep + sub_r + lftotal
+            cur, hit_empty = self._descend(host, x, r2, 0)
+            bad = self._bad_item(cur, 0) & ~hit_empty
+            coll = jnp.any(
+                (out2 == cur[:, None])
+                & (jnp.arange(out2.shape[1])[None, :] < cnt[:, None]),
+                axis=1,
+            )
+            rej = self._is_out(self._w, cur, x) | hit_empty
+            ok = live & ~bad & ~coll & ~rej
+            leaf = jnp.where(ok, cur, leaf)
+            done = done | ok | (live & bad)  # bad -> inner skip_rep
+            lftotal = jnp.where(live & ~ok & ~bad, lftotal + 1, lftotal)
+            return leaf, done, lftotal
+
+        leaf, done, _ = jax.lax.while_loop(cond, body, (leaf, done, lftotal))
+        ok = act & (already | (leaf != CRUSH_ITEM_NONE))
+        return leaf, ok
+
+    def _choose_firstn_vec(self, take, x, numrep, type_, tries, recurse_tries,
+                           recurse_to_leaf, vary_r, stable, lane_mask):
+        """crush_choose_firstn (mapper.c:443-631), zero local retries."""
+        L = x.shape[0]
+        out = jnp.full((L, numrep), CRUSH_ITEM_NONE, dtype=I32)
+        out2 = jnp.full((L, numrep), CRUSH_ITEM_NONE, dtype=I32)
+        cnt = jnp.zeros(L, dtype=I32)
+        for rep in range(numrep):
+            def cond(s):
+                out, out2, cnt, ftotal, done = s
+                return jnp.any(~done & (ftotal < tries))
+
+            def body(s, rep=rep):
+                out, out2, cnt, ftotal, done = s
+                live = ~done & (ftotal < tries)
+                r = rep + ftotal
+                cur, hit_empty = self._descend(take, x, r, type_)
+                bad = live & self._bad_item(cur, type_) & ~hit_empty
+                coll = jnp.any(
+                    (out == cur[:, None])
+                    & (jnp.arange(numrep)[None, :] < cnt[:, None]),
+                    axis=1,
+                )
+                reject = hit_empty
+                leaf = cur
+                if recurse_to_leaf:
+                    sub_r = (r >> (vary_r - 1)) if vary_r else jnp.zeros_like(r)
+                    inner_rep = jnp.zeros_like(cnt) if stable else cnt
+                    leaf, leaf_ok = self._leaf_firstn(
+                        cur, x, inner_rep, sub_r, recurse_tries, out2, cnt,
+                        live & ~bad & ~coll & (cur < 0))
+                    leaf = jnp.where(cur >= 0, cur, leaf)
+                    reject = reject | ((cur < 0) & ~leaf_ok)
+                if type_ == 0:
+                    reject = reject | self._is_out(self._w, cur, x)
+                success = live & ~bad & ~coll & ~reject
+                slot = jnp.arange(numrep)[None, :] == cnt[:, None]
+                out = jnp.where(slot & success[:, None], cur[:, None], out)
+                out2 = jnp.where(slot & success[:, None], leaf[:, None], out2)
+                cnt = cnt + success.astype(I32)
+                done = done | success | bad
+                ftotal = jnp.where(live & ~success & ~bad, ftotal + 1, ftotal)
+                return out, out2, cnt, ftotal, done
+
+            ftotal = jnp.zeros(L, dtype=I32)
+            done = ~lane_mask
+            out, out2, cnt, _, _ = jax.lax.while_loop(
+                cond, body, (out, out2, cnt, ftotal, done))
+        return (out2 if recurse_to_leaf else out), cnt
+
+    # --------------------------------------------------------------- indep
+
+    def _leaf_indep(self, host, x, rep, numrep, parent_r, tries, act):
+        """Recursive chooseleaf for indep (mapper.c:767-786)."""
+        already = host >= 0
+        leaf = jnp.where(already & act, host, CRUSH_ITEM_UNDEF)
+        done = ~act | already
+
+        def cond(s):
+            leaf, done, ftotal = s
+            return jnp.any(~done & (ftotal < tries))
+
+        def body(s):
+            leaf, done, ftotal = s
+            live = ~done & (ftotal < tries)
+            r = rep + parent_r + numrep * ftotal
+            cur, hit_empty = self._descend(host, x, r, 0)
+            bad = self._bad_item(cur, 0)
+            rej = self._is_out(self._w, cur, x) | hit_empty
+            ok = live & ~bad & ~rej
+            leaf = jnp.where(ok, cur, leaf)
+            leaf = jnp.where(live & bad, CRUSH_ITEM_NONE, leaf)
+            done = done | ok | (live & bad)
+            ftotal = ftotal + live.astype(I32)
+            return leaf, done, ftotal
+
+        leaf, _, _ = jax.lax.while_loop(
+            cond, body, (leaf, done, jnp.zeros_like(x, dtype=I32)))
+        leaf = jnp.where(leaf == CRUSH_ITEM_UNDEF, CRUSH_ITEM_NONE, leaf)
+        return leaf
+
+    def _choose_indep_vec(self, take, x, out_size, numrep, type_, tries,
+                          recurse_tries, recurse_to_leaf, lane_mask):
+        """crush_choose_indep (mapper.c:638-826), parent_r = 0."""
+        L = x.shape[0]
+        out = jnp.where(lane_mask[:, None],
+                        jnp.full((L, out_size), CRUSH_ITEM_UNDEF, dtype=I32),
+                        jnp.full((L, out_size), CRUSH_ITEM_NONE, dtype=I32))
+        out2 = out
+
+        def cond(s):
+            out, out2, ftotal = s
+            return jnp.any((out == CRUSH_ITEM_UNDEF) & (ftotal[:, None] < tries))
+
+        def body(s):
+            out, out2, ftotal = s
+            lane_live = jnp.any(out == CRUSH_ITEM_UNDEF, axis=1) & (ftotal < tries)
+            for rep in range(out_size):
+                act = lane_live & (out[:, rep] == CRUSH_ITEM_UNDEF)
+                r = rep + numrep * ftotal
+                cur, hit_empty = self._descend(take, x, r, type_)
+                bad = act & self._bad_item(cur, type_) & ~hit_empty
+                coll = jnp.any(out == cur[:, None], axis=1)
+                leaf = cur
+                leaf_fail = jnp.zeros_like(bad)
+                if recurse_to_leaf:
+                    leaf = self._leaf_indep(
+                        cur, x, rep, numrep, r, recurse_tries,
+                        act & ~bad & ~coll & (cur < 0))
+                    leaf = jnp.where(cur >= 0, cur, leaf)
+                    leaf_fail = (cur < 0) & (leaf == CRUSH_ITEM_NONE)
+                rej = jnp.zeros_like(bad)
+                if type_ == 0:
+                    rej = self._is_out(self._w, cur, x)
+                success = act & ~bad & ~coll & ~leaf_fail & ~rej & ~hit_empty
+                col = jnp.arange(out_size)[None, :] == rep
+                out = jnp.where(col & success[:, None], cur[:, None], out)
+                out = jnp.where(col & bad[:, None], CRUSH_ITEM_NONE, out)
+                out2 = jnp.where(col & success[:, None], leaf[:, None], out2)
+                out2 = jnp.where(col & bad[:, None], CRUSH_ITEM_NONE, out2)
+            ftotal = ftotal + lane_live.astype(I32)
+            return out, out2, ftotal
+
+        out, out2, _ = jax.lax.while_loop(
+            cond, body, (out, out2, jnp.zeros(L, dtype=I32)))
+        out = jnp.where(out == CRUSH_ITEM_UNDEF, CRUSH_ITEM_NONE, out)
+        out2 = jnp.where(out2 == CRUSH_ITEM_UNDEF, CRUSH_ITEM_NONE, out2)
+        return (out2 if recurse_to_leaf else out)
+
+    # ------------------------------------------------------------- rule VM
+
+    def _build_rule_fn(self, ruleno: int, result_max: int):
+        m = self.map
+        t = m.tunables
+        rule = m.rules[ruleno]
+
+        def run(xs, weights):
+            self._w = weights
+            L = xs.shape[0]
+            choose_tries = t.choose_total_tries + 1
+            choose_leaf_tries = 0
+            vary_r = t.chooseleaf_vary_r
+            stable = t.chooseleaf_stable
+            w_items = jnp.full((L, result_max), CRUSH_ITEM_NONE, dtype=I32)
+            wsize = jnp.zeros(L, dtype=I32)
+            result = jnp.full((L, result_max), CRUSH_ITEM_NONE, dtype=I32)
+            rlen = jnp.zeros(L, dtype=I32)
+            for op, arg1, arg2 in rule.steps:
+                if op == RULE_TAKE:
+                    w_items = w_items.at[:, 0].set(arg1)
+                    wsize = jnp.full(L, 1, dtype=I32)
+                elif op == RULE_SET_CHOOSE_TRIES:
+                    if arg1 > 0:
+                        choose_tries = arg1
+                elif op == RULE_SET_CHOOSELEAF_TRIES:
+                    if arg1 > 0:
+                        choose_leaf_tries = arg1
+                elif op == RULE_SET_CHOOSELEAF_VARY_R:
+                    if arg1 >= 0:
+                        vary_r = arg1
+                elif op == RULE_SET_CHOOSELEAF_STABLE:
+                    if arg1 >= 0:
+                        stable = arg1
+                elif op in (RULE_SET_CHOOSE_LOCAL_TRIES,
+                            RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES):
+                    if arg1 > 0:
+                        raise NotImplementedError("local retries not vectorized")
+                elif op in (RULE_CHOOSE_FIRSTN, RULE_CHOOSELEAF_FIRSTN,
+                            RULE_CHOOSE_INDEP, RULE_CHOOSELEAF_INDEP):
+                    firstn = op in (RULE_CHOOSE_FIRSTN, RULE_CHOOSELEAF_FIRSTN)
+                    recurse = op in (RULE_CHOOSELEAF_FIRSTN, RULE_CHOOSELEAF_INDEP)
+                    numrep = arg1
+                    if numrep <= 0:
+                        numrep += result_max
+                        if numrep <= 0:
+                            continue
+                    o_items = jnp.full((L, result_max), CRUSH_ITEM_NONE, dtype=I32)
+                    osize = jnp.zeros(L, dtype=I32)
+                    # Each W entry gets an independent output segment
+                    # (reference passes o+osize per input bucket).
+                    for i in range(result_max):
+                        mask = (i < wsize) & (w_items[:, i] < 0)
+                        take = w_items[:, i]
+                        if firstn:
+                            if choose_leaf_tries:
+                                recurse_tries = choose_leaf_tries
+                            elif t.chooseleaf_descend_once:
+                                recurse_tries = 1
+                            else:
+                                recurse_tries = choose_tries
+                            vals, cnt = self._choose_firstn_vec(
+                                take, xs, numrep, arg2, choose_tries,
+                                recurse_tries, recurse, vary_r, stable, mask)
+                            ncols = numrep
+                            cnt = jnp.where(mask, cnt, 0)
+                        else:
+                            # out_size depends on osize only when segments
+                            # overflow result_max; clamp below on append
+                            vals = self._choose_indep_vec(
+                                take, xs, numrep, numrep, arg2, choose_tries,
+                                choose_leaf_tries if choose_leaf_tries else 1,
+                                recurse, mask)
+                            ncols = numrep
+                            cnt = jnp.where(mask, numrep, 0)
+                        for j in range(ncols):
+                            valid = (j < cnt) & (osize < result_max)
+                            slot = jnp.arange(result_max)[None, :] == osize[:, None]
+                            o_items = jnp.where(
+                                slot & valid[:, None], vals[:, j][:, None], o_items)
+                            osize = osize + valid.astype(I32)
+                    w_items = o_items
+                    wsize = osize
+                elif op == RULE_EMIT:
+                    for j in range(result_max):
+                        valid = (j < wsize) & (rlen < result_max)
+                        slot = jnp.arange(result_max)[None, :] == rlen[:, None]
+                        result = jnp.where(
+                            slot & valid[:, None], w_items[:, j][:, None], result)
+                        rlen = rlen + valid.astype(I32)
+                    wsize = jnp.zeros(L, dtype=I32)
+                else:
+                    raise NotImplementedError(f"rule op {op}")
+            return result, rlen
+
+        return jax.jit(run)
+
+    def do_rule_batch(self, ruleno: int, xs, result_max: int, weights):
+        """Map a batch of x values; returns (N, result_max) int32 with
+        CRUSH_ITEM_NONE padding, plus lengths, matching crush_do_rule."""
+        key = (ruleno, result_max)
+        if key not in self._compiled:
+            self._compiled[key] = self._build_rule_fn(ruleno, result_max)
+        fn = self._compiled[key]
+        xs = jnp.asarray(xs, dtype=U32)
+        weights = jnp.asarray(weights, dtype=U32)
+        n = xs.shape[0]
+        outs = []
+        lens = []
+        for start in range(0, n, self.chunk):
+            part = xs[start : start + self.chunk]
+            pad = 0
+            if part.shape[0] < self.chunk and n > self.chunk:
+                pad = self.chunk - part.shape[0]
+                part = jnp.pad(part, (0, pad))
+            res, rl = fn(part, weights)
+            if pad:
+                res = res[:-pad]
+                rl = rl[:-pad]
+            outs.append(res)
+            lens.append(rl)
+        if len(outs) == 1:
+            return outs[0], lens[0]
+        return jnp.concatenate(outs), jnp.concatenate(lens)

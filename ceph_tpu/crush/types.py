@@ -153,6 +153,37 @@ class CrushMap:
         return max((depth(bid) for bid in self.buckets), default=0)
 
 
+def build_three_level(
+    n_racks: int,
+    hosts_per_rack: int,
+    osds_per_host: int,
+    numrep: int = 3,
+    weight: int = 0x10000,
+) -> Tuple[CrushMap, int]:
+    """root -> rack -> host -> osd map + chooseleaf-firstn rule (the
+    deployment shape of large clusters; keeps bucket fanouts narrow)."""
+    cmap = CrushMap()
+    rack_ids, rack_w = [], []
+    dev = 0
+    for r in range(n_racks):
+        host_ids, host_w = [], []
+        for h in range(hosts_per_rack):
+            items = list(range(dev, dev + osds_per_host))
+            dev += osds_per_host
+            weights = [weight] * osds_per_host
+            hid = cmap.make_straw2(1, items, weights, name=f"host{r}-{h}")
+            host_ids.append(hid)
+            host_w.append(sum(weights))
+        rid = cmap.make_straw2(2, host_ids, host_w, name=f"rack{r}")
+        rack_ids.append(rid)
+        rack_w.append(sum(host_w))
+    root = cmap.make_straw2(3, rack_ids, rack_w, name="default")
+    steps = [(RULE_TAKE, root, 0), (RULE_CHOOSELEAF_FIRSTN, numrep, 1),
+             (RULE_EMIT, 0, 0)]
+    ruleno = cmap.add_rule(Rule(steps=steps))
+    return cmap, ruleno
+
+
 def build_hierarchy(
     n_hosts: int,
     osds_per_host: int,

@@ -12,37 +12,44 @@ from __future__ import annotations
 import numpy as np
 
 CRUSH_HASH_SEED = 1315423911
+M32 = np.uint32(0xFFFFFFFF)  # typed: large literals overflow jnp's int32 parse
+
+
+def _u(v):
+    """Coerce plain Python ints to np.uint64 so the masked ops wrap
+    correctly under NEP-50 numpy scalar semantics; arrays pass through."""
+    return np.uint64(v) if isinstance(v, int) else v
 CRUSH_HASH_RJENKINS1 = 0
 
 
 def _mix(a, b, c):
     """One crush_hashmix round; args and results are uint32 arrays."""
-    a = (a - b) & 0xFFFFFFFF
-    a = (a - c) & 0xFFFFFFFF
+    a = (a - b) & M32
+    a = (a - c) & M32
     a = a ^ (c >> 13)
-    b = (b - c) & 0xFFFFFFFF
-    b = (b - a) & 0xFFFFFFFF
-    b = b ^ ((a << 8) & 0xFFFFFFFF)
-    c = (c - a) & 0xFFFFFFFF
-    c = (c - b) & 0xFFFFFFFF
+    b = (b - c) & M32
+    b = (b - a) & M32
+    b = b ^ ((a << 8) & M32)
+    c = (c - a) & M32
+    c = (c - b) & M32
     c = c ^ (b >> 13)
-    a = (a - b) & 0xFFFFFFFF
-    a = (a - c) & 0xFFFFFFFF
+    a = (a - b) & M32
+    a = (a - c) & M32
     a = a ^ (c >> 12)
-    b = (b - c) & 0xFFFFFFFF
-    b = (b - a) & 0xFFFFFFFF
-    b = b ^ ((a << 16) & 0xFFFFFFFF)
-    c = (c - a) & 0xFFFFFFFF
-    c = (c - b) & 0xFFFFFFFF
+    b = (b - c) & M32
+    b = (b - a) & M32
+    b = b ^ ((a << 16) & M32)
+    c = (c - a) & M32
+    c = (c - b) & M32
     c = c ^ (b >> 5)
-    a = (a - b) & 0xFFFFFFFF
-    a = (a - c) & 0xFFFFFFFF
+    a = (a - b) & M32
+    a = (a - c) & M32
     a = a ^ (c >> 3)
-    b = (b - c) & 0xFFFFFFFF
-    b = (b - a) & 0xFFFFFFFF
-    b = b ^ ((a << 10) & 0xFFFFFFFF)
-    c = (c - a) & 0xFFFFFFFF
-    c = (c - b) & 0xFFFFFFFF
+    b = (b - c) & M32
+    b = (b - a) & M32
+    b = b ^ ((a << 10) & M32)
+    c = (c - a) & M32
+    c = (c - b) & M32
     c = c ^ (b >> 15)
     return a, b, c
 
@@ -52,7 +59,8 @@ _Y = 1232
 
 
 def hash1(a):
-    h = (CRUSH_HASH_SEED ^ a) & 0xFFFFFFFF
+    a = _u(a)
+    h = (CRUSH_HASH_SEED ^ a) & M32
     b = a
     x, y = _X, _Y
     b, x, h = _mix(b, x, h)
@@ -61,7 +69,9 @@ def hash1(a):
 
 
 def hash2(a, b):
-    h = (CRUSH_HASH_SEED ^ a ^ b) & 0xFFFFFFFF
+    a = _u(a)
+    b = _u(b)
+    h = (CRUSH_HASH_SEED ^ a ^ b) & M32
     x, y = _X, _Y
     a, b, h = _mix(a, b, h)
     x, a, h = _mix(x, a, h)
@@ -70,7 +80,10 @@ def hash2(a, b):
 
 
 def hash3(a, b, c):
-    h = (CRUSH_HASH_SEED ^ a ^ b ^ c) & 0xFFFFFFFF
+    a = _u(a)
+    b = _u(b)
+    c = _u(c)
+    h = (CRUSH_HASH_SEED ^ a ^ b ^ c) & M32
     x, y = _X, _Y
     a, b, h = _mix(a, b, h)
     c, x, h = _mix(c, x, h)
@@ -81,7 +94,11 @@ def hash3(a, b, c):
 
 
 def hash4(a, b, c, d):
-    h = (CRUSH_HASH_SEED ^ a ^ b ^ c ^ d) & 0xFFFFFFFF
+    a = _u(a)
+    b = _u(b)
+    c = _u(c)
+    d = _u(d)
+    h = (CRUSH_HASH_SEED ^ a ^ b ^ c ^ d) & M32
     x, y = _X, _Y
     a, b, h = _mix(a, b, h)
     c, d, h = _mix(c, d, h)
@@ -93,7 +110,12 @@ def hash4(a, b, c, d):
 
 
 def hash5(a, b, c, d, e):
-    h = (CRUSH_HASH_SEED ^ a ^ b ^ c ^ d ^ e) & 0xFFFFFFFF
+    a = _u(a)
+    b = _u(b)
+    c = _u(c)
+    d = _u(d)
+    e = _u(e)
+    h = (CRUSH_HASH_SEED ^ a ^ b ^ c ^ d ^ e) & M32
     x, y = _X, _Y
     a, b, h = _mix(a, b, h)
     c, d, h = _mix(c, d, h)

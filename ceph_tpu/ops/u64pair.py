@@ -1,0 +1,128 @@
+"""Exact 64-bit unsigned arithmetic as uint32 pairs for the TPU VPU.
+
+The straw2 draw (reference mapper.c:322-367) needs 64-bit fixed-point log
+values and an exact truncating 64/32-bit division.  TPUs are 32-bit-native
+(s64 is emulated and slow, and f64 is unavailable), so the mapper carries
+(hi, lo) uint32 pairs and divides via precomputed Granlund-Montgomery
+reciprocals: with r = floor(2^64 / w) (a pack-time per-item constant),
+q̂ = (n * r) >> 64 is within 1 of n // w and one remainder comparison
+corrects it.  Everything here is add/sub/shift/mul16 — pure VPU ops.
+
+All functions take and return uint32 arrays (numpy or jax.numpy alike).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+M16 = 0xFFFF
+M32 = np.uint32(0xFFFFFFFF)  # typed: large literals overflow jnp's int32 parse
+
+
+def pair(hi, lo):
+    return hi, lo
+
+
+def add(a, b):
+    """(a_hi, a_lo) + (b_hi, b_lo) mod 2^64."""
+    lo = (a[1] + b[1]) & M32
+    carry = (lo < a[1]).astype(lo.dtype) if hasattr(lo, "astype") else int(lo < a[1])
+    hi = (a[0] + b[0] + carry) & M32
+    return hi, lo
+
+
+def sub(a, b):
+    """(a - b) mod 2^64."""
+    lo = (a[1] - b[1]) & M32
+    borrow = (a[1] < b[1]).astype(lo.dtype) if hasattr(lo, "astype") else int(a[1] < b[1])
+    hi = (a[0] - b[0] - borrow) & M32
+    return hi, lo
+
+
+def shr(a, n: int):
+    """Logical right shift by a static 0 < n < 32."""
+    lo = ((a[1] >> n) | (a[0] << (32 - n))) & M32
+    hi = a[0] >> n
+    return hi, lo
+
+
+def lt(a, b):
+    return (a[0] < b[0]) | ((a[0] == b[0]) & (a[1] < b[1]))
+
+
+def ge(a, b):
+    return ~lt(a, b)
+
+
+def eq(a, b):
+    return (a[0] == b[0]) & (a[1] == b[1])
+
+
+def mul32(a, b):
+    """u32 x u32 -> u64 pair, via 16-bit limbs (no 64-bit hardware mul)."""
+    a0, a1 = a & M16, a >> 16
+    b0, b1 = b & M16, b >> 16
+    p00 = a0 * b0
+    p01 = a0 * b1
+    p10 = a1 * b0
+    p11 = a1 * b1
+    mid = (p00 >> 16) + (p01 & M16) + (p10 & M16)
+    lo = ((p00 & M16) | ((mid & M16) << 16)) & M32
+    hi = (p11 + (p01 >> 16) + (p10 >> 16) + (mid >> 16)) & M32
+    return hi, lo
+
+
+def mulhi64(n, r):
+    """((n_hi, n_lo) * (r_hi, r_lo)) >> 64, exact.
+
+    Requires the true product's bit 128 overflow-free, which holds for any
+    u64 inputs (product < 2^128); result is the high u64 pair.
+    """
+    n_hi, n_lo = n
+    r_hi, r_lo = r
+    h1, _l1 = mul32(n_lo, r_lo)
+    h2, l2 = mul32(n_lo, r_hi)
+    h3, l3 = mul32(n_hi, r_lo)
+    h4, l4 = mul32(n_hi, r_hi)
+    # bits 32..63 column: h1 + l2 + l3 -> carries into bits 64+
+    m1 = (h1 + l2) & M32
+    c1 = (m1 < l2).astype(m1.dtype) if hasattr(m1, "astype") else int(m1 < l2)
+    m2 = (m1 + l3) & M32
+    c2 = (m2 < l3).astype(m2.dtype) if hasattr(m2, "astype") else int(m2 < l3)
+    carry_mid = c1 + c2
+    # bits 64..95 column: h2 + h3 + l4 + carry_mid
+    s1 = (h2 + h3) & M32
+    k1 = (s1 < h3).astype(s1.dtype) if hasattr(s1, "astype") else int(s1 < h3)
+    s2 = (s1 + l4) & M32
+    k2 = (s2 < l4).astype(s2.dtype) if hasattr(s2, "astype") else int(s2 < l4)
+    s3 = (s2 + carry_mid) & M32
+    k3 = (s3 < carry_mid).astype(s3.dtype) if hasattr(s3, "astype") else int(s3 < carry_mid)
+    out_lo = s3
+    out_hi = (h4 + k1 + k2 + k3) & M32
+    return out_hi, out_lo
+
+
+def mul_u32(n, w):
+    """(n_hi, n_lo) * w (u32), low 64 bits."""
+    h, lo = mul32(n[1], w)
+    hi = (h + n[0] * w) & M32
+    return hi, lo
+
+
+def div_by_recip(n, w, r_hi, r_lo):
+    """Exact n // w given r = floor(2^64/w) as (r_hi, r_lo); w >= 1.
+
+    For w == 1 the reciprocal overflows u64; callers pass r = 2^64-1 and the
+    correction step still lands on the exact quotient because the estimate
+    is n - 1 (or n) and a single increment is applied when rem >= w.
+    """
+    q_hi, q_lo = mulhi64(n, (r_hi, r_lo))
+    prod = mul_u32((q_hi, q_lo), w)
+    rem = sub(n, prod)
+    fix = ge(rem, (rem[0] * 0, w))  # rem >= (0, w)
+    inc = fix.astype(q_lo.dtype) if hasattr(fix, "astype") else int(fix)
+    lo = (q_lo + inc) & M32
+    carry = (lo < q_lo).astype(lo.dtype) if hasattr(lo, "astype") else int(lo < q_lo)
+    hi = (q_hi + carry) & M32
+    return hi, lo

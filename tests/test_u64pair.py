@@ -1,0 +1,70 @@
+"""uint32-pair 64-bit arithmetic vs Python big ints."""
+
+import numpy as np
+
+from ceph_tpu.ops import u64pair as u
+
+
+def _pairs(vals):
+    v = np.asarray(vals, dtype=np.uint64)
+    return (v >> np.uint64(32)).astype(np.uint32), (v & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+
+
+def _ints(p):
+    return (p[0].astype(np.uint64) << np.uint64(32)) | p[1].astype(np.uint64)
+
+
+RNG = np.random.default_rng(0)
+A = RNG.integers(0, 2**64, 4096, dtype=np.uint64)
+B = RNG.integers(0, 2**64, 4096, dtype=np.uint64)
+
+
+def test_add_sub():
+    a, b = _pairs(A), _pairs(B)
+    assert np.array_equal(_ints(u.add(a, b)), A + B)  # uint64 wraps
+    assert np.array_equal(_ints(u.sub(a, b)), A - B)
+
+
+def test_shr_cmp():
+    a, b = _pairs(A), _pairs(B)
+    for n in (1, 4, 16, 31):
+        assert np.array_equal(_ints(u.shr(a, n)), A >> np.uint64(n))
+    assert np.array_equal(u.lt(a, b), A < B)
+    assert np.array_equal(u.ge(a, b), A >= B)
+
+
+def test_mul32():
+    x = (A & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    y = (B & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    got = _ints(u.mul32(x, y))
+    want = x.astype(np.uint64) * y.astype(np.uint64)
+    assert np.array_equal(got, want)
+
+
+def test_mulhi64():
+    a, b = _pairs(A), _pairs(B)
+    got = _ints(u.mulhi64(a, b))
+    want = np.array([(int(x) * int(y)) >> 64 for x, y in zip(A, B)],
+                    dtype=np.uint64)
+    assert np.array_equal(got, want)
+
+
+def test_div_by_recip():
+    # n in the straw2 range [0, 2^48], w arbitrary u32 >= 1
+    n_vals = np.concatenate([
+        RNG.integers(0, 2**48 + 1, 2000, dtype=np.uint64),
+        np.array([0, 1, 2**48, 2**48 - 1, 0xFFFF], dtype=np.uint64),
+    ])
+    w_vals = np.concatenate([
+        RNG.integers(1, 2**32, 2000, dtype=np.uint64),
+        np.array([1, 1, 1, 0x10000, 0xFFFFFFFF], dtype=np.uint64),
+    ])
+    n = _pairs(n_vals)
+    w = w_vals.astype(np.uint32)
+    recips = np.array([2**64 - 1 if int(x) == 1 else (2**64) // int(x)
+                       for x in w_vals], dtype=np.uint64)
+    r = _pairs(recips)
+    got = _ints(u.div_by_recip(n, w, r[0], r[1]))
+    want = np.array([int(a) // int(b) for a, b in zip(n_vals, w_vals)],
+                    dtype=np.uint64)
+    assert np.array_equal(got, want)
