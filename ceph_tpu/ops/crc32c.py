@@ -1,0 +1,191 @@
+"""crc32c (Castagnoli) — host and batched-TPU checksumming.
+
+Behavioral mirror of reference ceph_crc32c (src/include/crc32c.h:43,
+src/common/sctp_crc32.c): a raw reflected CRC-32C table update from a caller
+seed, with NO pre/post inversion, and the null-buffer convention meaning
+"length zero bytes" (src/common/crc32c.cc:214-239 ceph_crc32c_zeros).
+
+TPU-first design: CRC is GF(2)-linear in the message bits —
+``update(seed, m) = A^len(seed) XOR L(m)`` — so a batch of fixed-size blocks
+is ONE bit-matrix matmul on the MXU, reusing the erasure-code substrate
+(ops/gf8.bitmatrix_matmul).  The combine/zero-extend operators are 32x32
+GF(2) matrix powers, the same trick the reference's crc32c.cc:54+ uses for
+crc_turbo_table.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+CRC32C_POLY_REFLECTED = 0x82F63B78
+
+
+def _build_table():
+    tbl = np.zeros(256, dtype=np.uint32)
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ (CRC32C_POLY_REFLECTED if c & 1 else 0)
+        tbl[i] = c
+    return tbl
+
+
+CRC_TABLE = _build_table()
+
+# ---------------------------------------------------------------------------
+# GF(2) 32x32 matrix algebra (matrices as 32 uint32 columns)
+# ---------------------------------------------------------------------------
+
+
+def _mat_vec(m: np.ndarray, v: int) -> int:
+    out = 0
+    vv = int(v)
+    j = 0
+    while vv:
+        if vv & 1:
+            out ^= int(m[j])
+        vv >>= 1
+        j += 1
+    return out
+
+
+def _mat_mat(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """(a . b)[j] = a . b[j]; vectorized column combine."""
+    bits = (b[:, None] >> np.arange(32)[None, :]) & 1      # (col j, bit i)
+    sel = np.where(bits.astype(bool), a[None, :, ], 0)
+    return np.bitwise_xor.reduce(sel, axis=1).astype(np.uint32)
+
+
+def _identity():
+    return (np.uint32(1) << np.arange(32)).astype(np.uint32)
+
+
+def _zero_byte_op():
+    """A_1: one zero-byte update, crc' = (crc >> 8) ^ tbl[crc & 0xff]."""
+    cols = np.zeros(32, dtype=np.uint32)
+    for j in range(32):
+        e = 1 << j
+        cols[j] = ((e >> 8) ^ int(CRC_TABLE[e & 0xFF])) & 0xFFFFFFFF
+    return cols
+
+
+_A1 = _zero_byte_op()
+
+
+@functools.lru_cache(maxsize=256)
+def _zeros_op(length: int) -> bytes:
+    """A_1^length, cached (returned as bytes for hashability)."""
+    result = _identity()
+    sq = _A1.copy()
+    n = length
+    while n:
+        if n & 1:
+            result = _mat_mat(sq, result)
+        sq = _mat_mat(sq, sq)
+        n >>= 1
+    return result.tobytes()
+
+
+def _zeros_mat(length: int) -> np.ndarray:
+    return np.frombuffer(_zeros_op(length), dtype=np.uint32)
+
+
+# ---------------------------------------------------------------------------
+# Host path
+# ---------------------------------------------------------------------------
+
+
+def crc32c(crc: int, data: Optional[bytes], length: Optional[int] = None) -> int:
+    """ceph_crc32c semantics: raw update from seed; data=None means zeros."""
+    crc &= 0xFFFFFFFF
+    if data is None:
+        if not length:
+            return crc
+        return crc32c_zeros(crc, length)
+    buf = np.frombuffer(memoryview(data), dtype=np.uint8)
+    if length is not None:
+        buf = buf[:length]
+    if len(buf) == 0:
+        return crc
+    # block-parallel: split into lanes, CRC each lane vectorized bytewise,
+    # then combine with the zero-extension operator
+    lane = 4096
+    if len(buf) <= lane:
+        c = np.uint32(crc)
+        for b in buf:
+            c = CRC_TABLE[(c ^ b) & np.uint32(0xFF)] ^ (c >> np.uint32(8))
+        return int(c)
+    n_full = len(buf) // lane
+    blocks = buf[: n_full * lane].reshape(n_full, lane)
+    cs = np.zeros(n_full, dtype=np.uint32)
+    for i in range(lane):
+        cs = CRC_TABLE[(cs ^ blocks[:, i]) & np.uint32(0xFF)] ^ (cs >> np.uint32(8))
+    # fold lanes left to right: crc = A^lane(crc) ^ lane_crc (lane seeded 0)
+    total = crc
+    for c in cs:
+        total = crc32c_zeros(total, lane) ^ int(c)
+    tail = buf[n_full * lane :]
+    if len(tail):
+        total = crc32c(total, tail.tobytes())
+    return total & 0xFFFFFFFF
+
+
+def crc32c_zeros(crc: int, length: int) -> int:
+    """CRC across `length` zero bytes (reference crc32c.cc:214)."""
+    return _mat_vec(_zeros_mat(length), crc)
+
+
+def crc32c_combine(crc_a: int, crc_b: int, len_b: int) -> int:
+    """CRC of a||b from crc(a) and crc(b) (b seeded with 0)."""
+    return crc32c_zeros(crc_a, len_b) ^ crc_b
+
+
+# ---------------------------------------------------------------------------
+# Device path: batched fixed-size blocks as one GF(2) matmul
+# ---------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=16)
+def _message_bitmat(block: int) -> np.ndarray:
+    """(32, 8*block) GF(2) matrix L with update(0, m) = L @ bits(m).
+
+    Column (p, i): contribution of bit i of byte p, i.e.
+    A_1^(block-1-p) . tbl[1 << i].
+    """
+    t_cols = np.array([CRC_TABLE[1 << i] for i in range(8)], dtype=np.uint32)
+    m = np.zeros((32, 8 * block), dtype=np.uint8)
+    p_op = _identity()
+    for p in range(block - 1, -1, -1):
+        cols = np.array([_mat_vec(p_op, int(c)) for c in t_cols], dtype=np.uint32)
+        bits = (cols[None, :] >> np.arange(32)[:, None]) & 1  # (32, 8)
+        m[:, 8 * p : 8 * p + 8] = bits.astype(np.uint8)
+        p_op = _mat_mat(_A1, p_op)
+    return m
+
+
+def crc32c_batch(data, seed: int = 0xFFFFFFFF):
+    """(N, B) uint8 blocks -> (N,) uint32 CRCs, computed on device.
+
+    Equivalent to [ceph_crc32c(seed, row) for row in data], as one MXU
+    matmul (linearity: update(seed, m) = L(m) ^ update(seed, 0^B)).
+    """
+    import jax.numpy as jnp
+
+    from ceph_tpu.ops import gf8
+
+    data = jnp.asarray(data)
+    n, block = data.shape
+    bitmat = jnp.asarray(_message_bitmat(block))
+    # bitmatrix_matmul wants (k, n) columns: one block per column
+    out_bytes = gf8.bitmatrix_matmul(bitmat, data.T)       # (4, N)
+    crcs = (
+        out_bytes[0].astype(jnp.uint32)
+        | (out_bytes[1].astype(jnp.uint32) << 8)
+        | (out_bytes[2].astype(jnp.uint32) << 16)
+        | (out_bytes[3].astype(jnp.uint32) << 24)
+    )
+    const = np.uint32(crc32c_zeros(seed, block))
+    return crcs ^ const

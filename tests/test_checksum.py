@@ -1,0 +1,45 @@
+"""Checksummer + xxhash tests."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ops.checksum import Checksummer, xxhash32, xxhash64
+
+
+def test_xxh32_known_vectors():
+    # published XXH32 vectors
+    assert xxhash32(b"") == 0x02CC5D05
+    assert xxhash32(b"", seed=1) == 0x0B2CB792
+    assert xxhash32(b"a") == 0x550D7456
+    assert xxhash32(b"abc") == 0x32D153FF
+    assert xxhash32(b"Hello, world!") == 0x31B7405D
+
+
+def test_xxh64_known_vectors():
+    assert xxhash64(b"") == 0xEF46DB3751D8E999
+    assert xxhash64(b"a") == 0xD24EC4F1A98C6E5B
+    assert xxhash64(b"abc") == 0x44BC2CF5AD770999
+
+
+def test_checksummer_roundtrip():
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, 4096 * 4, dtype=np.uint8).tobytes()
+    for algo in ("crc32c", "crc32c_16", "crc32c_8", "xxhash32", "xxhash64"):
+        cs = Checksummer(algo)
+        vec = cs.calculate(4096, data)
+        assert len(vec) == 4 * cs.VALUE_SIZE[algo]
+        assert cs.verify(4096, data, vec) is None
+        # corrupt second block
+        bad = bytearray(data)
+        bad[5000] ^= 0xFF
+        assert cs.verify(4096, bytes(bad), vec) == 4096
+
+
+def test_crc32c_batch_path_matches_scalar():
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, 512 * 16, dtype=np.uint8).tobytes()
+    cs = Checksummer("crc32c")
+    batched = cs.calculate(512, data)          # 16 blocks -> batch path
+    scalar = b"".join(
+        cs.calculate(512, data[i * 512 : (i + 1) * 512]) for i in range(16))
+    assert batched == scalar
