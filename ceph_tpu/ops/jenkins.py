@@ -24,6 +24,11 @@ CRUSH_HASH_RJENKINS1 = 0
 
 def _mix(a, b, c):
     """One crush_hashmix round; args and results are uint32 arrays."""
+    with np.errstate(over="ignore"):
+        return _mix_body(a, b, c)
+
+
+def _mix_body(a, b, c):
     a = (a - b) & M32
     a = (a - c) & M32
     a = a ^ (c >> 13)

@@ -1,0 +1,3 @@
+"""Cluster map: pools, PG -> OSD placement pipeline, epochs."""
+
+from ceph_tpu.osdmap.osdmap import OSDMap, PGPool, PGid  # noqa: F401

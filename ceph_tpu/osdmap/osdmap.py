@@ -1,0 +1,347 @@
+"""OSDMap: the versioned cluster map and its placement pipeline.
+
+Behavioral mirror of reference src/osd/OSDMap.{h,cc} and pg_pool_t
+(src/osd/osd_types.cc:1395-1423): pg -> pps seeding (stable_mod +
+rjenkins1), CRUSH raw placement (_pg_to_raw_osds, OSDMap.cc:1861),
+pg_upmap/pg_upmap_items overrides (:1891-1934), up-set filtering (:1937),
+primary affinity (:1962+), pg_temp/primary_temp (:2010), and the full
+_pg_to_up_acting_osds chain (:2079).
+
+Two execution paths share the same semantics:
+- per-PG scalar (ScalarMapper) — the oracle and control-plane path;
+- whole-pool batched (TensorMapper) — every PG of a pool in one TPU
+  dispatch, with the sparse host-side post-passes vectorized in numpy.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ceph_tpu.crush import CrushMap, ScalarMapper
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+from ceph_tpu.ops import jenkins
+
+CEPH_OSD_MAX_PRIMARY_AFFINITY = 0x10000
+CEPH_OSD_DEFAULT_PRIMARY_AFFINITY = 0x10000
+
+POOL_TYPE_REPLICATED = 1
+POOL_TYPE_ERASURE = 3
+
+
+def ceph_stable_mod(x: int, b: int, bmask: int) -> int:
+    """reference src/include/ceph_hash.h ceph_stable_mod."""
+    if (x & bmask) < b:
+        return x & bmask
+    return x & (bmask >> 1)
+
+
+def _calc_mask(n: int) -> int:
+    return (1 << max(n - 1, 1).bit_length()) - 1
+
+
+@dataclass(frozen=True, order=True)
+class PGid:
+    pool: int
+    seed: int
+
+    def __str__(self):
+        return f"{self.pool}.{self.seed:x}"
+
+
+@dataclass
+class PGPool:
+    """pg_pool_t subset (reference src/osd/osd_types.h)."""
+
+    pool_id: int
+    type: int = POOL_TYPE_REPLICATED
+    size: int = 3
+    min_size: int = 2
+    pg_num: int = 32
+    pgp_num: int = 32
+    crush_rule: int = 0
+    hashpspool: bool = True
+    ec_profile: Dict[str, str] = field(default_factory=dict)
+    name: str = ""
+
+    @property
+    def pg_num_mask(self) -> int:
+        return _calc_mask(self.pg_num)
+
+    @property
+    def pgp_num_mask(self) -> int:
+        return _calc_mask(self.pgp_num)
+
+    def can_shift_osds(self) -> bool:
+        return self.type == POOL_TYPE_REPLICATED
+
+    def is_erasure(self) -> bool:
+        return self.type == POOL_TYPE_ERASURE
+
+    def raw_pg_to_pg(self, seed: int) -> int:
+        return ceph_stable_mod(seed, self.pg_num, self.pg_num_mask)
+
+    def raw_pg_to_pps(self, seed: int) -> int:
+        if self.hashpspool:
+            return int(jenkins.hash2(
+                ceph_stable_mod(seed, self.pgp_num, self.pgp_num_mask),
+                self.pool_id))
+        return ceph_stable_mod(seed, self.pgp_num, self.pgp_num_mask) \
+            + self.pool_id
+
+    def raw_pg_to_pps_batch(self, seeds: np.ndarray) -> np.ndarray:
+        mask = np.uint32(self.pgp_num_mask)
+        half = mask >> np.uint32(1)
+        m = seeds.astype(np.uint32) & mask
+        stable = np.where(m < self.pgp_num, m, seeds.astype(np.uint32) & half)
+        if self.hashpspool:
+            return jenkins.hash2(
+                stable.astype(np.uint64),
+                np.uint64(self.pool_id)).astype(np.uint32)
+        return stable + np.uint32(self.pool_id)
+
+
+class OSDMap:
+    def __init__(self, crush: CrushMap, max_osd: int = 0):
+        self.epoch = 1
+        self.crush = crush
+        self.max_osd = max_osd or crush.max_devices
+        self.osd_exists = [True] * self.max_osd
+        self.osd_up = [True] * self.max_osd
+        self.osd_weight = [0x10000] * self.max_osd  # in/out weight
+        self.osd_primary_affinity: Optional[List[int]] = None
+        self.pools: Dict[int, PGPool] = {}
+        self.pg_upmap: Dict[PGid, List[int]] = {}
+        self.pg_upmap_items: Dict[PGid, List[Tuple[int, int]]] = {}
+        self.pg_temp: Dict[PGid, List[int]] = {}
+        self.primary_temp: Dict[PGid, int] = {}
+        self._scalar = ScalarMapper(crush)
+        self._tensor = None
+        self.osd_addrs: Dict[int, object] = {}
+
+    # -- state helpers -----------------------------------------------------
+
+    def exists(self, osd: int) -> bool:
+        return 0 <= osd < self.max_osd and self.osd_exists[osd]
+
+    def is_up(self, osd: int) -> bool:
+        return self.exists(osd) and self.osd_up[osd]
+
+    def is_down(self, osd: int) -> bool:
+        return not self.is_up(osd)
+
+    def is_out(self, osd: int) -> bool:
+        return not self.exists(osd) or self.osd_weight[osd] == 0
+
+    def mark_down(self, osd: int) -> None:
+        self.osd_up[osd] = False
+        self.epoch += 1
+
+    def mark_up(self, osd: int) -> None:
+        self.osd_up[osd] = True
+        self.epoch += 1
+
+    def mark_out(self, osd: int) -> None:
+        self.osd_weight[osd] = 0
+        self.epoch += 1
+
+    def mark_in(self, osd: int, weight: int = 0x10000) -> None:
+        self.osd_weight[osd] = weight
+        self.epoch += 1
+
+    def set_primary_affinity(self, osd: int, aff: int) -> None:
+        if self.osd_primary_affinity is None:
+            self.osd_primary_affinity = \
+                [CEPH_OSD_DEFAULT_PRIMARY_AFFINITY] * self.max_osd
+        self.osd_primary_affinity[osd] = aff
+        self.epoch += 1
+
+    def add_pool(self, pool: PGPool) -> None:
+        self.pools[pool.pool_id] = pool
+        self.epoch += 1
+
+    @property
+    def tensor_mapper(self):
+        if self._tensor is None:
+            from ceph_tpu.crush.mapper import TensorMapper
+
+            self._tensor = TensorMapper(self.crush)
+        return self._tensor
+
+    # -- placement pipeline (scalar) ---------------------------------------
+
+    def _pg_to_raw_osds(self, pool: PGPool, pgid: PGid) -> Tuple[List[int], int]:
+        pps = pool.raw_pg_to_pps(pgid.seed)
+        raw = self._scalar.do_rule(pool.crush_rule, pps, pool.size,
+                                   self.osd_weight)
+        raw = self._remove_nonexistent(pool, raw)
+        return raw, pps
+
+    def _remove_nonexistent(self, pool: PGPool, raw: List[int]) -> List[int]:
+        if pool.can_shift_osds():
+            return [o for o in raw if o == CRUSH_ITEM_NONE or self.exists(o)]
+        return [o if o == CRUSH_ITEM_NONE or self.exists(o) else
+                CRUSH_ITEM_NONE for o in raw]
+
+    def _apply_upmap(self, pool: PGPool, pgid: PGid, raw: List[int]) -> List[int]:
+        pg = PGid(pgid.pool, pool.raw_pg_to_pg(pgid.seed))
+        um = self.pg_upmap.get(pg)
+        if um is not None:
+            if not any(o != CRUSH_ITEM_NONE and o < self.max_osd
+                       and self.osd_weight[o] == 0 for o in um):
+                raw = list(um)
+        for src, dst in self.pg_upmap_items.get(pg, []):
+            exists_already = False
+            pos = -1
+            for i, o in enumerate(raw):
+                if o == dst:
+                    exists_already = True
+                    break
+                if o == src and pos < 0 and not (
+                        dst != CRUSH_ITEM_NONE and dst < self.max_osd
+                        and self.osd_weight[dst] == 0):
+                    pos = i
+            if not exists_already and pos >= 0:
+                raw[pos] = dst
+        return raw
+
+    def _raw_to_up(self, pool: PGPool, raw: List[int]) -> List[int]:
+        if pool.can_shift_osds():
+            return [o for o in raw
+                    if o != CRUSH_ITEM_NONE and not self.is_down(o)]
+        return [CRUSH_ITEM_NONE if o == CRUSH_ITEM_NONE or self.is_down(o)
+                else o for o in raw]
+
+    @staticmethod
+    def _pick_primary(osds: List[int]) -> int:
+        for o in osds:
+            if o != CRUSH_ITEM_NONE:
+                return o
+        return -1
+
+    def _apply_primary_affinity(self, pps: int, pool: PGPool,
+                                osds: List[int], primary: int) -> Tuple[List[int], int]:
+        aff = self.osd_primary_affinity
+        if aff is None:
+            return osds, primary
+        if not any(o != CRUSH_ITEM_NONE
+                   and aff[o] != CEPH_OSD_DEFAULT_PRIMARY_AFFINITY
+                   for o in osds):
+            return osds, primary
+        pos = -1
+        for i, o in enumerate(osds):
+            if o == CRUSH_ITEM_NONE:
+                continue
+            a = aff[o]
+            if a < CEPH_OSD_MAX_PRIMARY_AFFINITY and \
+                    (int(jenkins.hash2(pps, o)) >> 16) >= a:
+                if pos < 0:
+                    pos = i
+            else:
+                pos = i
+                break
+        if pos < 0:
+            return osds, primary
+        primary = osds[pos]
+        if pool.can_shift_osds() and pos > 0:
+            osds = [osds[pos]] + osds[:pos] + osds[pos + 1 :]
+        return osds, primary
+
+    def _get_temp_osds(self, pool: PGPool, pgid: PGid) -> Tuple[List[int], int]:
+        pg = PGid(pgid.pool, pool.raw_pg_to_pg(pgid.seed))
+        temp = []
+        for o in self.pg_temp.get(pg, []):
+            if not self.exists(o) or self.is_down(o):
+                if pool.can_shift_osds():
+                    continue
+                temp.append(CRUSH_ITEM_NONE)
+            else:
+                temp.append(o)
+        tp = self.primary_temp.get(pg, -1)
+        if tp == -1 and temp:
+            tp = self._pick_primary(temp)
+        return temp, tp
+
+    def pg_to_up_acting_osds(self, pgid: PGid):
+        """Returns (up, up_primary, acting, acting_primary) — reference
+        _pg_to_up_acting_osds (OSDMap.cc:2079)."""
+        pool = self.pools.get(pgid.pool)
+        if pool is None or pgid.seed >= pool.pg_num:
+            return [], -1, [], -1
+        acting, acting_primary = self._get_temp_osds(pool, pgid)
+        raw, pps = self._pg_to_raw_osds(pool, pgid)
+        raw = self._apply_upmap(pool, pgid, raw)
+        up = self._raw_to_up(pool, raw)
+        up_primary = self._pick_primary(up)
+        up, up_primary = self._apply_primary_affinity(pps, pool, up, up_primary)
+        if not acting:
+            acting, acting_primary = up, up_primary
+        elif acting_primary == -1:
+            acting_primary = self._pick_primary(acting)
+        return up, up_primary, acting, acting_primary
+
+    # -- whole-pool batched placement --------------------------------------
+
+    def pool_mapping(self, pool_id: int):
+        """Map every PG of a pool in one batched TPU dispatch.
+
+        Returns (up (pg_num, size) int32 with CRUSH_ITEM_NONE holes/padding,
+        up_primary (pg_num,) int32).  Sparse overrides (upmap, temp,
+        affinity) are applied as host post-passes; semantics match the
+        scalar pipeline exactly (cross-checked in tests).
+        """
+        pool = self.pools[pool_id]
+        seeds = np.arange(pool.pg_num, dtype=np.uint32)
+        pps = pool.raw_pg_to_pps_batch(seeds)
+        weights = np.zeros(self.crush.max_devices, dtype=np.uint32)
+        weights[: self.max_osd] = self.osd_weight
+        res, rlen = self.tensor_mapper.do_rule_batch(
+            pool.crush_rule, pps, pool.size, weights)
+        res = np.asarray(res)
+        rlen = np.asarray(rlen)
+        up = np.full((pool.pg_num, pool.size), CRUSH_ITEM_NONE, dtype=np.int64)
+        upp = np.full(pool.pg_num, -1, dtype=np.int64)
+        # post-passes per PG on the host (vectorize later if they show up
+        # in profiles; the dict overrides are sparse by design)
+        exists = np.zeros(self.max_osd + 1, dtype=bool)
+        exists[: self.max_osd] = self.osd_exists
+        for s in range(pool.pg_num):
+            raw = [int(v) for v in res[s, : rlen[s]]]
+            raw = self._remove_nonexistent(pool, raw)
+            pgid = PGid(pool_id, int(s))
+            raw = self._apply_upmap(pool, pgid, raw)
+            u = self._raw_to_up(pool, raw)
+            p = self._pick_primary(u)
+            u, p = self._apply_primary_affinity(int(pps[s]), pool, u, p)
+            up[s, : len(u)] = u
+            upp[s] = p
+        return up, upp
+
+    def rebalance_diff(self, pool_id: int, other: "OSDMap"):
+        """Changed-PG set between two maps (the BASELINE rebalance metric)."""
+        a, ap = self.pool_mapping(pool_id)
+        b, bp = other.pool_mapping(pool_id)
+        moved = np.nonzero((a != b).any(axis=1))[0]
+        return moved, len(moved) / max(a.shape[0], 1)
+
+
+def build_simple_osdmap(n_osds: int = 16, osds_per_host: int = 4,
+                        pg_num: int = 64, pool_type: int = POOL_TYPE_REPLICATED,
+                        size: int = 3, ec_profile: Optional[Dict] = None):
+    """Dev helper: hierarchy + one pool (the vstart analog)."""
+    from ceph_tpu.crush.types import build_hierarchy
+
+    cmap, ruleno = build_hierarchy(
+        n_hosts=max(1, n_osds // osds_per_host),
+        osds_per_host=osds_per_host,
+        numrep=size,
+        firstn=pool_type == POOL_TYPE_REPLICATED,
+    )
+    m = OSDMap(cmap)
+    m.add_pool(PGPool(pool_id=1, type=pool_type, size=size,
+                      min_size=max(1, size - 1), pg_num=pg_num,
+                      pgp_num=pg_num, crush_rule=ruleno,
+                      ec_profile=ec_profile or {}, name="rbd"))
+    return m

@@ -1,0 +1,115 @@
+"""OSDMap placement pipeline: scalar vs batched, overrides, rebalance."""
+
+import copy
+
+import numpy as np
+import pytest
+
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+from ceph_tpu.osdmap import OSDMap, PGPool, PGid
+from ceph_tpu.osdmap.osdmap import (
+    POOL_TYPE_ERASURE,
+    POOL_TYPE_REPLICATED,
+    build_simple_osdmap,
+    ceph_stable_mod,
+)
+
+
+def test_stable_mod():
+    # reference ceph_stable_mod semantics
+    assert ceph_stable_mod(9, 8, 15) == 1
+    assert ceph_stable_mod(13, 12, 15) == 5
+    for x in range(64):
+        v = ceph_stable_mod(x, 12, 15)
+        assert 0 <= v < 12
+
+
+@pytest.mark.parametrize("ptype", [POOL_TYPE_REPLICATED, POOL_TYPE_ERASURE],
+                         ids=["replicated", "erasure"])
+def test_batched_matches_scalar(ptype):
+    m = build_simple_osdmap(n_osds=24, osds_per_host=4, pg_num=64,
+                            pool_type=ptype, size=3)
+    m.mark_down(5)
+    m.mark_out(9)
+    m.set_primary_affinity(2, 0x8000)
+    pg = PGid(1, 3)
+    m.pg_upmap_items[pg] = [(m.pg_to_up_acting_osds(pg)[0][0], 11)]
+    up, upp = m.pool_mapping(1)
+    for s in range(64):
+        want_up, want_p, _, _ = m.pg_to_up_acting_osds(PGid(1, s))
+        got = [int(v) for v in up[s] if v != CRUSH_ITEM_NONE] \
+            if ptype == POOL_TYPE_REPLICATED else [int(v) for v in up[s]]
+        if ptype == POOL_TYPE_REPLICATED:
+            assert got == want_up, s
+        else:
+            assert got[: len(want_up)] == want_up, s
+        assert int(upp[s]) == want_p, s
+
+
+def test_down_osd_leaves_up_set():
+    m = build_simple_osdmap(n_osds=16, pg_num=32)
+    pg = PGid(1, 0)
+    up0, p0, _, _ = m.pg_to_up_acting_osds(pg)
+    assert len(up0) == 3 and p0 == up0[0]
+    m.mark_down(up0[0])
+    up1, p1, _, _ = m.pg_to_up_acting_osds(pg)
+    assert up0[0] not in up1
+    assert p1 != up0[0]
+
+
+def test_erasure_keeps_positions():
+    m = build_simple_osdmap(n_osds=16, pg_num=32, pool_type=POOL_TYPE_ERASURE,
+                            size=4)
+    pg = PGid(1, 7)
+    up0, _, _, _ = m.pg_to_up_acting_osds(pg)
+    assert len(up0) == 4
+    m.mark_down(up0[1])
+    up1, _, _, _ = m.pg_to_up_acting_osds(pg)
+    # indep placement is positionally stable: slot 1 becomes NONE
+    assert up1[1] == CRUSH_ITEM_NONE
+    assert up1[0] == up0[0] and up1[2] == up0[2] and up1[3] == up0[3]
+
+
+def test_pg_temp():
+    m = build_simple_osdmap(n_osds=16, pg_num=32)
+    pg = PGid(1, 4)
+    up, upp, acting, actp = m.pg_to_up_acting_osds(pg)
+    assert acting == up
+    others = [o for o in range(12) if o not in up][:3]
+    m.pg_temp[pg] = others
+    up2, _, acting2, actp2 = m.pg_to_up_acting_osds(pg)
+    assert up2 == up  # up unchanged
+    assert acting2 == others
+    assert actp2 == others[0]
+
+
+def test_upmap_full_override():
+    m = build_simple_osdmap(n_osds=16, pg_num=32)
+    pg = PGid(1, 9)
+    target = [1, 5, 9]
+    m.pg_upmap[pg] = target
+    up, p, _, _ = m.pg_to_up_acting_osds(pg)
+    assert up == target
+    # upmap to an out osd is ignored
+    m.mark_out(5)
+    up2, _, _, _ = m.pg_to_up_acting_osds(pg)
+    assert up2 != target
+
+
+def test_rebalance_diff():
+    m = build_simple_osdmap(n_osds=32, osds_per_host=4, pg_num=128)
+    m2 = copy.deepcopy(m)
+    m2.mark_out(3)
+    m2._tensor = None  # rebuild mapper after weight change
+    moved, frac = m.rebalance_diff(1, m2)
+    assert 0 < len(moved) < 128
+    # only PGs that mapped to osd 3 (or cascade) should move; most stay
+    assert frac < 0.5
+
+
+def test_pps_batch_matches_scalar():
+    pool = PGPool(pool_id=7, pg_num=64, pgp_num=48)
+    seeds = np.arange(64, dtype=np.uint32)
+    batch = pool.raw_pg_to_pps_batch(seeds)
+    for s in range(64):
+        assert int(batch[s]) == pool.raw_pg_to_pps(s)
