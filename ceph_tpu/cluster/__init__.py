@@ -1,0 +1,9 @@
+"""Mini-RADOS: messenger, monitor, OSD daemons, object store, client.
+
+The cluster control plane around the TPU compute core, mirroring the
+reference's daemon capability surface (SURVEY §2.3): an async messenger
+(src/msg analog), a map-authority monitor (src/mon), OSD daemons with
+replicated and erasure-coded PG backends whose encode/decode and placement
+run through the TPU engine (src/osd), an in-memory ObjectStore (src/os
+MemStore), and a client op engine (src/osdc Objecter + librados surface).
+"""

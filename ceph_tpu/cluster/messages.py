@@ -1,0 +1,156 @@
+"""Wire messages (reference src/messages/ analog)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ceph_tpu.cluster.messenger import Addr, Message
+from ceph_tpu.osdmap.osdmap import PGid
+
+
+# -- mon <-> daemons --------------------------------------------------------
+
+
+@dataclass
+class MPing(Message):
+    stamp: float = 0.0
+    reply: bool = False
+
+
+@dataclass
+class MOSDBoot(Message):
+    osd_id: int = -1
+    addr: Optional[Addr] = None
+
+
+@dataclass
+class MOSDFailure(Message):
+    failed_osd: int = -1
+    reporter: int = -1
+
+
+@dataclass
+class MOSDAlive(Message):
+    osd_id: int = -1
+
+
+@dataclass
+class MMonSubscribe(Message):
+    what: str = "osdmap"
+    addr: Optional[Addr] = None
+
+
+@dataclass
+class MOSDMapMsg(Message):
+    epoch: int = 0
+    osdmap_blob: bytes = b""
+
+
+@dataclass
+class MMonCommand(Message):
+    cmd: Dict[str, Any] = field(default_factory=dict)
+    tid: int = 0
+
+
+@dataclass
+class MMonCommandReply(Message):
+    tid: int = 0
+    result: int = 0
+    data: Any = None
+
+
+# -- client <-> osd ---------------------------------------------------------
+
+
+@dataclass
+class MOSDOp(Message):
+    """Client op (reference MOSDOp): ops are (opname, kwargs) pairs."""
+
+    reqid: Tuple[str, int] = ("", 0)
+    pgid: Optional[PGid] = None
+    oid: str = ""
+    ops: List[Tuple[str, Dict[str, Any]]] = field(default_factory=list)
+    epoch: int = 0
+
+
+@dataclass
+class MOSDOpReply(Message):
+    reqid: Tuple[str, int] = ("", 0)
+    result: int = 0
+    data: Any = None
+    epoch: int = 0
+
+
+# -- osd <-> osd (replication / EC / recovery) ------------------------------
+
+
+@dataclass
+class MOSDRepOp(Message):
+    reqid: Tuple[str, int] = ("", 0)
+    pgid: Optional[PGid] = None
+    txn_blob: bytes = b""
+    epoch: int = 0
+
+
+@dataclass
+class MOSDRepOpReply(Message):
+    reqid: Tuple[str, int] = ("", 0)
+    result: int = 0
+
+
+@dataclass
+class MOSDECSubOpWrite(Message):
+    """Shard write (reference MOSDECSubOpWrite, ECBackend.cc:921)."""
+
+    reqid: Tuple[str, int] = ("", 0)
+    pgid: Optional[PGid] = None
+    oid: str = ""
+    shard: int = -1
+    data: bytes = b""
+    hinfo: Dict[str, Any] = field(default_factory=dict)
+    epoch: int = 0
+
+
+@dataclass
+class MOSDECSubOpWriteReply(Message):
+    reqid: Tuple[str, int] = ("", 0)
+    result: int = 0
+
+
+@dataclass
+class MOSDECSubOpRead(Message):
+    """Shard read (reference handle_sub_read, ECBackend.cc:986)."""
+
+    reqid: Tuple[str, int] = ("", 0)
+    pgid: Optional[PGid] = None
+    oid: str = ""
+    shard: int = -1
+
+
+@dataclass
+class MOSDECSubOpReadReply(Message):
+    reqid: Tuple[str, int] = ("", 0)
+    result: int = 0
+    shard: int = -1
+    data: bytes = b""
+    hinfo: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class MOSDPGPush(Message):
+    """Recovery push (reference push/pull recovery, ReplicatedBackend)."""
+
+    pgid: Optional[PGid] = None
+    oid: str = ""
+    shard: int = -1  # -1 for replicated full object
+    data: bytes = b""
+    version: int = 0
+    xattrs: Dict[str, bytes] = field(default_factory=dict)
+
+
+@dataclass
+class MOSDPGPushReply(Message):
+    pgid: Optional[PGid] = None
+    oid: str = ""
+    result: int = 0

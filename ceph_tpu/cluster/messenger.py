@@ -1,0 +1,154 @@
+"""Async messenger: Connection / Dispatcher / Policy over asyncio TCP.
+
+Structural mirror of the reference messenger abstraction (src/msg/
+Messenger.h, Dispatcher.h; AsyncMessenger event loops): entity-named
+endpoints, per-peer Connections with ordered delivery and reconnect,
+dispatchers receiving typed messages.  Transport is asyncio TCP on
+loopback (the reference's tier-3 standalone tests run the same way:
+N daemons x 1 host over real sockets).  Frames are length-prefixed
+pickles — an internal trust boundary, like the reference's cephx-signed
+native encoding is within a cluster.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+import struct
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+Addr = Tuple[str, int]
+
+
+@dataclass(frozen=True)
+class EntityName:
+    type: str  # mon | osd | client | mgr
+    num: int
+
+    def __str__(self):
+        return f"{self.type}.{self.num}"
+
+
+@dataclass
+class Message:
+    """Base message; src is stamped by the sending messenger."""
+
+    src: Optional[EntityName] = field(default=None, init=False)
+    seq: int = field(default=0, init=False)
+
+
+class Connection:
+    def __init__(self, messenger: "Messenger", reader, writer,
+                 peer: Optional[EntityName] = None,
+                 peer_addr: Optional[Addr] = None):
+        self.messenger = messenger
+        self.reader = reader
+        self.writer = writer
+        self.peer = peer
+        self.peer_addr = peer_addr
+        self._send_lock = asyncio.Lock()
+        self._seq = 0
+        self.closed = False
+
+    async def send(self, msg: Message) -> None:
+        msg.src = self.messenger.name
+        async with self._send_lock:
+            self._seq += 1
+            msg.seq = self._seq
+            payload = pickle.dumps(msg)
+            try:
+                self.writer.write(struct.pack("<I", len(payload)) + payload)
+                await self.writer.drain()
+            except (ConnectionError, RuntimeError):
+                self.closed = True
+                raise
+
+    async def close(self) -> None:
+        self.closed = True
+        try:
+            self.writer.close()
+            await self.writer.wait_closed()
+        except Exception:
+            pass
+
+
+class Dispatcher:
+    async def ms_dispatch(self, conn: Connection, msg: Message) -> bool:
+        """Return True if handled."""
+        return False
+
+    async def ms_handle_reset(self, conn: Connection) -> None:
+        ...
+
+
+class Messenger:
+    def __init__(self, name: EntityName):
+        self.name = name
+        self.dispatchers: List[Dispatcher] = []
+        self._server: Optional[asyncio.base_events.Server] = None
+        self._out: Dict[Addr, Connection] = {}
+        self._accepted: List[Connection] = []
+        self._tasks: List[asyncio.Task] = []
+        self.my_addr: Optional[Addr] = None
+
+    def add_dispatcher(self, d: Dispatcher) -> None:
+        self.dispatchers.append(d)
+
+    async def bind(self, host: str = "127.0.0.1", port: int = 0) -> Addr:
+        self._server = await asyncio.start_server(self._accept, host, port)
+        self.my_addr = self._server.sockets[0].getsockname()[:2]
+        return self.my_addr
+
+    async def _accept(self, reader, writer) -> None:
+        conn = Connection(self, reader, writer)
+        self._accepted.append(conn)
+        self._tasks.append(asyncio.current_task() or
+                           asyncio.create_task(asyncio.sleep(0)))
+        await self._read_loop(conn)
+
+    async def _read_loop(self, conn: Connection) -> None:
+        try:
+            while True:
+                hdr = await conn.reader.readexactly(4)
+                (n,) = struct.unpack("<I", hdr)
+                payload = await conn.reader.readexactly(n)
+                msg = pickle.loads(payload)
+                if conn.peer is None:
+                    conn.peer = msg.src
+                for d in self.dispatchers:
+                    if await d.ms_dispatch(conn, msg):
+                        break
+        except (asyncio.IncompleteReadError, ConnectionError,
+                asyncio.CancelledError):
+            conn.closed = True
+            for d in self.dispatchers:
+                try:
+                    await d.ms_handle_reset(conn)
+                except Exception:
+                    pass
+
+    async def connect(self, addr: Addr) -> Connection:
+        conn = self._out.get(tuple(addr))
+        if conn is not None and not conn.closed:
+            return conn
+        reader, writer = await asyncio.open_connection(addr[0], addr[1])
+        conn = Connection(self, reader, writer, peer_addr=tuple(addr))
+        self._out[tuple(addr)] = conn
+        task = asyncio.get_event_loop().create_task(self._read_loop(conn))
+        self._tasks.append(task)
+        return conn
+
+    async def send_message(self, msg: Message, addr: Addr) -> None:
+        conn = await self.connect(addr)
+        await conn.send(msg)
+
+    async def shutdown(self) -> None:
+        for conn in list(self._out.values()) + self._accepted:
+            await conn.close()
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+        for t in self._tasks:
+            if not t.done():
+                t.cancel()

@@ -1,0 +1,227 @@
+"""Monitor: the cluster-map authority.
+
+Mirrors the reference monitor's OSD-map service (src/mon/OSDMonitor.cc):
+boot/failure handling with reporter thresholds (can_mark_down,
+OSDMonitor.cc:1761), down-out ticks, map-epoch broadcast to subscribers
+(MonClient subscription model, src/mon/MonClient.cc:354), and pool-create
+commands that build CRUSH rules through the EC-profile seam
+(ErasureCode::create_rule analog).  Map mutations go through a
+single-authority proposal log (the Paxos seam — multi-mon quorum is the
+next stage; the propose/commit structure is kept so Paxos slots in).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from ceph_tpu.cluster import messages as M
+from ceph_tpu.cluster.messenger import Addr, Connection, Dispatcher, EntityName, Messenger
+from ceph_tpu.crush.types import (
+    RULE_CHOOSELEAF_FIRSTN,
+    RULE_CHOOSELEAF_INDEP,
+    RULE_EMIT,
+    RULE_TAKE,
+    Rule,
+)
+from ceph_tpu.osdmap.osdmap import (
+    OSDMap,
+    PGPool,
+    POOL_TYPE_ERASURE,
+    POOL_TYPE_REPLICATED,
+)
+from ceph_tpu.utils import Config, PerfCounters
+
+
+class Monitor(Dispatcher):
+    def __init__(self, osdmap: OSDMap, config: Optional[Config] = None,
+                 rank: int = 0):
+        self.rank = rank
+        self.config = config or Config()
+        self.osdmap = osdmap
+        self.messenger = Messenger(EntityName("mon", rank))
+        self.messenger.add_dispatcher(self)
+        self.subscribers: Set[Addr] = set()
+        self.failure_reports: Dict[int, Set[int]] = {}
+        self.down_since: Dict[int, float] = {}
+        self.perf = PerfCounters("mon")
+        self._tick_task: Optional[asyncio.Task] = None
+        self._log: List[Tuple[str, object]] = []  # proposal log (Paxos seam)
+        self._next_pool_id = max(self.osdmap.pools, default=0) + 1
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> Addr:
+        addr = await self.messenger.bind(host, port)
+        self._tick_task = asyncio.get_event_loop().create_task(self._tick())
+        return addr
+
+    async def stop(self) -> None:
+        if self._tick_task:
+            self._tick_task.cancel()
+        await self.messenger.shutdown()
+
+    # -- proposal log (single-authority; Paxos slots in here) --------------
+
+    def _propose(self, what: str, payload) -> None:
+        self._log.append((what, payload))
+        self.perf.inc("mon_proposals")
+
+    async def _commit_map_change(self) -> None:
+        await self._broadcast_map()
+
+    # -- dispatch ----------------------------------------------------------
+
+    async def ms_dispatch(self, conn: Connection, msg) -> bool:
+        if isinstance(msg, M.MOSDBoot):
+            await self._handle_boot(msg)
+            return True
+        if isinstance(msg, M.MOSDFailure):
+            await self._handle_failure(msg)
+            return True
+        if isinstance(msg, M.MMonSubscribe):
+            self.subscribers.add(tuple(msg.addr))
+            await self._send_map(tuple(msg.addr))
+            return True
+        if isinstance(msg, M.MMonCommand):
+            await self._handle_command(conn, msg)
+            return True
+        return False
+
+    async def _handle_boot(self, msg: M.MOSDBoot) -> None:
+        self._propose("boot", (msg.osd_id, msg.addr))
+        m = self.osdmap
+        if msg.osd_id >= m.max_osd:
+            return
+        m.osd_addrs[msg.osd_id] = tuple(msg.addr)
+        if not m.osd_up[msg.osd_id]:
+            m.mark_up(msg.osd_id)
+        else:
+            m.epoch += 1
+        self.down_since.pop(msg.osd_id, None)
+        self.failure_reports.pop(msg.osd_id, None)
+        self.perf.inc("mon_osd_boot")
+        await self._commit_map_change()
+
+    async def _handle_failure(self, msg: M.MOSDFailure) -> None:
+        m = self.osdmap
+        osd = msg.failed_osd
+        if osd < 0 or osd >= m.max_osd or not m.osd_up[osd]:
+            return
+        reporters = self.failure_reports.setdefault(osd, set())
+        reporters.add(msg.reporter)
+        # can_mark_down analog: enough distinct reporters
+        if len(reporters) >= self.config.mon_osd_min_down_reporters:
+            self._propose("down", osd)
+            m.mark_down(osd)
+            self.down_since[osd] = time.monotonic()
+            self.failure_reports.pop(osd, None)
+            self.perf.inc("mon_osd_marked_down")
+            await self._commit_map_change()
+
+    async def _handle_command(self, conn: Connection, msg: M.MMonCommand) -> None:
+        cmd = msg.cmd
+        result, data = 0, None
+        try:
+            prefix = cmd.get("prefix")
+            if prefix == "osd pool create":
+                data = self._create_pool(cmd)
+                await self._commit_map_change()
+            elif prefix == "osd out":
+                self.osdmap.mark_out(int(cmd["id"]))
+                await self._commit_map_change()
+            elif prefix == "osd in":
+                self.osdmap.mark_in(int(cmd["id"]))
+                await self._commit_map_change()
+            elif prefix == "status":
+                m = self.osdmap
+                data = {
+                    "epoch": m.epoch,
+                    "num_osds": m.max_osd,
+                    "num_up": sum(m.osd_up),
+                    "num_in": sum(1 for w in m.osd_weight if w > 0),
+                    "pools": {p.name or pid: {"id": pid, "size": p.size,
+                                              "pg_num": p.pg_num,
+                                              "type": p.type}
+                              for pid, p in m.pools.items()},
+                }
+            elif prefix == "perf dump":
+                data = self.perf.dump()
+            else:
+                result = -22  # EINVAL
+        except Exception as e:  # surface errors to the caller
+            result, data = -22, repr(e)
+        reply = M.MMonCommandReply(tid=msg.tid, result=result, data=data)
+        await conn.send(reply)
+
+    def _create_pool(self, cmd: Dict) -> int:
+        name = cmd["pool"]
+        pool_type = POOL_TYPE_ERASURE if cmd.get("pool_type") == "erasure" \
+            else POOL_TYPE_REPLICATED
+        m = self.osdmap
+        root = None
+        for bid, b in m.crush.buckets.items():
+            if b.type == max(bb.type for bb in m.crush.buckets.values()):
+                root = bid
+                break
+        ec_profile = dict(cmd.get("ec_profile") or {})
+        if pool_type == POOL_TYPE_ERASURE:
+            from ceph_tpu.ec import factory
+
+            codec = factory(ec_profile or {"plugin": "jerasure",
+                                           "technique": "reed_sol_van",
+                                           "k": "2", "m": "1"})
+            size = codec.get_chunk_count()
+            min_size = codec.get_data_chunk_count()
+            # ErasureCode::create_rule analog: indep chooseleaf rule
+            ruleno = m.crush.add_rule(Rule(steps=[
+                (RULE_TAKE, root, 0),
+                (RULE_CHOOSELEAF_INDEP, size, 1),
+                (RULE_EMIT, 0, 0)], type=POOL_TYPE_ERASURE))
+        else:
+            size = int(cmd.get("size", self.config.osd_pool_default_size))
+            min_size = max(1, size - 1)
+            ruleno = m.crush.add_rule(Rule(steps=[
+                (RULE_TAKE, root, 0),
+                (RULE_CHOOSELEAF_FIRSTN, size, 1),
+                (RULE_EMIT, 0, 0)]))
+        pg_num = int(cmd.get("pg_num", self.config.osd_pool_default_pg_num))
+        pool_id = self._next_pool_id
+        self._next_pool_id += 1
+        m.add_pool(PGPool(
+            pool_id=pool_id, type=pool_type, size=size, min_size=min_size,
+            pg_num=pg_num, pgp_num=pg_num, crush_rule=ruleno,
+            ec_profile=ec_profile, name=name))
+        m.invalidate_mappers()  # rules changed
+        self._propose("pool_create", (pool_id, name))
+        self.perf.inc("mon_pool_create")
+        return pool_id
+
+    # -- map distribution --------------------------------------------------
+
+    async def _broadcast_map(self) -> None:
+        for addr in list(self.subscribers):
+            try:
+                await self._send_map(addr)
+            except (ConnectionError, OSError):
+                self.subscribers.discard(addr)
+
+    async def _send_map(self, addr: Addr) -> None:
+        blob = pickle.dumps(self.osdmap)
+        await self.messenger.send_message(
+            M.MOSDMapMsg(epoch=self.osdmap.epoch, osdmap_blob=blob), addr)
+
+    async def _tick(self) -> None:
+        """Down-out tick (reference OSDMonitor tick auto-out)."""
+        while True:
+            await asyncio.sleep(self.config.mon_tick_interval)
+            now = time.monotonic()
+            changed = False
+            for osd, since in list(self.down_since.items()):
+                if now - since > self.config.mon_osd_down_out_interval and \
+                        self.osdmap.osd_weight[osd] > 0:
+                    self.osdmap.mark_out(osd)
+                    self.down_since.pop(osd)
+                    changed = True
+            if changed:
+                await self._commit_map_change()

@@ -1,0 +1,198 @@
+"""Objecter + librados-style client surface.
+
+Mirrors the reference client op engine (src/osdc/Objecter.cc): ops are
+targeted client-side — object name -> ps (ceph_str_hash_rjenkins) ->
+PG -> acting primary against the cached OSDMap (_calc_target,
+Objecter.cc:2749) — sent as MOSDOp, and resent with a refreshed map on
+misdirect or connection failure (:1272-1329 resend semantics).  The
+RadosClient/IoCtx pair mirrors librados (src/librados/IoCtxImpl.cc).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+from typing import Any, Dict, List, Optional, Tuple
+
+from ceph_tpu.cluster import messages as M
+from ceph_tpu.cluster.messenger import (
+    Addr,
+    Connection,
+    Dispatcher,
+    EntityName,
+    Messenger,
+)
+from ceph_tpu.ops.jenkins import str_hash_rjenkins
+from ceph_tpu.osdmap.osdmap import OSDMap, PGid, ceph_stable_mod
+from ceph_tpu.utils import Config
+
+
+class Objecter(Dispatcher):
+    def __init__(self, name: str, mon_addr: Addr,
+                 config: Optional[Config] = None):
+        self.client_name = name
+        self.mon_addr = tuple(mon_addr)
+        self.config = config or Config()
+        self.messenger = Messenger(EntityName("client", abs(hash(name)) % 10000))
+        self.messenger.add_dispatcher(self)
+        self.osdmap: Optional[OSDMap] = None
+        self._map_event = asyncio.Event()
+        self._tid = 0
+        self._inflight: Dict[Tuple[str, int], asyncio.Future] = {}
+        self._mon_tid = 0
+        self._mon_inflight: Dict[int, asyncio.Future] = {}
+
+    async def start(self) -> None:
+        addr = await self.messenger.bind()
+        await self.messenger.send_message(
+            M.MMonSubscribe(what="osdmap", addr=addr), self.mon_addr)
+        await asyncio.wait_for(self._map_event.wait(), timeout=10)
+
+    async def stop(self) -> None:
+        await self.messenger.shutdown()
+
+    async def ms_dispatch(self, conn: Connection, msg) -> bool:
+        if isinstance(msg, M.MOSDMapMsg):
+            self.osdmap = pickle.loads(msg.osdmap_blob)
+            self._map_event.set()
+            return True
+        if isinstance(msg, M.MOSDOpReply):
+            fut = self._inflight.pop(tuple(msg.reqid), None)
+            if fut and not fut.done():
+                fut.set_result(msg)
+            return True
+        if isinstance(msg, M.MMonCommandReply):
+            fut = self._mon_inflight.pop(msg.tid, None)
+            if fut and not fut.done():
+                fut.set_result(msg)
+            return True
+        return False
+
+    # -- targeting (reference _calc_target) --------------------------------
+
+    def object_pgid(self, pool_id: int, oid: str) -> PGid:
+        pool = self.osdmap.pools[pool_id]
+        ps = str_hash_rjenkins(oid.encode())
+        seed = ceph_stable_mod(ps, pool.pg_num, pool.pg_num_mask)
+        return PGid(pool_id, seed)
+
+    def _target_osd(self, pgid: PGid) -> int:
+        _, _, acting, acting_primary = self.osdmap.pg_to_up_acting_osds(pgid)
+        return acting_primary
+
+    async def _refresh_map(self) -> None:
+        self._map_event.clear()
+        await self.messenger.send_message(
+            M.MMonSubscribe(what="osdmap", addr=self.messenger.my_addr),
+            self.mon_addr)
+        await asyncio.wait_for(self._map_event.wait(), timeout=10)
+
+    # -- op submission with resend-on-map-change ---------------------------
+
+    async def op_submit(self, pool_id: int, oid: str,
+                        ops: List[Tuple[str, Dict[str, Any]]],
+                        timeout: float = 30.0) -> M.MOSDOpReply:
+        deadline = asyncio.get_event_loop().time() + timeout
+        backoff = 0.05
+        while True:
+            pgid = self.object_pgid(pool_id, oid)
+            primary = self._target_osd(pgid)
+            addr = self.osdmap.osd_addrs.get(primary) if primary >= 0 else None
+            if addr is not None:
+                self._tid += 1
+                reqid = (self.client_name, self._tid)
+                fut = asyncio.get_event_loop().create_future()
+                self._inflight[reqid] = fut
+                msg = M.MOSDOp(reqid=reqid, pgid=pgid, oid=oid, ops=ops,
+                               epoch=self.osdmap.epoch)
+                try:
+                    await self.messenger.send_message(msg, tuple(addr))
+                    reply = await asyncio.wait_for(fut, timeout=5.0)
+                    if reply.result != -11:  # not misdirected
+                        return reply
+                except (ConnectionError, OSError, asyncio.TimeoutError):
+                    self._inflight.pop(reqid, None)
+            if asyncio.get_event_loop().time() > deadline:
+                raise TimeoutError(f"op on {oid} timed out")
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, 1.0)
+            try:
+                await self._refresh_map()
+            except asyncio.TimeoutError:
+                pass
+
+    async def mon_command(self, cmd: Dict[str, Any], timeout: float = 10.0):
+        self._mon_tid += 1
+        tid = self._mon_tid
+        fut = asyncio.get_event_loop().create_future()
+        self._mon_inflight[tid] = fut
+        await self.messenger.send_message(
+            M.MMonCommand(cmd=cmd, tid=tid), self.mon_addr)
+        reply = await asyncio.wait_for(fut, timeout=timeout)
+        if reply.result != 0:
+            raise RuntimeError(f"mon command failed: {reply.data}")
+        return reply.data
+
+
+class IoCtx:
+    """Pool I/O context (librados IoCtx analog)."""
+
+    def __init__(self, objecter: Objecter, pool_id: int):
+        self.objecter = objecter
+        self.pool_id = pool_id
+
+    async def write_full(self, oid: str, data: bytes) -> None:
+        reply = await self.objecter.op_submit(
+            self.pool_id, oid, [("write_full", {"data": data})])
+        if reply.result != 0:
+            raise IOError(f"write_full({oid}) -> {reply.result}: {reply.data}")
+
+    async def read(self, oid: str) -> bytes:
+        reply = await self.objecter.op_submit(self.pool_id, oid, [("read", {})])
+        if reply.result == -2:
+            raise FileNotFoundError(oid)
+        if reply.result != 0:
+            raise IOError(f"read({oid}) -> {reply.result}: {reply.data}")
+        return reply.data
+
+    async def remove(self, oid: str) -> None:
+        reply = await self.objecter.op_submit(self.pool_id, oid,
+                                              [("delete", {})])
+        if reply.result != 0:
+            raise IOError(f"remove({oid}) -> {reply.result}")
+
+    async def stat(self, oid: str) -> int:
+        reply = await self.objecter.op_submit(self.pool_id, oid, [("stat", {})])
+        if reply.result != 0:
+            raise FileNotFoundError(oid)
+        return reply.data
+
+
+class RadosClient:
+    """librados rados_t analog: connect, pools, ioctx."""
+
+    def __init__(self, mon_addr: Addr, name: str = "admin",
+                 config: Optional[Config] = None):
+        self.objecter = Objecter(name, mon_addr, config)
+
+    async def connect(self) -> None:
+        await self.objecter.start()
+
+    async def shutdown(self) -> None:
+        await self.objecter.stop()
+
+    async def pool_create(self, name: str, pool_type: str = "replicated",
+                          pg_num: int = 16, size: int = 3,
+                          ec_profile: Optional[Dict[str, str]] = None) -> int:
+        pool_id = await self.objecter.mon_command({
+            "prefix": "osd pool create", "pool": name,
+            "pool_type": pool_type, "pg_num": pg_num, "size": size,
+            "ec_profile": ec_profile})
+        await self.objecter._refresh_map()
+        return pool_id
+
+    async def status(self):
+        return await self.objecter.mon_command({"prefix": "status"})
+
+    def ioctx(self, pool_id: int) -> IoCtx:
+        return IoCtx(self.objecter, pool_id)

@@ -1,0 +1,636 @@
+"""OSD daemon: PGs, replicated and erasure-coded backends, recovery.
+
+Structural mirror of the reference OSD (src/osd/OSD.cc dispatch ->
+PrimaryLogPG op execution; ReplicatedBackend transaction fan-out;
+ECBackend shard writes/reads, src/osd/ECBackend.cc:921,986,1141), with the
+dense compute — erasure encode/decode, chunk crc32c — running through the
+TPU codec engine.  Heartbeats/failure reports mirror OSD::heartbeat_check
+(OSD.cc:4763) -> MOSDFailure -> monitor.  Recovery re-synchronizes PG
+contents on map change (push recovery; EC shards reconstructed by decode,
+ECBackend::run_recovery_op analog).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+from ceph_tpu.cluster import messages as M
+from ceph_tpu.cluster.messenger import (
+    Addr,
+    Connection,
+    Dispatcher,
+    EntityName,
+    Messenger,
+)
+from ceph_tpu.cluster.store import MemStore, ObjectStore, Transaction
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+from ceph_tpu.ops import crc32c as crcmod
+from ceph_tpu.osdmap.osdmap import OSDMap, PGid, PGPool
+from ceph_tpu.utils import Config, PerfCounters
+
+
+@dataclass
+class PGState:
+    pgid: PGid
+    up: List[int] = field(default_factory=list)
+    acting: List[int] = field(default_factory=list)
+    primary: int = -1
+
+
+@dataclass
+class MOSDPGQuery(M.Message):
+    pgid: Optional[PGid] = None
+
+
+@dataclass
+class MOSDPGQueryReply(M.Message):
+    pgid: Optional[PGid] = None
+    objects: Dict[str, int] = field(default_factory=dict)  # oid -> version
+
+
+def _coll(pgid: PGid) -> str:
+    return f"pg_{pgid.pool}_{pgid.seed}"
+
+
+class OSDDaemon(Dispatcher):
+    def __init__(self, osd_id: int, mon_addr: Addr,
+                 config: Optional[Config] = None,
+                 store: Optional[ObjectStore] = None):
+        self.osd_id = osd_id
+        self.mon_addr = tuple(mon_addr)
+        self.config = config or Config()
+        self.store = store or MemStore()
+        self.messenger = Messenger(EntityName("osd", osd_id))
+        self.messenger.add_dispatcher(self)
+        self.osdmap: Optional[OSDMap] = None
+        self.pgs: Dict[PGid, PGState] = {}
+        self.perf = PerfCounters(f"osd.{osd_id}")
+        self._codecs: Dict[int, object] = {}
+        self._pending: Dict[Tuple, Tuple[asyncio.Future, List]] = {}
+        self._tid = 0
+        self._tasks: List[asyncio.Task] = []
+        self._hb_last: Dict[int, float] = {}
+        self._reported: Set[int] = set()
+        self._stopped = False
+
+    # ------------------------------------------------------------ lifecycle
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> Addr:
+        addr = await self.messenger.bind(host, port)
+        await self.messenger.send_message(
+            M.MOSDBoot(osd_id=self.osd_id, addr=addr), self.mon_addr)
+        await self.messenger.send_message(
+            M.MMonSubscribe(what="osdmap", addr=addr), self.mon_addr)
+        loop = asyncio.get_event_loop()
+        self._tasks.append(loop.create_task(self._heartbeat_loop()))
+        return addr
+
+    async def stop(self) -> None:
+        self._stopped = True
+        for t in self._tasks:
+            t.cancel()
+        await self.messenger.shutdown()
+
+    def _next_reqid(self) -> Tuple[str, int]:
+        self._tid += 1
+        return (f"osd.{self.osd_id}", self._tid)
+
+    def _codec(self, pool: PGPool):
+        codec = self._codecs.get(pool.pool_id)
+        if codec is None:
+            from ceph_tpu.ec import factory
+
+            profile = pool.ec_profile or {
+                "plugin": "jerasure", "technique": "reed_sol_van",
+                "k": "2", "m": "1"}
+            codec = factory(profile)
+            self._codecs[pool.pool_id] = codec
+        return codec
+
+    # ------------------------------------------------------------- dispatch
+
+    async def ms_dispatch(self, conn: Connection, msg) -> bool:
+        try:
+            return await self._dispatch(conn, msg)
+        except Exception as e:
+            self.perf.inc("osd_dispatch_errors")
+            if isinstance(msg, M.MOSDOp):
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=-5, data=repr(e)))
+                return True
+            raise
+
+    async def _dispatch(self, conn: Connection, msg) -> bool:
+        if isinstance(msg, M.MOSDMapMsg):
+            await self._handle_map(msg)
+            return True
+        if isinstance(msg, M.MOSDOp):
+            await self._handle_client_op(conn, msg)
+            return True
+        if isinstance(msg, M.MOSDRepOp):
+            txn = Transaction.decode(msg.txn_blob)
+            self.store.queue_transaction(txn)
+            self.perf.inc("osd_rep_ops")
+            await conn.send(M.MOSDRepOpReply(reqid=msg.reqid, result=0))
+            return True
+        if isinstance(msg, M.MOSDRepOpReply) or \
+                isinstance(msg, M.MOSDECSubOpWriteReply):
+            self._ack(msg.reqid, msg.result)
+            return True
+        if isinstance(msg, M.MOSDECSubOpWrite):
+            await self._handle_ec_write(conn, msg)
+            return True
+        if isinstance(msg, M.MOSDECSubOpRead):
+            await self._handle_ec_read(conn, msg)
+            return True
+        if isinstance(msg, M.MOSDECSubOpReadReply):
+            self._ack(msg.reqid, msg.result, msg)
+            return True
+        if isinstance(msg, M.MOSDPGPush):
+            self._handle_push(msg)
+            await conn.send(M.MOSDPGPushReply(
+                pgid=msg.pgid, oid=msg.oid, result=0))
+            return True
+        if isinstance(msg, M.MOSDPGPushReply):
+            return True
+        if isinstance(msg, MOSDPGQuery):
+            objects = {
+                oid: self.store.get_version(_coll(msg.pgid), oid)
+                for oid in self.store.list_objects(_coll(msg.pgid))
+            }
+            await conn.send(MOSDPGQueryReply(pgid=msg.pgid, objects=objects))
+            return True
+        if isinstance(msg, MOSDPGQueryReply):
+            self._ack(("pgq", str(msg.pgid), msg.src.num), 0, msg)
+            return True
+        if isinstance(msg, M.MPing):
+            if msg.reply:
+                if msg.src is not None:
+                    self._hb_last[msg.src.num] = time.monotonic()
+            else:
+                await conn.send(M.MPing(stamp=msg.stamp, reply=True))
+            return True
+        return False
+
+    # -------------------------------------------------------------- helpers
+
+    def _ack(self, key, result, payload=None) -> None:
+        entry = self._pending.get(tuple(key) if isinstance(key, tuple) else key)
+        if entry is None:
+            return
+        fut, acc = entry
+        acc.append((result, payload))
+        if len(acc) >= fut.needed and not fut.done():  # type: ignore[attr-defined]
+            fut.set_result(acc)
+
+    def _make_waiter(self, key, needed: int) -> asyncio.Future:
+        fut = asyncio.get_event_loop().create_future()
+        fut.needed = needed  # type: ignore[attr-defined]
+        self._pending[key] = (fut, [])
+        return fut
+
+    async def _send_osd(self, osd: int, msg) -> None:
+        addr = self.osdmap.osd_addrs.get(osd)
+        if addr is None:
+            raise ConnectionError(f"no address for osd.{osd}")
+        await self.messenger.send_message(msg, addr)
+
+    # ------------------------------------------------------------ map flow
+
+    async def _handle_map(self, msg: M.MOSDMapMsg) -> None:
+        newmap: OSDMap = pickle.loads(msg.osdmap_blob)
+        old = self.osdmap
+        self.osdmap = newmap
+        self.perf.set("osd_map_epoch", newmap.epoch)
+        changed = self._advance_pgs()
+        if changed and not self._stopped:
+            self._tasks.append(asyncio.get_event_loop().create_task(
+                self._recover_all()))
+
+    def _advance_pgs(self) -> bool:
+        """Recompute PG membership for this OSD; returns True if the set of
+        primary PGs changed (triggering recovery)."""
+        m = self.osdmap
+        changed = False
+        for pool_id, pool in m.pools.items():
+            for seed in range(pool.pg_num):
+                pgid = PGid(pool_id, seed)
+                up, upp, acting, actp = m.pg_to_up_acting_osds(pgid)
+                mine = self.osd_id in [o for o in acting if o != CRUSH_ITEM_NONE]
+                old = self.pgs.get(pgid)
+                if mine:
+                    st = PGState(pgid, up, acting, actp)
+                    if old is None or old.acting != acting:
+                        changed = True
+                        self.store.queue_transaction(
+                            Transaction().create_collection(_coll(pgid)))
+                    self.pgs[pgid] = st
+                elif old is not None:
+                    del self.pgs[pgid]
+                    changed = True
+        return changed
+
+    # -------------------------------------------------------- client ops
+
+    async def _handle_client_op(self, conn: Connection, msg: M.MOSDOp) -> None:
+        m = self.osdmap
+        if m is None:
+            await conn.send(M.MOSDOpReply(reqid=msg.reqid, result=-11))
+            return
+        pool = m.pools.get(msg.pgid.pool)
+        if pool is None:
+            await conn.send(M.MOSDOpReply(reqid=msg.reqid, result=-2))
+            return
+        st = self.pgs.get(msg.pgid)
+        if st is None or st.primary != self.osd_id:
+            # not primary (anymore): tell client to refresh its map
+            await conn.send(M.MOSDOpReply(
+                reqid=msg.reqid, result=-11, epoch=m.epoch))
+            self.perf.inc("osd_misdirected_ops")
+            return
+        self.perf.inc("osd_client_ops")
+        for opname, args in msg.ops:
+            if opname == "write_full":
+                r = await self._op_write_full(pool, st, msg.oid, args["data"])
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=r, epoch=m.epoch))
+            elif opname == "read":
+                try:
+                    data = await self._op_read(pool, st, msg.oid)
+                    await conn.send(M.MOSDOpReply(
+                        reqid=msg.reqid, result=0, data=data, epoch=m.epoch))
+                except FileNotFoundError:
+                    await conn.send(M.MOSDOpReply(
+                        reqid=msg.reqid, result=-2, epoch=m.epoch))
+            elif opname == "delete":
+                r = await self._op_delete(pool, st, msg.oid)
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=r, epoch=m.epoch))
+            elif opname == "stat":
+                size = self.store.stat(_coll(st.pgid), msg.oid)
+                if size is None and pool.is_erasure():
+                    xs = self.store.getattr(_coll(st.pgid), msg.oid, "size")
+                    size = int(xs) if xs else None
+                elif pool.is_erasure():
+                    xs = self.store.getattr(_coll(st.pgid), msg.oid, "size")
+                    size = int(xs) if xs else size
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid,
+                    result=0 if size is not None else -2,
+                    data=size, epoch=m.epoch))
+            elif opname == "list":
+                names = self.store.list_objects(_coll(st.pgid))
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=0, data=names, epoch=m.epoch))
+            else:
+                await conn.send(M.MOSDOpReply(reqid=msg.reqid, result=-95))
+
+    # replicated write: local txn + MOSDRepOp fan-out (ReplicatedBackend)
+    async def _op_write_full(self, pool: PGPool, st: PGState, oid: str,
+                             data: bytes) -> int:
+        if pool.is_erasure():
+            return await self._ec_write(pool, st, oid, data)
+        version = self.store.get_version(_coll(st.pgid), oid) + 1
+        txn = (Transaction()
+               .remove(_coll(st.pgid), oid)
+               .write(_coll(st.pgid), oid, 0, data)
+               .set_version(_coll(st.pgid), oid, version))
+        self.store.queue_transaction(txn)
+        peers = [o for o in st.acting
+                 if o != self.osd_id and o != CRUSH_ITEM_NONE]
+        if peers:
+            reqid = self._next_reqid()
+            fut = self._make_waiter(reqid, len(peers))
+            rep = M.MOSDRepOp(reqid=reqid, pgid=st.pgid,
+                              txn_blob=txn.encode(),
+                              epoch=self.osdmap.epoch)
+            for o in peers:
+                await self._send_osd(o, rep)
+            try:
+                await asyncio.wait_for(
+                    fut, timeout=self.config.osd_client_op_timeout)
+            except asyncio.TimeoutError:
+                return -110
+            finally:
+                self._pending.pop(reqid, None)
+        return 0
+
+    async def _op_delete(self, pool: PGPool, st: PGState, oid: str) -> int:
+        txn = Transaction().remove(_coll(st.pgid), oid)
+        self.store.queue_transaction(txn)
+        peers = [o for o in st.acting
+                 if o != self.osd_id and o != CRUSH_ITEM_NONE]
+        for o in peers:
+            await self._send_osd(o, M.MOSDRepOp(
+                reqid=self._next_reqid(), pgid=st.pgid,
+                txn_blob=txn.encode(), epoch=self.osdmap.epoch))
+        return 0
+
+    async def _op_read(self, pool: PGPool, st: PGState, oid: str) -> bytes:
+        if pool.is_erasure():
+            return await self._ec_read(pool, st, oid)
+        return self.store.read(_coll(st.pgid), oid)
+
+    # ----------------------------------------------------------- EC backend
+
+    async def _ec_write(self, pool: PGPool, st: PGState, oid: str,
+                        data: bytes) -> int:
+        """start_rmw analog for full-object writes: encode on the TPU,
+        fan shard writes out to the acting set (ECBackend.cc:1785,921)."""
+        codec = self._codec(pool)
+        n = codec.get_chunk_count()
+        chunks = codec.encode(range(n), data)
+        version = self.store.get_version(_coll(st.pgid), oid) + 1
+        reqid = self._next_reqid()
+        peers = []
+        my_shard = None
+        for shard in range(n):
+            osd = st.acting[shard] if shard < len(st.acting) else CRUSH_ITEM_NONE
+            if osd == self.osd_id:
+                my_shard = shard
+            elif osd != CRUSH_ITEM_NONE:
+                peers.append((osd, shard))
+        hinfo = {"size": len(data), "version": version}
+        if my_shard is not None:
+            self._apply_shard(st.pgid, oid, my_shard,
+                              chunks[my_shard].tobytes(), hinfo)
+        if peers:
+            fut = self._make_waiter(reqid, len(peers))
+            for osd, shard in peers:
+                await self._send_osd(osd, M.MOSDECSubOpWrite(
+                    reqid=reqid, pgid=st.pgid, oid=oid, shard=shard,
+                    data=chunks[shard].tobytes(), hinfo=hinfo,
+                    epoch=self.osdmap.epoch))
+            try:
+                await asyncio.wait_for(
+                    fut, timeout=self.config.osd_client_op_timeout)
+            except asyncio.TimeoutError:
+                return -110
+            finally:
+                self._pending.pop(reqid, None)
+        return 0
+
+    def _apply_shard(self, pgid: PGid, oid: str, shard: int, data: bytes,
+                     hinfo: Dict) -> None:
+        """Store one EC shard + its cumulative crc (ECUtil::HashInfo)."""
+        crc = crcmod.crc32c(0xFFFFFFFF, data)
+        txn = (Transaction()
+               .remove(_coll(pgid), oid)
+               .write(_coll(pgid), oid, 0, data)
+               .setattr(_coll(pgid), oid, "shard", str(shard).encode())
+               .setattr(_coll(pgid), oid, "size",
+                        str(hinfo["size"]).encode())
+               .setattr(_coll(pgid), oid, "hinfo_crc", str(crc).encode())
+               .set_version(_coll(pgid), oid, hinfo["version"]))
+        self.store.queue_transaction(txn)
+
+    async def _handle_ec_write(self, conn: Connection,
+                               msg: M.MOSDECSubOpWrite) -> None:
+        self._apply_shard(msg.pgid, msg.oid, msg.shard, msg.data, msg.hinfo)
+        self.perf.inc("osd_ec_sub_writes")
+        await conn.send(M.MOSDECSubOpWriteReply(reqid=msg.reqid, result=0))
+
+    async def _handle_ec_read(self, conn: Connection,
+                              msg: M.MOSDECSubOpRead) -> None:
+        try:
+            data = self.store.read(_coll(msg.pgid), msg.oid)
+            stored_crc = self.store.getattr(_coll(msg.pgid), msg.oid,
+                                            "hinfo_crc")
+            # scrub-on-read: verify the chunk crc (ecbackend.rst:86-99)
+            if stored_crc is not None and \
+                    int(stored_crc) != crcmod.crc32c(0xFFFFFFFF, data):
+                raise IOError("chunk crc mismatch")
+            shard_attr = self.store.getattr(_coll(msg.pgid), msg.oid, "shard")
+            shard = int(shard_attr) if shard_attr else msg.shard
+            size = self.store.getattr(_coll(msg.pgid), msg.oid, "size")
+            await conn.send(M.MOSDECSubOpReadReply(
+                reqid=msg.reqid, result=0, shard=shard, data=data,
+                hinfo={"size": int(size) if size else 0}))
+            self.perf.inc("osd_ec_sub_reads")
+        except (FileNotFoundError, IOError):
+            await conn.send(M.MOSDECSubOpReadReply(
+                reqid=msg.reqid, result=-2, shard=msg.shard))
+
+    async def _gather_shards(self, pool: PGPool, st: PGState, oid: str,
+                             need_k: int) -> Tuple[Dict[int, bytes], int]:
+        """Collect >= k shards from the acting set (own shard free)."""
+        codec = self._codec(pool)
+        shards: Dict[int, bytes] = {}
+        size = 0
+        my = self.store.stat(_coll(st.pgid), oid)
+        if my is not None:
+            data = self.store.read(_coll(st.pgid), oid)
+            shard_attr = self.store.getattr(_coll(st.pgid), oid, "shard")
+            if shard_attr is not None:
+                shards[int(shard_attr)] = data
+            sa = self.store.getattr(_coll(st.pgid), oid, "size")
+            size = int(sa) if sa else 0
+        peers = [(shard, osd) for shard, osd in enumerate(st.acting)
+                 if osd not in (self.osd_id, CRUSH_ITEM_NONE)
+                 and shard not in shards]
+        if peers and len(shards) < need_k:
+            reqid = self._next_reqid()
+            fut = self._make_waiter(reqid, len(peers))
+            for shard, osd in peers:
+                try:
+                    await self._send_osd(osd, M.MOSDECSubOpRead(
+                        reqid=reqid, pgid=st.pgid, oid=oid, shard=shard))
+                except ConnectionError:
+                    fut.needed -= 1  # type: ignore[attr-defined]
+            try:
+                acc = await asyncio.wait_for(
+                    fut, timeout=self.config.osd_client_op_timeout)
+            except asyncio.TimeoutError:
+                acc = self._pending[reqid][1]
+            finally:
+                self._pending.pop(reqid, None)
+            for result, reply in acc:
+                if result == 0 and reply is not None:
+                    shards[reply.shard] = reply.data
+                    if reply.hinfo.get("size"):
+                        size = reply.hinfo["size"]
+        return shards, size
+
+    async def _ec_read(self, pool: PGPool, st: PGState, oid: str) -> bytes:
+        """objects_read_async analog: min shards + TPU decode
+        (ECBackend.cc:2111,1588,2262)."""
+        codec = self._codec(pool)
+        k = codec.get_data_chunk_count()
+        shards, size = await self._gather_shards(pool, st, oid, k)
+        if len(shards) < k:
+            if not shards:
+                raise FileNotFoundError(oid)
+            raise IOError(f"only {len(shards)} of {k} shards for {oid}")
+        import numpy as np
+
+        avail = {s: np.frombuffer(d, dtype=np.uint8)
+                 for s, d in shards.items()}
+        out = codec.decode_concat(avail)
+        return out[:size]
+
+    # ------------------------------------------------------------- recovery
+
+    async def _recover_all(self) -> None:
+        await asyncio.sleep(self.config.osd_recovery_delay_start)
+        for pgid, st in list(self.pgs.items()):
+            if st.primary == self.osd_id:
+                try:
+                    await self._recover_pg(st)
+                except Exception:
+                    self.perf.inc("osd_recovery_errors")
+
+    async def _recover_pg(self, st: PGState) -> None:
+        """Primary-driven resync: query members, reconstruct, push."""
+        m = self.osdmap
+        pool = m.pools[st.pgid.pool]
+        members = [o for o in st.acting
+                   if o not in (self.osd_id, CRUSH_ITEM_NONE)]
+        # object inventory = union of members' lists + local
+        names: Dict[str, int] = {
+            oid: self.store.get_version(_coll(st.pgid), oid)
+            for oid in self.store.list_objects(_coll(st.pgid))}
+        for osd in members:
+            key = ("pgq", str(st.pgid), osd)
+            fut = self._make_waiter(key, 1)
+            try:
+                await self._send_osd(osd, MOSDPGQuery(pgid=st.pgid))
+                acc = await asyncio.wait_for(fut, timeout=2.0)
+                for _, reply in acc:
+                    for oid, ver in reply.objects.items():
+                        names[oid] = max(names.get(oid, 0), ver)
+            except (asyncio.TimeoutError, ConnectionError):
+                pass
+            finally:
+                self._pending.pop(key, None)
+        for oid in names:
+            if pool.is_erasure():
+                await self._recover_ec_object(pool, st, oid)
+            else:
+                await self._recover_rep_object(pool, st, oid, names[oid])
+        self.perf.inc("osd_pg_recoveries")
+
+    async def _recover_rep_object(self, pool: PGPool, st: PGState,
+                                  oid: str, version: int) -> None:
+        if self.store.stat(_coll(st.pgid), oid) is None:
+            # pull from any member that has it
+            for osd in st.acting:
+                if osd in (self.osd_id, CRUSH_ITEM_NONE):
+                    continue
+                key = ("pgq", str(st.pgid), osd)
+                # reuse EC sub read as a generic object fetch
+                reqid = self._next_reqid()
+                fut = self._make_waiter(reqid, 1)
+                try:
+                    await self._send_osd(osd, M.MOSDECSubOpRead(
+                        reqid=reqid, pgid=st.pgid, oid=oid, shard=-1))
+                    acc = await asyncio.wait_for(fut, timeout=2.0)
+                    result, reply = acc[0]
+                    if result == 0:
+                        self.store.queue_transaction(
+                            Transaction().write(_coll(st.pgid), oid, 0,
+                                                reply.data))
+                        break
+                except (asyncio.TimeoutError, ConnectionError):
+                    continue
+                finally:
+                    self._pending.pop(reqid, None)
+        if self.store.stat(_coll(st.pgid), oid) is None:
+            return
+        data = self.store.read(_coll(st.pgid), oid)
+        for osd in st.acting:
+            if osd in (self.osd_id, CRUSH_ITEM_NONE):
+                continue
+            try:
+                await self._send_osd(osd, M.MOSDPGPush(
+                    pgid=st.pgid, oid=oid, data=data, version=version))
+            except ConnectionError:
+                pass
+
+    async def _recover_ec_object(self, pool: PGPool, st: PGState,
+                                 oid: str) -> None:
+        """Reconstruct and re-distribute shards (TPU decode + encode)."""
+        codec = self._codec(pool)
+        k = codec.get_data_chunk_count()
+        shards, size = await self._gather_shards(pool, st, oid, k)
+        if len(shards) < k:
+            self.perf.inc("osd_unrecoverable")
+            return
+        import numpy as np
+
+        avail = {s: np.frombuffer(d, dtype=np.uint8)
+                 for s, d in shards.items()}
+        data = codec.decode_concat(avail)[:size]
+        chunks = codec.encode(range(codec.get_chunk_count()), data)
+        version = max((self.store.get_version(_coll(st.pgid), oid)), 1)
+        hinfo = {"size": size, "version": version}
+        for shard, osd in enumerate(st.acting):
+            if osd == CRUSH_ITEM_NONE:
+                continue
+            blob = chunks[shard].tobytes()
+            if osd == self.osd_id:
+                self._apply_shard(st.pgid, oid, shard, blob, hinfo)
+            else:
+                try:
+                    await self._send_osd(osd, M.MOSDECSubOpWrite(
+                        reqid=self._next_reqid(), pgid=st.pgid, oid=oid,
+                        shard=shard, data=blob, hinfo=hinfo,
+                        epoch=self.osdmap.epoch))
+                except ConnectionError:
+                    pass
+
+    def _handle_push(self, msg: M.MOSDPGPush) -> None:
+        coll = _coll(msg.pgid)
+        cur = self.store.get_version(coll, msg.oid)
+        if self.store.stat(coll, msg.oid) is not None and cur >= msg.version:
+            return
+        txn = (Transaction()
+               .remove(coll, msg.oid)
+               .write(coll, msg.oid, 0, msg.data)
+               .set_version(coll, msg.oid, msg.version))
+        for k, v in msg.xattrs.items():
+            txn.setattr(coll, msg.oid, k, v)
+        self.store.queue_transaction(txn)
+        self.perf.inc("osd_pushes_applied")
+
+    # ------------------------------------------------------------ heartbeat
+
+    async def _heartbeat_loop(self) -> None:
+        while not self._stopped:
+            await asyncio.sleep(self.config.osd_heartbeat_interval)
+            m = self.osdmap
+            if m is None:
+                continue
+            now = time.monotonic()
+            for osd, addr in list(m.osd_addrs.items()):
+                if osd == self.osd_id or not m.osd_up[osd]:
+                    continue
+                try:
+                    await self.messenger.send_message(
+                        M.MPing(stamp=now), addr)
+                except (ConnectionError, OSError):
+                    pass
+                last = self._hb_last.get(osd)
+                if last is not None and \
+                        now - last > self.config.osd_heartbeat_grace and \
+                        osd not in self._reported:
+                    self._reported.add(osd)
+                    try:
+                        await self.messenger.send_message(
+                            M.MOSDFailure(failed_osd=osd,
+                                          reporter=self.osd_id),
+                            self.mon_addr)
+                        self.perf.inc("osd_failure_reports")
+                    except (ConnectionError, OSError):
+                        pass
+                elif last is None:
+                    self._hb_last[osd] = now
+            # once the monitor marks a reported peer down, forget it so a
+            # future reboot is tracked afresh
+            for osd in list(self._reported):
+                if not m.osd_up[osd]:
+                    self._reported.discard(osd)
+                    self._hb_last.pop(osd, None)

@@ -1,0 +1,180 @@
+"""ObjectStore + Transaction, with a MemStore implementation.
+
+Mirrors the reference's storage contract (src/os/ObjectStore.h:1470-1498):
+every mutation is an ordered, atomic Transaction of typed ops applied to
+collections of objects (data + xattrs + omap), and MemStore
+(src/os/memstore/MemStore.cc) is the in-RAM implementation backing tests
+and the dev cluster.
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class Obj:
+    data: bytearray = field(default_factory=bytearray)
+    xattrs: Dict[str, bytes] = field(default_factory=dict)
+    omap: Dict[str, bytes] = field(default_factory=dict)
+    version: int = 0
+
+
+class Transaction:
+    """Ordered op list; atomic at queue_transaction."""
+
+    def __init__(self):
+        self.ops: List[Tuple] = []
+
+    def create_collection(self, coll: str):
+        self.ops.append(("create_collection", coll))
+        return self
+
+    def remove_collection(self, coll: str):
+        self.ops.append(("remove_collection", coll))
+        return self
+
+    def write(self, coll: str, oid: str, offset: int, data: bytes):
+        self.ops.append(("write", coll, oid, offset, bytes(data)))
+        return self
+
+    def truncate(self, coll: str, oid: str, size: int):
+        self.ops.append(("truncate", coll, oid, size))
+        return self
+
+    def remove(self, coll: str, oid: str):
+        self.ops.append(("remove", coll, oid))
+        return self
+
+    def setattr(self, coll: str, oid: str, name: str, value: bytes):
+        self.ops.append(("setattr", coll, oid, name, bytes(value)))
+        return self
+
+    def omap_set(self, coll: str, oid: str, kv: Dict[str, bytes]):
+        self.ops.append(("omap_set", coll, oid, dict(kv)))
+        return self
+
+    def touch(self, coll: str, oid: str):
+        self.ops.append(("touch", coll, oid))
+        return self
+
+    def set_version(self, coll: str, oid: str, version: int):
+        self.ops.append(("set_version", coll, oid, version))
+        return self
+
+    def encode(self) -> bytes:
+        return pickle.dumps(self.ops)
+
+    @classmethod
+    def decode(cls, blob: bytes) -> "Transaction":
+        t = cls()
+        t.ops = pickle.loads(blob)
+        return t
+
+
+class ObjectStore:
+    def mount(self) -> None: ...
+
+    def umount(self) -> None: ...
+
+    def queue_transaction(self, txn: Transaction) -> None:
+        raise NotImplementedError
+
+    def read(self, coll: str, oid: str, offset: int = 0,
+             length: Optional[int] = None) -> bytes:
+        raise NotImplementedError
+
+    def stat(self, coll: str, oid: str) -> Optional[int]:
+        raise NotImplementedError
+
+
+class MemStore(ObjectStore):
+    def __init__(self):
+        self._colls: Dict[str, Dict[str, Obj]] = {}
+        self._lock = threading.RLock()
+
+    # -- transaction application (atomic under lock) -----------------------
+
+    def queue_transaction(self, txn: Transaction) -> None:
+        with self._lock:
+            for op in txn.ops:
+                self._apply(op)
+
+    def _apply(self, op: Tuple) -> None:
+        kind = op[0]
+        if kind == "create_collection":
+            self._colls.setdefault(op[1], {})
+        elif kind == "remove_collection":
+            self._colls.pop(op[1], None)
+        elif kind == "touch":
+            self._coll(op[1]).setdefault(op[2], Obj())
+        elif kind == "write":
+            _, coll, oid, offset, data = op
+            o = self._coll(coll).setdefault(oid, Obj())
+            end = offset + len(data)
+            if len(o.data) < end:
+                o.data.extend(b"\0" * (end - len(o.data)))
+            o.data[offset:end] = data
+            o.version += 1
+        elif kind == "truncate":
+            _, coll, oid, size = op
+            o = self._coll(coll).setdefault(oid, Obj())
+            if len(o.data) > size:
+                del o.data[size:]
+            else:
+                o.data.extend(b"\0" * (size - len(o.data)))
+            o.version += 1
+        elif kind == "remove":
+            self._coll(op[1]).pop(op[2], None)
+        elif kind == "setattr":
+            _, coll, oid, name, value = op
+            self._coll(coll).setdefault(oid, Obj()).xattrs[name] = value
+        elif kind == "omap_set":
+            _, coll, oid, kv = op
+            self._coll(coll).setdefault(oid, Obj()).omap.update(kv)
+        elif kind == "set_version":
+            _, coll, oid, version = op
+            self._coll(coll).setdefault(oid, Obj()).version = version
+        else:
+            raise ValueError(f"unknown transaction op {kind}")
+
+    def _coll(self, coll: str) -> Dict[str, Obj]:
+        return self._colls.setdefault(coll, {})
+
+    # -- reads -------------------------------------------------------------
+
+    def read(self, coll: str, oid: str, offset: int = 0,
+             length: Optional[int] = None) -> bytes:
+        with self._lock:
+            o = self._colls.get(coll, {}).get(oid)
+            if o is None:
+                raise FileNotFoundError(f"{coll}/{oid}")
+            if length is None:
+                return bytes(o.data[offset:])
+            return bytes(o.data[offset : offset + length])
+
+    def stat(self, coll: str, oid: str) -> Optional[int]:
+        with self._lock:
+            o = self._colls.get(coll, {}).get(oid)
+            return None if o is None else len(o.data)
+
+    def get_version(self, coll: str, oid: str) -> int:
+        with self._lock:
+            o = self._colls.get(coll, {}).get(oid)
+            return 0 if o is None else o.version
+
+    def getattr(self, coll: str, oid: str, name: str) -> Optional[bytes]:
+        with self._lock:
+            o = self._colls.get(coll, {}).get(oid)
+            return None if o is None else o.xattrs.get(name)
+
+    def list_objects(self, coll: str) -> List[str]:
+        with self._lock:
+            return sorted(self._colls.get(coll, {}))
+
+    def list_collections(self) -> List[str]:
+        with self._lock:
+            return sorted(self._colls)

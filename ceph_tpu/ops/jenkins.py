@@ -131,3 +131,35 @@ def hash5(a, b, c, d, e):
     d, x, h = _mix(d, x, h)
     y, e, h = _mix(y, e, h)
     return h
+
+
+def str_hash_rjenkins(data: bytes) -> int:
+    """ceph_str_hash_rjenkins (reference src/common/ceph_hash.cc:21-78):
+    the object-name hash feeding pg selection."""
+    with np.errstate(over="ignore"):
+        a = np.uint64(0x9E3779B9)
+        b = np.uint64(0x9E3779B9)
+        c = np.uint64(0)
+        k = 0
+        length = len(data)
+        left = length
+        while left >= 12:
+            a = (a + np.uint64(int.from_bytes(data[k : k + 4], "little"))) & M32
+            b = (b + np.uint64(int.from_bytes(data[k + 4 : k + 8], "little"))) & M32
+            c = (c + np.uint64(int.from_bytes(data[k + 8 : k + 12], "little"))) & M32
+            a, b, c = _mix(a, b, c)
+            k += 12
+            left -= 12
+        c = (c + np.uint64(length)) & M32
+        tail = data[k:]
+        t = tail + bytes(12 - len(tail))
+        if left >= 9:
+            c = (c + np.uint64(int.from_bytes(t[8:11], "little") << 8)) & M32
+        if left >= 5:
+            b = (b + np.uint64(int.from_bytes(t[4:8], "little")
+                               & (0xFFFFFFFF >> (8 * (8 - min(left, 8)))))) & M32
+        if left >= 1:
+            a = (a + np.uint64(int.from_bytes(t[0:4], "little")
+                               & (0xFFFFFFFF >> (8 * (4 - min(left, 4)))))) & M32
+        a, b, c = _mix(a, b, c)
+        return int(c)

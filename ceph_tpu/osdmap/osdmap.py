@@ -121,6 +121,23 @@ class OSDMap:
         self._tensor = None
         self.osd_addrs: Dict[int, object] = {}
 
+    def invalidate_mappers(self) -> None:
+        """Call after mutating the CRUSH map (rules/buckets)."""
+        self._scalar = ScalarMapper(self.crush)
+        self._tensor = None
+
+    # pickling: mappers hold device arrays; rebuild lazily on the far side
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["_scalar"] = None
+        d["_tensor"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self._scalar = ScalarMapper(self.crush)
+        self._tensor = None
+
     # -- state helpers -----------------------------------------------------
 
     def exists(self, osd: int) -> bool:

@@ -1,0 +1,4 @@
+"""Common runtime: typed config schema, perf counters."""
+
+from ceph_tpu.utils.config import Config, Option  # noqa: F401
+from ceph_tpu.utils.perf import PerfCounters  # noqa: F401

@@ -1,0 +1,98 @@
+"""Typed option schema + runtime-mutable config.
+
+Mirrors the shape of the reference's md_config_t / Option machinery
+(src/common/options.cc ~1,338 entries; src/common/config.cc): each option
+has a type, default, and optional bounds; values can be set from kwargs,
+dicts, or at runtime ("injectargs"), and observers are notified on change
+(md_config_obs_t semantics).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+
+@dataclass(frozen=True)
+class Option:
+    name: str
+    type: type
+    default: Any
+    desc: str = ""
+    min: Optional[float] = None
+    max: Optional[float] = None
+
+
+OPTIONS: List[Option] = [
+    # messenger
+    Option("ms_type", str, "async", "messenger transport"),
+    Option("ms_bind_host", str, "127.0.0.1"),
+    Option("ms_connect_timeout", float, 5.0),
+    # osd
+    Option("osd_heartbeat_interval", float, 0.5, "peer ping period (s)"),
+    Option("osd_heartbeat_grace", float, 2.0, "grace before failure report"),
+    Option("osd_pool_default_size", int, 3, min=1, max=16),
+    Option("osd_pool_default_min_size", int, 2, min=1),
+    Option("osd_pool_default_pg_num", int, 32, min=1),
+    Option("osd_recovery_delay_start", float, 0.0),
+    Option("osd_client_op_timeout", float, 10.0),
+    Option("osd_map_cache_size", int, 50),
+    # mon
+    Option("mon_osd_down_out_interval", float, 30.0,
+           "auto-out after down this long"),
+    Option("mon_osd_min_down_reporters", int, 1),
+    Option("mon_tick_interval", float, 0.5),
+    # ec
+    Option("osd_ec_batch_size", int, 64, "stripes per device dispatch"),
+    Option("osd_ec_stripe_unit", int, 4096),
+    # store
+    Option("memstore_device_bytes", int, 1 << 30),
+    Option("bluestore_csum_type", str, "crc32c"),
+    # debug
+    Option("debug_ms", int, 0, min=0, max=20),
+    Option("debug_osd", int, 0, min=0, max=20),
+    Option("debug_mon", int, 0, min=0, max=20),
+]
+
+_BY_NAME = {o.name: o for o in OPTIONS}
+
+
+class Config:
+    def __init__(self, **overrides):
+        self._values: Dict[str, Any] = {o.name: o.default for o in OPTIONS}
+        self._observers: List[Callable[[str, Any], None]] = []
+        for k, v in overrides.items():
+            self.set(k, v)
+
+    def get(self, name: str):
+        return self._values[name]
+
+    def __getattr__(self, name: str):
+        values = object.__getattribute__(self, "_values")
+        if name in values:
+            return values[name]
+        raise AttributeError(name)
+
+    def set(self, name: str, value) -> None:
+        opt = _BY_NAME.get(name)
+        if opt is None:
+            raise KeyError(f"unknown option {name}")
+        value = opt.type(value)
+        if opt.min is not None and value < opt.min:
+            raise ValueError(f"{name}={value} below min {opt.min}")
+        if opt.max is not None and value > opt.max:
+            raise ValueError(f"{name}={value} above max {opt.max}")
+        self._values[name] = value
+        for obs in self._observers:
+            obs(name, value)
+
+    def injectargs(self, args: Dict[str, Any]) -> None:
+        """Runtime mutation (reference injectargs admin command)."""
+        for k, v in args.items():
+            self.set(k, v)
+
+    def add_observer(self, fn: Callable[[str, Any], None]) -> None:
+        self._observers.append(fn)
+
+    def show(self) -> Dict[str, Any]:
+        return dict(self._values)

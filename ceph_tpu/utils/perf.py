@@ -1,0 +1,75 @@
+"""Perf counters (reference src/common/perf_counters.cc).
+
+Per-daemon registry of named counters: u64 counters, time sums, and
+long-running averages (avgcount/sum pairs), dumped as JSON-able dicts — the
+"perf dump" admin-socket surface.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+
+class PerfCounters:
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = {}
+        self._avgs: Dict[str, list] = {}  # name -> [count, sum]
+
+    def inc(self, name: str, amount: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + amount
+
+    def set(self, name: str, value: int) -> None:
+        with self._lock:
+            self._counters[name] = value
+
+    def tinc(self, name: str, seconds: float) -> None:
+        """Time/average counter (avgcount + sum, like PERFCOUNTER_TIME)."""
+        with self._lock:
+            entry = self._avgs.setdefault(name, [0, 0.0])
+            entry[0] += 1
+            entry[1] += seconds
+
+    def time(self, name: str):
+        """Context manager timing a block into a tinc counter."""
+        perf = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                perf.tinc(name, time.perf_counter() - self.t0)
+                return False
+
+        return _Timer()
+
+    def dump(self) -> Dict:
+        with self._lock:
+            out: Dict = dict(self._counters)
+            for k, (count, total) in self._avgs.items():
+                out[k] = {"avgcount": count, "sum": total}
+            return {self.name: out}
+
+
+class PerfCountersCollection:
+    """Registry of all PerfCounters in a daemon (perf dump aggregates)."""
+
+    def __init__(self):
+        self._all: Dict[str, PerfCounters] = {}
+
+    def create(self, name: str) -> PerfCounters:
+        pc = PerfCounters(name)
+        self._all[name] = pc
+        return pc
+
+    def dump(self) -> Dict:
+        out: Dict = {}
+        for pc in self._all.values():
+            out.update(pc.dump())
+        return out
