@@ -4,12 +4,15 @@ The RH/LH halves of the LUT follow exact closed forms (verified entry-by-
 entry against the reference table):
 
     RH[k] = ceil(2^48 * 128 / (128 + k))      k = 0..128
-    LH[k] = floor(2^48 * log2(1 + k/128))
+    LH[k] = floor(2^48 * log2(1 + k/128))     k = 0..127
 
-(float64 log2 reproduces every LH entry exactly; spot values are pinned in
-tests).  The LL half is pinned in _ll_table.py: the deployed table deviates
-from its documented formula for most entries, and bit-compatible placement
-requires the deployed values.
+with ONE deployed deviation: LH[128] in crush_ln_table.h is 0xffff00000000,
+not the closed form's 2^48 (a rounding artifact of whatever script generated
+the deployed table).  Entry 128 is reached whenever a straw2 16-bit draw is
+0xFFFF, so bit-compatible placement requires the deployed value — it is
+pinned below.  The LL half is pinned in _ll_table.py: the deployed table
+deviates from its documented formula for most entries, and bit-compatible
+placement requires the deployed values.
 """
 
 from __future__ import annotations
@@ -24,6 +27,7 @@ def _gen_rh_lh():
     for k in range(129):
         rh.append(-(-(2**48 * 128) // (128 + k)))  # exact ceil
         lh.append(math.floor((2**48) * math.log2(1 + k / 128)))
+    lh[128] = 0xFFFF00000000  # deployed-table deviation from the closed form
     return tuple(rh), tuple(lh)
 
 

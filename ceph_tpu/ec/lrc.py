@@ -1,0 +1,417 @@
+"""LRC: layered locally-repairable codes.
+
+Behavioral mirror of reference src/erasure-code/lrc/ErasureCodeLrc.{h,cc}:
+a stack of layers, each a chunk-subset delegation to another EC plugin
+(struct Layer, ErasureCodeLrc.h:51-61), profile either explicit
+mapping+layers JSON or generated from (k, m, l) (parse_kml,
+ErasureCodeLrc.cc:295), locality-aware minimum_to_decode
+(ErasureCodeLrc.cc:572) so a single erasure reads only its local group,
+and multi-step CRUSH rule generation (rule_steps, ErasureCodeLrc.h:66-75,
+create_rule ErasureCodeLrc.cc).
+
+The compute stays on the TPU: every layer delegates to a MatrixCodec whose
+encode/decode is the MXU bit-matrix matmul — LRC itself only routes chunk
+subsets, exactly like the reference routes bufferlists between plugins.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Mapping, Set
+
+import numpy as np
+
+from ceph_tpu.ec.base import ErasureCode
+from ceph_tpu.ec.interface import ECError, ErasureCodeInterface, ErasureCodeProfile
+
+DEFAULT_KML = "-1"
+
+
+@dataclass
+class Layer:
+    """One LRC layer (reference ErasureCodeLrc.h:51-61)."""
+
+    chunks_map: str
+    profile: ErasureCodeProfile = field(default_factory=dict)
+    erasure_code: ErasureCodeInterface = None
+    data: List[int] = field(default_factory=list)
+    coding: List[int] = field(default_factory=list)
+    chunks: List[int] = field(default_factory=list)
+    chunks_as_set: Set[int] = field(default_factory=set)
+
+
+@dataclass
+class Step:
+    """One generated CRUSH rule step (reference ErasureCodeLrc.h:66-75)."""
+
+    op: str
+    type: str
+    n: int
+
+
+class ErasureCodeLrc(ErasureCode):
+    def __init__(self):
+        super().__init__()
+        self.layers: List[Layer] = []
+        self.chunk_count = 0
+        self.data_chunk_count = 0
+        self.rule_steps: List[Step] = [Step("chooseleaf", "host", 0)]
+
+    # -- profile parsing ----------------------------------------------------
+
+    def _parse_kml(self, profile: ErasureCodeProfile) -> None:
+        """Generate mapping/layers/rule-steps from (k, m, l)
+        (reference parse_kml, ErasureCodeLrc.cc:295)."""
+        k = self.to_int("k", profile, DEFAULT_KML)
+        m = self.to_int("m", profile, DEFAULT_KML)
+        l = self.to_int("l", profile, DEFAULT_KML)
+        if k == -1 and m == -1 and l == -1:
+            return
+        if k == -1 or m == -1 or l == -1:
+            raise ECError(errno.EINVAL,
+                          "all of k, m, l must be set or none of them")
+        for generated in ("mapping", "layers", "crush-steps"):
+            if generated in profile and profile[generated]:
+                raise ECError(
+                    errno.EINVAL,
+                    f"the {generated} parameter cannot be set when k, m, l are set")
+        if (k + m) % l:
+            raise ECError(errno.EINVAL, "k + m must be a multiple of l")
+        local_group_count = (k + m) // l
+        if k % local_group_count:
+            raise ECError(errno.EINVAL, "k must be a multiple of (k + m) / l")
+        if m % local_group_count:
+            raise ECError(errno.EINVAL, "m must be a multiple of (k + m) / l")
+
+        mapping = ""
+        for _ in range(local_group_count):
+            mapping += "D" * (k // local_group_count) + \
+                "_" * (m // local_group_count) + "_"
+        profile["mapping"] = mapping
+
+        layers = [ ]
+        # global layer
+        desc = ""
+        for _ in range(local_group_count):
+            desc += "D" * (k // local_group_count) + \
+                "c" * (m // local_group_count) + "_"
+        layers.append([desc, ""])
+        # local layers
+        for i in range(local_group_count):
+            desc = ""
+            for j in range(local_group_count):
+                if i == j:
+                    desc += "D" * l + "c"
+                else:
+                    desc += "_" * (l + 1)
+            layers.append([desc, ""])
+        profile["layers"] = json.dumps(layers)
+
+        rule_locality = profile.get("crush-locality", "")
+        rule_failure_domain = profile.get("crush-failure-domain", "host")
+        if rule_locality:
+            self.rule_steps = [
+                Step("choose", rule_locality, local_group_count),
+                Step("chooseleaf", rule_failure_domain, l + 1),
+            ]
+        elif rule_failure_domain:
+            self.rule_steps = [Step("chooseleaf", rule_failure_domain, 0)]
+
+    def _parse_rule(self, profile: ErasureCodeProfile) -> None:
+        """crush-steps JSON override (reference parse_rule)."""
+        if not profile.get("crush-steps"):
+            return
+        try:
+            description = json.loads(profile["crush-steps"])
+        except json.JSONDecodeError as e:
+            raise ECError(errno.EINVAL, f"failed to parse crush-steps: {e}")
+        if not isinstance(description, list):
+            raise ECError(errno.EINVAL, "crush-steps must be a JSON array")
+        self.rule_steps = []
+        for entry in description:
+            if not isinstance(entry, list):
+                raise ECError(errno.EINVAL,
+                              "each crush-steps element must be a JSON array")
+            op, type_, n = "", "", 0
+            for pos, v in enumerate(entry):
+                if pos in (0, 1) and not isinstance(v, str):
+                    raise ECError(errno.EINVAL,
+                                  f"crush-steps element {pos} must be a string")
+                if pos == 2 and not isinstance(v, int):
+                    raise ECError(errno.EINVAL,
+                                  "crush-steps element 2 must be an int")
+                if pos == 0:
+                    op = v
+                elif pos == 1:
+                    type_ = v
+                elif pos == 2:
+                    n = v
+            self.rule_steps.append(Step(op, type_, n))
+
+    def _layers_parse(self, profile: ErasureCodeProfile) -> None:
+        """layers JSON -> Layer list (reference layers_parse,
+        ErasureCodeLrc.cc:145)."""
+        if not profile.get("layers"):
+            raise ECError(errno.EINVAL, "could not find 'layers' in profile")
+        try:
+            description = json.loads(profile["layers"])
+        except json.JSONDecodeError as e:
+            raise ECError(errno.EINVAL, f"failed to parse layers: {e}")
+        if not isinstance(description, list):
+            raise ECError(errno.EINVAL, "layers must be a JSON array")
+        self.layers = []
+        for position, entry in enumerate(description):
+            if not isinstance(entry, list):
+                raise ECError(
+                    errno.EINVAL,
+                    f"layers element at position {position} must be a JSON array")
+            if not entry or not isinstance(entry[0], str):
+                raise ECError(
+                    errno.EINVAL,
+                    f"the first element of layers entry {position} must be a string")
+            layer = Layer(chunks_map=entry[0])
+            if len(entry) > 1:
+                config = entry[1]
+                if isinstance(config, str):
+                    if config:
+                        try:
+                            layer.profile = {
+                                str(a): str(b)
+                                for a, b in json.loads(config).items()
+                            }
+                        except (json.JSONDecodeError, AttributeError) as e:
+                            raise ECError(errno.EINVAL,
+                                          f"bad layer config {config!r}: {e}")
+                elif isinstance(config, dict):
+                    layer.profile = {str(a): str(b) for a, b in config.items()}
+                else:
+                    raise ECError(
+                        errno.EINVAL,
+                        f"the second element of layers entry {position} "
+                        "must be a string or object")
+            # trailing elements ignored, like the reference
+            self.layers.append(layer)
+
+    def _layers_init(self) -> None:
+        """Resolve chunk positions + instantiate per-layer codecs
+        (reference layers_init, ErasureCodeLrc.cc:215)."""
+        from ceph_tpu.ec.registry import ErasureCodePluginRegistry
+
+        registry = ErasureCodePluginRegistry.instance()
+        for layer in self.layers:
+            layer.data = [i for i, c in enumerate(layer.chunks_map) if c == "D"]
+            layer.coding = [i for i, c in enumerate(layer.chunks_map) if c == "c"]
+            layer.chunks = layer.data + layer.coding
+            layer.chunks_as_set = set(layer.chunks)
+            layer.profile.setdefault("k", str(len(layer.data)))
+            layer.profile.setdefault("m", str(len(layer.coding)))
+            layer.profile.setdefault("plugin", "jerasure")
+            layer.profile.setdefault("technique", "reed_sol_van")
+            layer.erasure_code = registry.factory(
+                layer.profile["plugin"], layer.profile)
+
+    def _layers_sanity_checks(self) -> None:
+        if len(self.layers) < 1:
+            raise ECError(errno.EINVAL, "layers must have at least one entry")
+        for position, layer in enumerate(self.layers):
+            if len(layer.chunks_map) != self.chunk_count:
+                raise ECError(
+                    errno.EINVAL,
+                    f"layer {position} chunks_map {layer.chunks_map!r} must be "
+                    f"{self.chunk_count} characters long")
+
+    def init(self, profile: ErasureCodeProfile) -> None:
+        # ordering mirrors reference ErasureCodeLrc::init (:496-553)
+        self._parse_kml(profile)
+        self.rule_root = self.to_string("crush-root", profile, "default")
+        self.rule_failure_domain = self.to_string(
+            "crush-failure-domain", profile, "host")
+        self.rule_device_class = self.to_string("crush-device-class", profile, "")
+        self._parse_rule(profile)
+        self._layers_parse(profile)
+        self._layers_init()
+        if not profile.get("mapping"):
+            raise ECError(errno.EINVAL, "the 'mapping' profile is missing")
+        mapping = profile["mapping"]
+        self.data_chunk_count = mapping.count("D")
+        self.chunk_count = len(mapping)
+        self._layers_sanity_checks()
+        self.to_mapping(profile)
+        # kml-generated parameters are internal; do not expose them
+        # (reference :545-550)
+        if profile.get("l") and profile["l"] != DEFAULT_KML:
+            profile.pop("mapping", None)
+            profile.pop("layers", None)
+        self._profile = profile
+
+    # -- geometry -----------------------------------------------------------
+
+    def get_chunk_count(self) -> int:
+        return self.chunk_count
+
+    def get_data_chunk_count(self) -> int:
+        return self.data_chunk_count
+
+    def get_chunk_size(self, object_size: int) -> int:
+        return self.layers[0].erasure_code.get_chunk_size(object_size)
+
+    # -- minimum_to_decode (the locality win) -------------------------------
+
+    def minimum_to_decode(
+        self, want_to_read: Set[int], available_chunks: Set[int]
+    ) -> Set[int]:
+        """Reference ErasureCodeLrc::minimum_to_decode (:572): recover
+        erasures with as few chunks as possible, preferring the lowest
+        (most local) layers; on a single local erasure the read set is the
+        local group, not k chunks."""
+        erasures_total = set()
+        erasures_not_recovered = set()
+        erasures_want = set()
+        for i in range(self.get_chunk_count()):
+            if i not in available_chunks:
+                erasures_total.add(i)
+                erasures_not_recovered.add(i)
+                if i in want_to_read:
+                    erasures_want.add(i)
+
+        # Case 1: nothing wanted is missing
+        if not erasures_want:
+            return set(want_to_read)
+
+        # Case 2: recover wanted erasures bottom-up (local layers last in
+        # the list, reverse iteration visits them first)
+        minimum: Set[int] = set()
+        for layer in reversed(self.layers):
+            layer_want = want_to_read & layer.chunks_as_set
+            if not layer_want:
+                continue
+            layer_erasures = layer_want & erasures_want
+            if not layer_erasures:
+                minimum |= layer_want
+                continue
+            erasures = layer.chunks_as_set & erasures_not_recovered
+            if len(erasures) > layer.erasure_code.get_coding_chunk_count():
+                # too many erasures for this layer: hope an upper layer helps
+                continue
+            layer_minimum = layer.chunks_as_set - erasures_not_recovered
+            for j in erasures:
+                erasures_not_recovered.discard(j)
+                erasures_want.discard(j)
+            minimum |= layer_minimum
+        if not erasures_want:
+            minimum |= want_to_read
+            minimum -= erasures_total
+            return minimum
+
+        # Case 3: recover everything recoverable, layer by layer, and read
+        # all available chunks
+        erasures_total = {
+            i for i in range(self.get_chunk_count()) if i not in available_chunks
+        }
+        for layer in reversed(self.layers):
+            layer_erasures = layer.chunks_as_set & erasures_total
+            if not layer_erasures:
+                continue
+            if len(layer_erasures) <= layer.erasure_code.get_coding_chunk_count():
+                erasures_total -= layer_erasures
+        if not erasures_total:
+            return set(available_chunks)
+
+        raise ECError(errno.EIO,
+                      f"not enough chunks in {sorted(available_chunks)} "
+                      f"to read {sorted(want_to_read)}")
+
+    # -- encode / decode ----------------------------------------------------
+
+    def encode_chunks(self, chunks: Dict[int, np.ndarray]) -> None:
+        """Apply every layer in order: the global layer fills the global
+        parities, then each local layer its local parity (reference
+        encode_chunks, ErasureCodeLrc.cc:744 with want = all chunks)."""
+        for layer in self.layers:
+            layer_chunks = {
+                j: chunks[c] for j, c in enumerate(layer.chunks)
+            }
+            layer.erasure_code.encode_chunks(layer_chunks)
+
+    def decode_chunks(
+        self,
+        want_to_read: Set[int],
+        chunks: Mapping[int, np.ndarray],
+        decoded: Dict[int, np.ndarray],
+    ) -> None:
+        """Reference decode_chunks (ErasureCodeLrc.cc:782): walk layers
+        bottom-up; each successful layer decode improves ``decoded`` and
+        shrinks the erasure set for the layers above."""
+        erasures = {
+            i for i in range(self.get_chunk_count()) if i not in chunks
+        }
+        want_to_read_erasures = erasures & want_to_read
+        for layer in reversed(self.layers):
+            layer_erasures = layer.chunks_as_set & erasures
+            if len(layer_erasures) > layer.erasure_code.get_coding_chunk_count():
+                continue  # too many erasures for this layer
+            if not layer_erasures:
+                continue  # all of this layer's chunks already available
+            layer_want: Set[int] = set()
+            layer_chunks: Dict[int, np.ndarray] = {}
+            layer_decoded: Dict[int, np.ndarray] = {}
+            for j, c in enumerate(layer.chunks):
+                # pick from `decoded` (not `chunks`) to reuse chunks
+                # recovered by previous layers
+                if c not in erasures:
+                    layer_chunks[j] = decoded[c]
+                if c in want_to_read:
+                    layer_want.add(j)
+                layer_decoded[j] = decoded[c]
+            layer.erasure_code.decode_chunks(
+                layer_want, layer_chunks, layer_decoded)
+            for j, c in enumerate(layer.chunks):
+                decoded[c][...] = layer_decoded[j]
+                erasures.discard(c)
+            want_to_read_erasures = erasures & want_to_read
+            if not want_to_read_erasures:
+                break
+        if want_to_read_erasures:
+            raise ECError(errno.EIO,
+                          f"unable to read {sorted(want_to_read_erasures)}")
+
+    # -- CRUSH rule generation ----------------------------------------------
+
+    def create_rule(self, name: str, cmap) -> int:
+        """Generate the multi-step indep rule (reference create_rule):
+        SET_CHOOSELEAF_TRIES 5, SET_CHOOSE_TRIES 100, TAKE root, then one
+        CHOOSE/CHOOSELEAF_INDEP per rule_step, then EMIT."""
+        from ceph_tpu.crush import types as ct
+
+        root = None
+        for item_id, item_name in cmap.item_names.items():
+            if item_name == self.rule_root:
+                root = item_id
+                break
+        if root is None:
+            raise ECError(errno.ENOENT,
+                          f"root item {self.rule_root} does not exist")
+        type_ids = {v: k for k, v in cmap.type_names.items()}
+        steps = [
+            (ct.RULE_SET_CHOOSELEAF_TRIES, 5, 0),
+            (ct.RULE_SET_CHOOSE_TRIES, 100, 0),
+            (ct.RULE_TAKE, root, 0),
+        ]
+        for s in self.rule_steps:
+            op = (ct.RULE_CHOOSELEAF_INDEP if s.op == "chooseleaf"
+                  else ct.RULE_CHOOSE_INDEP)
+            if s.type not in type_ids:
+                raise ECError(errno.EINVAL, f"unknown crush type {s.type}")
+            steps.append((op, s.n, type_ids[s.type]))
+        steps.append((ct.RULE_EMIT, 0, 0))
+        return cmap.add_rule(
+            ct.Rule(steps=steps, type=3, min_size=3,
+                    max_size=self.get_chunk_count()))
+
+
+def make_lrc(profile: ErasureCodeProfile):
+    codec = ErasureCodeLrc()
+    codec.init(profile)
+    return codec

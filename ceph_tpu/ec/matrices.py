@@ -63,11 +63,25 @@ def _systematize_vandermonde(v: np.ndarray) -> np.ndarray:
 
 
 def reed_sol_vandermonde_coding_matrix(k: int, m: int) -> np.ndarray:
-    """(m, k) coding matrix: systematized extended Vandermonde, bottom m rows."""
+    """(m, k) coding matrix: systematized extended Vandermonde, bottom m rows.
+
+    After systematization, jerasure's reed_sol_big_vandermonde_distribution_
+    matrix ends by scaling each parity *column* by the inverse of its first-
+    parity-row entry so row 0 of the coding block is all ones (making the
+    first parity a plain XOR).  Column scaling by nonzero constants preserves
+    the MDS property; omitting it produced parity bytes incompatible with
+    jerasure for k >= 4.
+    """
     v = reed_sol_extended_vandermonde(k + m, k)
     v = _systematize_vandermonde(v)
     assert np.array_equal(v[:k], np.eye(k, dtype=np.uint8))
-    return v[k:]
+    coding = v[k:].copy()
+    for j in range(k):
+        e = int(coding[0, j])
+        if e not in (0, 1):
+            coding[:, j] = gf8.gf_mul(coding[:, j], gf8.gf_inv(e))
+    assert np.all(coding[0] == 1), "first parity row must be all ones"
+    return coding
 
 
 def reed_sol_r6_coding_matrix(k: int) -> np.ndarray:

@@ -1,0 +1,383 @@
+"""SHEC: shingled erasure codes (k, m, c).
+
+Behavioral mirror of reference src/erasure-code/shec/ErasureCodeShec.{h,cc}
+and ErasureCodePluginShec.cc: a Vandermonde RS matrix with a shingle pattern
+of zeros (shec_reedsolomon_coding_matrix, ErasureCodeShec.cc:456), the
+(m1, c1, m2, c2) split chosen by the recovery-efficiency metric
+(shec_calc_recovery_efficiency1, :415), per-erasure-pattern decode via a
+minimal-subset search over parity combinations + GF Gaussian elimination
+(shec_make_decoding_matrix, :526), and a decode-table cache keyed by the
+(want, avails) pattern (ErasureCodeShecTableCache).
+
+Tolerates up to ``c`` erasures while reading fewer chunks than a full-k MDS
+decode — the "shingle" rows overlap so each data chunk is covered by a
+cheap local-ish parity.  Encode is the standard bytewise GF(2^8) matrix
+multiply, so the TPU MXU bit-matrix path serves it unchanged; only
+decode-matrix *construction* differs from MDS codes and stays on the host
+(k x k bytes).
+"""
+
+from __future__ import annotations
+
+import errno
+from typing import Dict, List, Mapping, Set, Tuple
+
+import numpy as np
+
+from ceph_tpu.ec import matrices
+from ceph_tpu.ec.codec import MatrixCodec
+from ceph_tpu.ec.interface import ECError, ErasureCodeProfile
+from ceph_tpu.ops import gf8
+
+MULTIPLE = 0
+SINGLE = 1
+
+LARGEST_VECTOR_WORDSIZE = 16
+
+
+def _calc_recovery_efficiency1(k: int, m1: int, m2: int, c1: int, c2: int) -> float:
+    """Reference shec_calc_recovery_efficiency1 (ErasureCodeShec.cc:415)."""
+    if m1 < c1 or m2 < c2:
+        return -1.0
+    if (m1 == 0 and c1 != 0) or (m2 == 0 and c2 != 0):
+        return -1.0
+    r_eff_k = [100000000] * k
+    r_e1 = 0.0
+    for rr in range(m1):
+        start = ((rr * k) // m1) % k
+        end = (((rr + c1) * k) // m1) % k
+        cc = start
+        first = True
+        while first or cc != end:
+            first = False
+            r_eff_k[cc] = min(r_eff_k[cc],
+                              ((rr + c1) * k) // m1 - (rr * k) // m1)
+            cc = (cc + 1) % k
+        r_e1 += ((rr + c1) * k) // m1 - (rr * k) // m1
+    for rr in range(m2):
+        start = ((rr * k) // m2) % k
+        end = (((rr + c2) * k) // m2) % k
+        cc = start
+        first = True
+        while first or cc != end:
+            first = False
+            r_eff_k[cc] = min(r_eff_k[cc],
+                              ((rr + c2) * k) // m2 - (rr * k) // m2)
+            cc = (cc + 1) % k
+        r_e1 += ((rr + c2) * k) // m2 - (rr * k) // m2
+    r_e1 += sum(r_eff_k)
+    return r_e1 / (k + m1 + m2)
+
+
+def shec_coding_matrix(k: int, m: int, c: int, technique: int) -> np.ndarray:
+    """Shingled (m, k) coding matrix (reference
+    shec_reedsolomon_coding_matrix, ErasureCodeShec.cc:456): a Vandermonde
+    RS matrix with shingle-patterned zeros."""
+    if technique == MULTIPLE:
+        c1_best, m1_best = -1, -1
+        min_r_e1 = 100.0
+        for c1 in range(c // 2 + 1):
+            for m1 in range(m + 1):
+                c2 = c - c1
+                m2 = m - m1
+                if m1 < c1 or m2 < c2:
+                    continue
+                if (m1 == 0 and c1 != 0) or (m2 == 0 and c2 != 0):
+                    continue
+                if (m1 != 0 and c1 == 0) or (m2 != 0 and c2 == 0):
+                    continue
+                r_e1 = _calc_recovery_efficiency1(k, m1, m2, c1, c2)
+                if min_r_e1 - r_e1 > np.finfo(float).eps and r_e1 < min_r_e1:
+                    min_r_e1 = r_e1
+                    c1_best, m1_best = c1, m1
+        m1, c1 = m1_best, c1_best
+        m2, c2 = m - m1_best, c - c1_best
+    else:
+        m1, c1 = 0, 0
+        m2, c2 = m, c
+
+    mat = matrices.reed_sol_vandermonde_coding_matrix(k, m).astype(np.uint8)
+    for rr in range(m1):
+        end = ((rr * k) // m1) % k
+        start = (((rr + c1) * k) // m1) % k
+        cc = start
+        while cc != end:
+            mat[rr, cc] = 0
+            cc = (cc + 1) % k
+    for rr in range(m2):
+        end = ((rr * k) // m2) % k
+        start = (((rr + c2) * k) // m2) % k
+        cc = start
+        while cc != end:
+            mat[rr + m1, cc] = 0
+            cc = (cc + 1) % k
+    return mat
+
+
+class ErasureCodeShec(MatrixCodec):
+    DEFAULT_K = 4
+    DEFAULT_M = 3
+    DEFAULT_C = 2
+
+    def __init__(self, technique: int = MULTIPLE):
+        super().__init__()
+        self.technique = technique
+        self.c = 0
+        # decode-plan cache keyed by (want, avails) bit patterns
+        # (ErasureCodeShecTableCache semantics)
+        self._plan_cache: Dict[Tuple, Tuple] = {}
+
+    # -- profile ------------------------------------------------------------
+
+    def parse(self, profile: ErasureCodeProfile) -> None:
+        super().parse(profile)
+        has = [name in profile and profile[name] for name in ("k", "m", "c")]
+        if not any(has):
+            self.k, self.m, self.c = self.DEFAULT_K, self.DEFAULT_M, self.DEFAULT_C
+        elif not all(has):
+            raise ECError(errno.EINVAL, "(k, m, c) must all be chosen")
+        else:
+            try:
+                self.k = int(profile["k"])
+                self.m = int(profile["m"])
+                self.c = int(profile["c"])
+            except ValueError as e:
+                raise ECError(errno.EINVAL, f"bad k/m/c: {e}")
+        k, m, c = self.k, self.m, self.c
+        if k <= 0 or m <= 0 or c <= 0:
+            raise ECError(errno.EINVAL, "k, m, c must be positive")
+        if m < c:
+            raise ECError(errno.EINVAL, f"c={c} must be <= m={m}")
+        if k > 12:
+            raise ECError(errno.EINVAL, f"k={k} must be <= 12")
+        if k + m > 20:
+            raise ECError(errno.EINVAL, f"k+m={k+m} must be <= 20")
+        if k < m:
+            raise ECError(errno.EINVAL, f"m={m} must be <= k={k}")
+        w = profile.get("w")
+        self.w = 8
+        if w:
+            try:
+                wv = int(w)
+            except ValueError:
+                wv = 8
+            if wv not in (8, 16, 32):
+                wv = 8  # reference falls back to the default, no error
+            if wv != 8:
+                raise NotImplementedError("tpu shec supports w=8")
+            self.w = wv
+
+    def get_alignment(self) -> int:
+        # reference ErasureCodeShecReedSolomonVandermonde::get_alignment:
+        # k * w * sizeof(int)
+        return self.k * self.w * 4
+
+    def get_chunk_size(self, object_size: int) -> int:
+        alignment = self.get_alignment()
+        tail = object_size % alignment
+        padded = object_size + (alignment - tail if tail else 0)
+        assert padded % self.k == 0
+        return padded // self.k
+
+    def build_coding_matrix(self) -> np.ndarray:
+        return shec_coding_matrix(self.k, self.m, self.c, self.technique)
+
+    # -- decode-plan search (reference shec_make_decoding_matrix, :526) -----
+
+    def _make_decoding_plan(self, want: List[int], avails: List[int]):
+        """Returns (srcs, cols, inv, minimum):
+        srcs — chunk ids whose values feed the solve (rows of the system),
+        cols — data chunk ids solved for (columns),
+        inv  — GF inverse of the system matrix (None when nothing to solve),
+        minimum — minimal chunk-id set to read.
+        Raises ECError(EIO) when the pattern is unrecoverable."""
+        k, m = self.k, self.m
+        matrix = self.engine.coding
+        want = list(want)
+        # to re-encode a wanted erased parity, all data in its support is wanted
+        for i in range(m):
+            if want[k + i] and not avails[k + i]:
+                for j in range(k):
+                    if matrix[i, j] > 0:
+                        want[j] = 1
+
+        key = (tuple(want), tuple(avails))
+        cached = self._plan_cache.get(key)
+        if cached is not None:
+            return cached
+
+        mindup = k + 1
+        minp = k + 1
+        best_srcs: List[int] = []
+        best_cols: List[int] = []
+        best_inv = None
+        for pp in range(1 << m):
+            p = [i for i in range(m) if pp & (1 << i)]
+            ek = len(p)
+            if ek > minp:
+                continue
+            if not all(avails[k + i] for i in p):
+                continue
+            tmprow = [0] * (k + m)
+            tmpcolumn = [0] * k
+            for i in range(k):
+                if want[i] and not avails[i]:
+                    tmpcolumn[i] = 1
+            for i in p:
+                tmprow[k + i] = 1
+                for j in range(k):
+                    element = int(matrix[i, j])
+                    if element != 0:
+                        tmpcolumn[j] = 1
+                        if avails[j] == 1:
+                            tmprow[j] = 1
+            dup_row = sum(tmprow)
+            dup_column = sum(tmpcolumn)
+            if dup_row != dup_column:
+                continue
+            dup = dup_row
+            if dup == 0:
+                mindup = 0
+                best_srcs, best_cols, best_inv = [], [], None
+                break
+            if dup < mindup:
+                srcs = [i for i in range(k + m) if tmprow[i]]
+                cols = [j for j in range(k) if tmpcolumn[j]]
+                tmpmat = np.zeros((dup, dup), dtype=np.uint8)
+                for r, i in enumerate(srcs):
+                    for cidx, j in enumerate(cols):
+                        if i < k:
+                            tmpmat[r, cidx] = 1 if i == j else 0
+                        else:
+                            tmpmat[r, cidx] = matrix[i - k, j]
+                try:
+                    inv = gf8.gf_invert_matrix(tmpmat)
+                except gf8.SingularMatrixError:
+                    continue  # singular: determinant is zero, reject
+                mindup = dup
+                best_srcs, best_cols, best_inv = srcs, cols, inv
+                minp = ek
+
+        if mindup == k + 1:
+            raise ECError(errno.EIO, "shec: can't find recover matrix")
+
+        minimum = set(best_srcs)
+        for i in range(k):
+            if want[i] and avails[i]:
+                minimum.add(i)
+        for i in range(m):
+            if want[k + i] and avails[k + i] and (k + i) not in minimum:
+                for j in range(k):
+                    if matrix[i, j] > 0 and not want[j]:
+                        minimum.add(k + i)
+                        break
+
+        plan = (best_srcs, best_cols, best_inv, minimum)
+        self._plan_cache[key] = plan
+        return plan
+
+    # -- interface ----------------------------------------------------------
+
+    def minimum_to_decode(
+        self, want_to_read: Set[int], available_chunks: Set[int]
+    ) -> Set[int]:
+        n = self.k + self.m
+        for s in (want_to_read, available_chunks):
+            for i in s:
+                if i < 0 or i >= n:
+                    raise ECError(errno.EINVAL, f"bad chunk id {i}")
+        want = [1 if i in want_to_read else 0 for i in range(n)]
+        avails = [1 if i in available_chunks else 0 for i in range(n)]
+        _, _, _, minimum = self._make_decoding_plan(want, avails)
+        return set(minimum)
+
+    def decode_chunks(
+        self,
+        want_to_read: Set[int],
+        chunks: Mapping[int, np.ndarray],
+        decoded: Dict[int, np.ndarray],
+    ) -> None:
+        """Reference shec_matrix_decode (ErasureCodeShec.cc:756): solve the
+        minimal system for erased wanted data chunks, then re-encode erased
+        wanted parities from the (now complete) data row."""
+        k, m = self.k, self.m
+        n = k + m
+        avails = [1 if i in chunks else 0 for i in range(n)]
+        want = [1 if (i in want_to_read and i not in chunks) else 0
+                for i in range(n)]
+        if not any(want):
+            return
+        srcs, cols, inv, _ = self._make_decoding_plan(want, avails)
+        if inv is not None and srcs:
+            src_data = np.stack([
+                np.asarray(decoded[i], dtype=np.uint8) for i in srcs
+            ])
+            # reconstruct only the erased columns; available ones are
+            # already in `decoded`
+            out_rows = [ci for ci, j in enumerate(cols) if not avails[j]]
+            if out_rows:
+                rmat = inv[out_rows]
+                out = np.asarray(gf8.gf_matmul_ref(rmat, src_data)) \
+                    if src_data.shape[1] < 4096 else self._device_matmul(
+                        rmat, src_data)
+                for idx, ci in enumerate(out_rows):
+                    decoded[cols[ci]][...] = out[idx]
+        # re-encode wanted erased parity chunks from complete data
+        parity_want = [i for i in range(m) if want[k + i]]
+        if parity_want:
+            data = np.stack([
+                np.asarray(decoded[i], dtype=np.uint8) for i in range(k)
+            ])
+            rows = self.engine.coding[parity_want]
+            out = np.asarray(gf8.gf_matmul_ref(rows, data)) \
+                if data.shape[1] < 4096 else self._device_matmul(rows, data)
+            for idx, i in enumerate(parity_want):
+                decoded[k + i][...] = out[idx]
+
+    def _device_matmul(self, rmat: np.ndarray, data: np.ndarray) -> np.ndarray:
+        import jax.numpy as jnp
+
+        from ceph_tpu.ec.codec import _encode_cols
+
+        bitmat = jnp.asarray(gf8.expand_bitmatrix(rmat))
+        return np.asarray(_encode_cols(bitmat, jnp.asarray(data)))
+
+    def decode_batch(self, erasures: Tuple[int, ...], chunks) -> np.ndarray:
+        """Batched single-pattern reconstruction on device: build the plan
+        once, apply the recovery matrix to the whole stripe batch."""
+        import jax.numpy as jnp
+
+        from ceph_tpu.ec.codec import _encode_batch_jit
+
+        n = self.k + self.m
+        avails = [0 if i in erasures else 1 for i in range(n)]
+        want = [1 if i in erasures else 0 for i in range(n)]
+        srcs, cols, inv, _ = self._make_decoding_plan(want, avails)
+        rows = []
+        src_list = list(srcs)
+        for e in erasures:
+            if e < self.k:
+                ci = cols.index(e)
+                rows.append(inv[ci])
+            else:
+                # parity: compose coding row with data recovery
+                raise NotImplementedError("batched parity recovery")
+        rmat = np.stack(rows).astype(np.uint8)
+        bitmat = jnp.asarray(gf8.expand_bitmatrix(rmat))
+        data = jnp.asarray(chunks)[:, src_list, :]
+        return _encode_batch_jit(bitmat, data)
+
+
+def make_shec(profile: ErasureCodeProfile):
+    technique_name = profile.get("technique") or "multiple"
+    profile["technique"] = technique_name
+    if technique_name == "multiple":
+        technique = MULTIPLE
+    elif technique_name == "single":
+        technique = SINGLE
+    else:
+        raise ECError(errno.ENOENT,
+                      f"technique={technique_name} is not a valid coding technique")
+    codec = ErasureCodeShec(technique)
+    codec.init(profile)
+    return codec

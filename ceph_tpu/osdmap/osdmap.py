@@ -206,9 +206,12 @@ class OSDMap:
         pg = PGid(pgid.pool, pool.raw_pg_to_pg(pgid.seed))
         um = self.pg_upmap.get(pg)
         if um is not None:
-            if not any(o != CRUSH_ITEM_NONE and o < self.max_osd
-                       and self.osd_weight[o] == 0 for o in um):
-                raw = list(um)
+            if any(o != CRUSH_ITEM_NONE and 0 <= o < self.max_osd
+                   and self.osd_weight[o] == 0 for o in um):
+                # a target is marked out: reject the explicit mapping and,
+                # like the reference (OSDMap.cc:1899), skip pg_upmap_items too
+                return raw
+            raw = list(um)
         for src, dst in self.pg_upmap_items.get(pg, []):
             exists_already = False
             pos = -1
@@ -217,7 +220,7 @@ class OSDMap:
                     exists_already = True
                     break
                 if o == src and pos < 0 and not (
-                        dst != CRUSH_ITEM_NONE and dst < self.max_osd
+                        dst != CRUSH_ITEM_NONE and 0 <= dst < self.max_osd
                         and self.osd_weight[dst] == 0):
                     pos = i
             if not exists_already and pos >= 0:
@@ -294,9 +297,13 @@ class OSDMap:
         up_primary = self._pick_primary(up)
         up, up_primary = self._apply_primary_affinity(pps, pool, up, up_primary)
         if not acting:
-            acting, acting_primary = up, up_primary
-        elif acting_primary == -1:
-            acting_primary = self._pick_primary(acting)
+            acting = up
+            # the up_primary fallback happens only inside the empty-acting
+            # branch, so a standalone primary_temp (no pg_temp) survives and
+            # an all-down pg_temp keeps acting_primary == -1 (reference
+            # _pg_to_up_acting_osds, OSDMap.cc:2110-2116)
+            if acting_primary == -1:
+                acting_primary = up_primary
         return up, up_primary, acting, acting_primary
 
     # -- whole-pool batched placement --------------------------------------

@@ -22,7 +22,12 @@ from ceph_tpu.ops import gf8
 
 def make_mesh(n_devices: int | None = None, shard_axis: int | None = None) -> Mesh:
     """Build a ('data', 'shard') mesh over the first n devices."""
-    devices = jax.devices()
+    try:
+        devices = jax.devices()
+    except RuntimeError:
+        # default platform failed to initialize entirely (e.g. a libtpu
+        # version skew): the virtual CPU mesh is still usable
+        devices = jax.devices("cpu")
     if n_devices is None:
         n_devices = len(devices)
     if len(devices) < n_devices:
@@ -57,14 +62,18 @@ def distributed_ec_step(mesh: Mesh, k: int, m: int, batch: int, chunk: int):
 
     from ceph_tpu.ec import matrices
 
+    # Keep the matrices as host numpy: they become jit-time constants on the
+    # mesh's backend.  jnp.asarray here would commit them to the *default*
+    # backend, which may be a different platform than the mesh (the round-1
+    # multichip dryrun crashed exactly this way: CPU mesh, TPU default).
     coding = matrices.isa_rs_matrix(k, m)
-    enc_bitmat = jnp.asarray(gf8.expand_bitmatrix(coding))
+    enc_bitmat = gf8.expand_bitmatrix(coding)
     generator = matrices.generator_matrix(coding)
     # static single-erasure recovery: lose shard 0, decode from rows 1..k
     src_rows = tuple(range(1, k + 1))
     sub = generator[list(src_rows)]
     inv = gf8.gf_invert_matrix(sub)
-    rec_bitmat = jnp.asarray(gf8.expand_bitmatrix(inv[0][None, :]))
+    rec_bitmat = gf8.expand_bitmatrix(inv[0][None, :])
 
     data_sharding = NamedSharding(mesh, P("data", None, None))
     chunk_sharding = NamedSharding(mesh, P("data", "shard", None))
@@ -73,7 +82,7 @@ def distributed_ec_step(mesh: Mesh, k: int, m: int, batch: int, chunk: int):
         # data: (batch, k, chunk) uint8, sharded over the stripe batch
         b = data.shape[0]
         cols = data.transpose(1, 0, 2).reshape(k, b * chunk)
-        parity = gf8.bitmatrix_matmul(enc_bitmat, cols)
+        parity = gf8.bitmatrix_matmul(jnp.asarray(enc_bitmat), cols)
         parity = parity.reshape(m, b, chunk).transpose(1, 0, 2)
         chunks = jnp.concatenate([data, parity], axis=1)
         # distribute shards over the shard axis (Ceph: shards to distinct OSDs)
@@ -81,7 +90,7 @@ def distributed_ec_step(mesh: Mesh, k: int, m: int, batch: int, chunk: int):
         # reconstruct shard 0 from k survivors (XLA gathers across 'shard')
         survivors = chunks[:, 1 : k + 1, :]
         scols = survivors.transpose(1, 0, 2).reshape(k, b * chunk)
-        recon = gf8.bitmatrix_matmul(rec_bitmat, scols).reshape(b, chunk)
+        recon = gf8.bitmatrix_matmul(jnp.asarray(rec_bitmat), scols).reshape(b, chunk)
         mismatches = jnp.sum((recon != chunks[:, 0, :]).astype(jnp.int32))
         return mismatches, chunks
 
@@ -93,4 +102,6 @@ def distributed_ec_step(mesh: Mesh, k: int, m: int, batch: int, chunk: int):
     example = np.random.default_rng(0).integers(
         0, 256, (batch, k, chunk), dtype=np.uint8
     )
-    return jitted, (jnp.asarray(example),)
+    # device_put with the mesh sharding: the example lands on the mesh's
+    # devices directly and never touches the default backend.
+    return jitted, (jax.device_put(example, data_sharding),)

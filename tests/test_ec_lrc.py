@@ -1,0 +1,198 @@
+"""LRC plugin tests.
+
+Scenario coverage mirrors the reference's TestErasureCodeLrc.cc: kml profile
+generation, explicit mapping+layers profiles, locality-aware
+minimum_to_decode (single erasure reads only the local group), layered
+encode/decode roundtrips, and rule-step generation.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import factory
+from ceph_tpu.ec.interface import ECError
+from ceph_tpu.ec.lrc import ErasureCodeLrc, make_lrc
+
+
+def test_kml_profile_generation():
+    codec = make_lrc({"k": "4", "m": "2", "l": "3"})
+    # (k+m)/l = 2 local groups of l+1 = 4 slots each
+    assert codec.get_chunk_count() == 8
+    assert codec.get_data_chunk_count() == 4
+    # one global layer + one local layer per group
+    assert len(codec.layers) == 3
+    assert codec.layers[0].chunks_map == "DDc_DDc_"
+    assert codec.layers[1].chunks_map == "DDDc____"
+    assert codec.layers[2].chunks_map == "____DDDc"
+    # kml-generated internals are not exposed through the profile
+    assert "mapping" not in codec.get_profile()
+    assert "layers" not in codec.get_profile()
+
+
+def test_kml_constraint_errors():
+    with pytest.raises(ECError):
+        make_lrc({"k": "4", "m": "2"})  # l missing
+    with pytest.raises(ECError):
+        make_lrc({"k": "4", "m": "2", "l": "5"})  # (k+m) % l != 0
+    with pytest.raises(ECError):
+        make_lrc({"k": "4", "m": "2", "l": "3", "mapping": "DD"})
+
+
+def test_kml_roundtrip_single_erasure():
+    codec = make_lrc({"k": "4", "m": "2", "l": "3"})
+    data = bytes(range(256)) * 13
+    n = codec.get_chunk_count()
+    chunks = codec.encode(range(n), data)
+    assert len(chunks) == n
+    for erase in range(n):
+        avail = {i: c for i, c in chunks.items() if i != erase}
+        decoded = codec.decode({erase}, avail)
+        assert np.array_equal(decoded[erase], chunks[erase]), f"chunk {erase}"
+    assert codec.decode_concat(chunks)[: len(data)] == data
+
+
+def test_kml_roundtrip_double_erasure():
+    codec = make_lrc({"k": "4", "m": "2", "l": "3"})
+    data = np.random.default_rng(7).integers(0, 256, 4096, dtype=np.uint8).tobytes()
+    n = codec.get_chunk_count()
+    chunks = codec.encode(range(n), data)
+    # erase one data chunk in each local group: each local layer recovers its own
+    avail = {i: c for i, c in chunks.items() if i not in (0, 4)}
+    decoded = codec.decode({0, 4}, avail)
+    assert np.array_equal(decoded[0], chunks[0])
+    assert np.array_equal(decoded[4], chunks[4])
+
+
+def test_minimum_to_decode_is_local():
+    """A single erasure must read only the local group's survivors (size l),
+    not k chunks from across the stripe (reference ErasureCodeLrc.cc:572)."""
+    codec = make_lrc({"k": "4", "m": "2", "l": "3"})
+    n = codec.get_chunk_count()
+    # chunk 1 lives in local group {0,1,2,3} (layer map DDDc____)
+    want = {1}
+    avail = set(range(n)) - {1}
+    minimum = codec.minimum_to_decode(want, avail)
+    assert minimum == {0, 2, 3}
+    assert len(minimum) == 3  # l survivors, not k=4
+
+    # nothing missing: read exactly what is wanted
+    assert codec.minimum_to_decode({2, 5}, set(range(n))) == {2, 5}
+
+
+def test_minimum_to_decode_falls_back_to_global():
+    codec = make_lrc({"k": "4", "m": "2", "l": "3"})
+    n = codec.get_chunk_count()
+    # two erasures in one local group exceed the local layer's m=1:
+    # the global layer must take over
+    want = {0}
+    avail = set(range(n)) - {0, 1}
+    minimum = codec.minimum_to_decode(want, avail)
+    # recoverable: the read set must exclude the erased chunks
+    assert 0 not in minimum and 1 not in minimum
+    assert minimum <= avail
+    # and decode proves it
+    data = bytes(range(128)) * 31
+    chunks = codec.encode(range(n), data)
+    decoded = codec.decode({0, 1}, {i: chunks[i] for i in avail})
+    assert np.array_equal(decoded[0], chunks[0])
+    assert np.array_equal(decoded[1], chunks[1])
+
+
+def test_minimum_to_decode_unrecoverable():
+    codec = make_lrc({"k": "4", "m": "2", "l": "3"})
+    n = codec.get_chunk_count()
+    # 3 erasures in one group (2 data + its global parity + local parity
+    # leaves too little): drop 0,1,2,3 entirely — clearly unrecoverable
+    with pytest.raises(ECError):
+        codec.minimum_to_decode({0}, set(range(n)) - {0, 1, 2, 3})
+
+
+def test_explicit_layers_profile():
+    profile = {
+        "plugin": "lrc",
+        "mapping": "__DD__DD",
+        "layers": json.dumps([
+            ["_cDD_cDD", ""],
+            ["cDDD____", ""],
+            ["____cDDD", ""],
+        ]),
+    }
+    codec = factory(profile)
+    assert codec.get_chunk_count() == 8
+    assert codec.get_data_chunk_count() == 4
+    data = bytes(range(64)) * 61
+    chunks = codec.encode(range(8), data)
+    for erase in range(8):
+        avail = {i: c for i, c in chunks.items() if i != erase}
+        decoded = codec.decode({erase}, avail)
+        assert np.array_equal(decoded[erase], chunks[erase])
+    assert codec.decode_concat(chunks)[: len(data)] == data
+
+
+def test_layer_profile_override():
+    profile = {
+        "mapping": "DD__DD__",
+        "layers": json.dumps([
+            ["DDc_DDc_", {"plugin": "isa", "technique": "reed_sol_van"}],
+            ["DDDc____", ""],
+            ["____DDDc", ""],
+        ]),
+    }
+    codec = make_lrc(profile)
+    assert codec.layers[0].profile["plugin"] == "isa"
+    assert codec.layers[1].profile["plugin"] == "jerasure"
+    data = b"x" * 4096
+    chunks = codec.encode(range(8), data)
+    avail = {i: c for i, c in chunks.items() if i != 5}
+    decoded = codec.decode({5}, avail)
+    assert np.array_equal(decoded[5], chunks[5])
+
+
+def test_rule_steps_kml():
+    codec = make_lrc({"k": "4", "m": "2", "l": "3",
+                      "crush-locality": "rack",
+                      "crush-failure-domain": "host"})
+    ops = [(s.op, s.type, s.n) for s in codec.rule_steps]
+    assert ops == [("choose", "rack", 2), ("chooseleaf", "host", 4)]
+
+
+def test_create_rule_steps():
+    from ceph_tpu.crush import types as ct
+
+    codec = make_lrc({"k": "4", "m": "2", "l": "3",
+                      "crush-locality": "rack",
+                      "crush-failure-domain": "host"})
+    cmap, _ = ct.build_three_level(3, 2, 2)
+    ruleno = codec.create_rule("lrcrule", cmap)
+    rule = cmap.rules[ruleno]
+    opcodes = [s[0] for s in rule.steps]
+    assert opcodes == [
+        ct.RULE_SET_CHOOSELEAF_TRIES, ct.RULE_SET_CHOOSE_TRIES,
+        ct.RULE_TAKE, ct.RULE_CHOOSE_INDEP, ct.RULE_CHOOSELEAF_INDEP,
+        ct.RULE_EMIT,
+    ]
+    assert rule.type == 3
+    assert rule.max_size == 8
+
+
+def test_crush_steps_json_profile():
+    profile = {
+        "mapping": "DD__DD__",
+        "layers": json.dumps([
+            ["DDc_DDc_", ""],
+            ["DDDc____", ""],
+            ["____DDDc", ""],
+        ]),
+        "crush-steps": json.dumps([["choose", "rack", 2],
+                                   ["chooseleaf", "host", 4]]),
+    }
+    codec = make_lrc(profile)
+    ops = [(s.op, s.type, s.n) for s in codec.rule_steps]
+    assert ops == [("choose", "rack", 2), ("chooseleaf", "host", 4)]
+
+
+def test_registry_exposes_lrc():
+    codec = factory({"plugin": "lrc", "k": "4", "m": "2", "l": "3"})
+    assert isinstance(codec, ErasureCodeLrc)

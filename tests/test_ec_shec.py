@@ -1,0 +1,147 @@
+"""SHEC plugin tests.
+
+Coverage models the reference's TestErasureCodeShec*.cc: profile parsing
+constraints, shingle-matrix structure, minimum_to_decode locality (reads
+fewer than k chunks for a single erasure), and exhaustive erasure-pattern
+recovery sweeps for SHEC(k=6, m=4, c=3).
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ec import factory
+from ceph_tpu.ec.interface import ECError
+from ceph_tpu.ec.shec import ErasureCodeShec, make_shec, shec_coding_matrix
+
+
+def test_profile_defaults():
+    codec = make_shec({})
+    assert (codec.k, codec.m, codec.c) == (4, 3, 2)
+    assert codec.get_chunk_count() == 7
+    assert codec.get_data_chunk_count() == 4
+
+
+def test_profile_constraints():
+    with pytest.raises(ECError):
+        make_shec({"k": "4", "m": "3"})  # c missing
+    with pytest.raises(ECError):
+        make_shec({"k": "4", "m": "3", "c": "4"})  # c > m
+    with pytest.raises(ECError):
+        make_shec({"k": "13", "m": "3", "c": "2"})  # k > 12
+    with pytest.raises(ECError):
+        make_shec({"k": "12", "m": "9", "c": "2"})  # k+m > 20
+    with pytest.raises(ECError):
+        make_shec({"k": "3", "m": "4", "c": "2"})  # m > k
+    with pytest.raises(ECError):
+        make_shec({"k": "4", "m": "3", "c": "2", "technique": "bogus"})
+
+
+def test_shingle_matrix_has_zero_pattern():
+    mat = shec_coding_matrix(6, 4, 3, technique=0)
+    assert mat.shape == (4, 6)
+    # shingled rows are sparse: zeros must exist (it is not a dense RS matrix)
+    assert (mat == 0).sum() > 0
+    # every data chunk is covered by at least one parity
+    assert (mat != 0).any(axis=0).all()
+    # every parity row uses at least one data chunk
+    assert (mat != 0).any(axis=1).all()
+
+
+def test_single_technique_matrix():
+    mat = shec_coding_matrix(6, 4, 3, technique=1)
+    assert mat.shape == (4, 6)
+    assert (mat != 0).any(axis=0).all()
+
+
+def test_roundtrip_no_erasure():
+    codec = make_shec({"k": "6", "m": "4", "c": "3"})
+    data = bytes(range(256)) * 24
+    n = codec.get_chunk_count()
+    chunks = codec.encode(range(n), data)
+    assert len(chunks) == n
+    assert codec.decode_concat(chunks)[: len(data)] == data
+
+
+@pytest.mark.parametrize("n_erasures", [1, 2, 3])
+def test_exhaustive_erasure_recovery(n_erasures):
+    """SHEC(6,4,3) must recover every <= c erasure pattern (the reference's
+    TestErasureCodeShec_all sweep, ErasureCodeShec.cc:69-121 decode path)."""
+    codec = make_shec({"k": "6", "m": "4", "c": "3"})
+    n = codec.get_chunk_count()
+    data = np.random.default_rng(3).integers(0, 256, 6000, dtype=np.uint8).tobytes()
+    chunks = codec.encode(range(n), data)
+    for erase in itertools.combinations(range(n), n_erasures):
+        avail = {i: c for i, c in chunks.items() if i not in erase}
+        decoded = codec.decode(set(erase), avail)
+        for e in erase:
+            assert np.array_equal(decoded[e], chunks[e]), \
+                f"pattern {erase}: chunk {e} mismatch"
+
+
+def test_minimum_to_decode_reads_fewer_than_k():
+    """The SHEC selling point: a single data-chunk erasure is recovered
+    from fewer than k chunks (locality of the shingled parity)."""
+    codec = make_shec({"k": "6", "m": "4", "c": "3"})
+    n = codec.get_chunk_count()
+    smaller_than_k = 0
+    for erased in range(codec.k):
+        minimum = codec.minimum_to_decode({erased}, set(range(n)) - {erased})
+        assert erased not in minimum
+        # must be recoverable, and never need more than k chunks
+        assert len(minimum) <= codec.k
+        if len(minimum) < codec.k:
+            smaller_than_k += 1
+        # the minimum really is sufficient: decode from exactly that set
+        data = b"m" * 3000
+        chunks = codec.encode(range(n), data)
+        decoded = codec.decode({erased}, {i: chunks[i] for i in minimum})
+        assert np.array_equal(decoded[erased], chunks[erased])
+    assert smaller_than_k > 0, "no single-erasure pattern was local"
+
+
+def test_minimum_to_decode_nothing_missing():
+    codec = make_shec({"k": "6", "m": "4", "c": "3"})
+    n = codec.get_chunk_count()
+    assert codec.minimum_to_decode({2, 3}, set(range(n))) <= set(range(n))
+
+
+def test_unrecoverable_pattern_raises():
+    codec = make_shec({"k": "4", "m": "3", "c": "2"})
+    n = codec.get_chunk_count()
+    data = b"u" * 1000
+    chunks = codec.encode(range(n), data)
+    # erase more than the code can ever tolerate (all parities + 2 data)
+    erase = {0, 1, 4, 5, 6}
+    avail = {i: c for i, c in chunks.items() if i not in erase}
+    with pytest.raises(ECError):
+        codec.decode({0, 1}, avail)
+
+
+def test_decode_table_cache_hit():
+    codec = make_shec({"k": "6", "m": "4", "c": "3"})
+    n = codec.get_chunk_count()
+    data = b"c" * 3000
+    chunks = codec.encode(range(n), data)
+    avail = {i: c for i, c in chunks.items() if i != 2}
+    codec.decode({2}, avail)
+    assert len(codec._plan_cache) >= 1
+    before = len(codec._plan_cache)
+    codec.decode({2}, avail)  # same pattern: cache hit, no new entry
+    assert len(codec._plan_cache) == before
+
+
+def test_batch_decode_matches_single():
+    codec = make_shec({"k": "6", "m": "4", "c": "3"})
+    rng = np.random.default_rng(11)
+    batch = rng.integers(0, 256, (8, 6, 96), dtype=np.uint8)
+    parity = np.asarray(codec.encode_batch(batch))
+    full = np.concatenate([batch, parity], axis=1)
+    out = np.asarray(codec.decode_batch((1,), full))
+    assert np.array_equal(out[:, 0, :], batch[:, 1, :])
+
+
+def test_registry_exposes_shec():
+    codec = factory({"plugin": "shec", "k": "6", "m": "4", "c": "3"})
+    assert isinstance(codec, ErasureCodeShec)
