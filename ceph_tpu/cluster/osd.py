@@ -178,6 +178,15 @@ class OSDDaemon(Dispatcher):
 
     # -------------------------------------------------------------- helpers
 
+    async def _compute(self, fn, *args):
+        """Run codec compute (encode/decode, possibly a first-call jit
+        compile) off the event loop.  Blocking the loop here starves
+        heartbeat replies and triggers false failure reports — the reference
+        isolates heartbeats on dedicated messengers for the same reason
+        (src/ceph_osd.cc:459-486 creates 4 hb messengers)."""
+        return await asyncio.get_event_loop().run_in_executor(
+            None, lambda: fn(*args))
+
     def _ack(self, key, result, payload=None) -> None:
         entry = self._pending.get(tuple(key) if isinstance(key, tuple) else key)
         if entry is None:
@@ -206,6 +215,14 @@ class OSDDaemon(Dispatcher):
         old = self.osdmap
         self.osdmap = newmap
         self.perf.set("osd_map_epoch", newmap.epoch)
+        if not self._stopped and self.osd_id < newmap.max_osd and \
+                not newmap.osd_up[self.osd_id]:
+            # the map says we are down but we are alive: re-boot (reference
+            # OSD::start_boot after _committed_osd_maps notices the same)
+            self.perf.inc("osd_re_boots")
+            await self.messenger.send_message(
+                M.MOSDBoot(osd_id=self.osd_id,
+                           addr=self.messenger.my_addr), self.mon_addr)
         changed = self._advance_pgs()
         if changed and not self._stopped:
             self._tasks.append(asyncio.get_event_loop().create_task(
@@ -343,7 +360,7 @@ class OSDDaemon(Dispatcher):
         fan shard writes out to the acting set (ECBackend.cc:1785,921)."""
         codec = self._codec(pool)
         n = codec.get_chunk_count()
-        chunks = codec.encode(range(n), data)
+        chunks = await self._compute(codec.encode, range(n), data)
         version = self.store.get_version(_coll(st.pgid), oid) + 1
         reqid = self._next_reqid()
         peers = []
@@ -469,7 +486,7 @@ class OSDDaemon(Dispatcher):
 
         avail = {s: np.frombuffer(d, dtype=np.uint8)
                  for s, d in shards.items()}
-        out = codec.decode_concat(avail)
+        out = await self._compute(codec.decode_concat, avail)
         return out[:size]
 
     # ------------------------------------------------------------- recovery
@@ -563,8 +580,9 @@ class OSDDaemon(Dispatcher):
 
         avail = {s: np.frombuffer(d, dtype=np.uint8)
                  for s, d in shards.items()}
-        data = codec.decode_concat(avail)[:size]
-        chunks = codec.encode(range(codec.get_chunk_count()), data)
+        data = (await self._compute(codec.decode_concat, avail))[:size]
+        chunks = await self._compute(
+            codec.encode, range(codec.get_chunk_count()), data)
         version = max((self.store.get_version(_coll(st.pgid), oid)), 1)
         hinfo = {"size": size, "version": version}
         for shard, osd in enumerate(st.acting):

@@ -1,0 +1,139 @@
+"""vstart: in-process dev cluster launcher.
+
+Analog of the reference's src/vstart.sh dev-cluster bootstrap: spin up one
+monitor and N OSD daemons on loopback, build the initial CRUSH map/OSDMap,
+and hand back a connected client.  Used as the fixture for the tier-3-style
+cluster tests (reference qa/standalone/ceph-helpers.sh run the same
+daemons-on-loopback shape) and runnable as a module for interactive use:
+
+    python -m ceph_tpu.cluster.vstart --osds 3
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ceph_tpu.cluster.mon import Monitor
+from ceph_tpu.cluster.objecter import RadosClient
+from ceph_tpu.cluster.osd import OSDDaemon
+from ceph_tpu.crush.types import build_hierarchy
+from ceph_tpu.osdmap.osdmap import OSDMap
+from ceph_tpu.utils import Config
+
+
+@dataclass
+class Cluster:
+    """A running mini cluster: one mon, N OSDs, loopback messengers."""
+
+    mon: Monitor
+    osds: Dict[int, OSDDaemon]
+    config: Config
+    mon_addr: tuple = None
+    clients: List[RadosClient] = field(default_factory=list)
+
+    async def client(self, name: str = "admin") -> RadosClient:
+        c = RadosClient(self.mon_addr, name=name, config=self.config)
+        await c.connect()
+        self.clients.append(c)
+        return c
+
+    async def kill_osd(self, osd_id: int) -> None:
+        """Hard-stop an OSD (thrasher kill_osd analog)."""
+        osd = self.osds.pop(osd_id)
+        await osd.stop()
+
+    async def revive_osd(self, osd_id: int) -> OSDDaemon:
+        """Start a fresh daemon for the id (revive_osd analog; empty store —
+        recovery must repopulate it)."""
+        osd = OSDDaemon(osd_id, self.mon_addr, config=self.config)
+        await osd.start()
+        self.osds[osd_id] = osd
+        return osd
+
+    async def wait_for_epoch(self, epoch: int, timeout: float = 10.0) -> None:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            if all(o.osdmap is not None and o.osdmap.epoch >= epoch
+                   for o in self.osds.values()):
+                return
+            await asyncio.sleep(0.02)
+        raise TimeoutError(f"epoch {epoch} not reached")
+
+    async def wait_down(self, osd_id: int, timeout: float = 20.0) -> None:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            if not self.mon.osdmap.osd_up[osd_id]:
+                return
+            await asyncio.sleep(0.05)
+        raise TimeoutError(f"osd.{osd_id} never marked down")
+
+    async def stop(self) -> None:
+        for c in self.clients:
+            await c.shutdown()
+        for osd in self.osds.values():
+            await osd.stop()
+        await self.mon.stop()
+
+
+def _fast_config() -> Config:
+    """Test-speed timings (the vstart analog of ceph.conf overrides)."""
+    return Config(
+        osd_heartbeat_interval=0.1,
+        osd_heartbeat_grace=1.5,
+        mon_tick_interval=0.1,
+        mon_osd_down_out_interval=2.0,
+        mon_osd_min_down_reporters=1,
+        osd_recovery_delay_start=0.05,
+        osd_client_op_timeout=5.0,
+    )
+
+
+async def start_cluster(n_osds: int = 3, osds_per_host: int = 1,
+                        config: Optional[Config] = None) -> Cluster:
+    """Boot mon + OSDs and wait for all of them to appear up in the map."""
+    config = config or _fast_config()
+    n_hosts = (n_osds + osds_per_host - 1) // osds_per_host
+    cmap, _ = build_hierarchy(n_hosts, osds_per_host, numrep=3)
+    osdmap = OSDMap(cmap, max_osd=n_osds)
+    # OSDs boot "down" until they report in (reference: superblock boot flow)
+    for o in range(n_osds):
+        osdmap.osd_up[o] = False
+    mon = Monitor(osdmap, config=config)
+    mon_addr = await mon.start()
+    cluster = Cluster(mon=mon, osds={}, config=config, mon_addr=mon_addr)
+    for o in range(n_osds):
+        osd = OSDDaemon(o, mon_addr, config=config)
+        await osd.start()
+        cluster.osds[o] = osd
+    deadline = asyncio.get_event_loop().time() + 10
+    while asyncio.get_event_loop().time() < deadline:
+        if all(mon.osdmap.osd_up[o] for o in range(n_osds)):
+            break
+        await asyncio.sleep(0.02)
+    else:
+        raise TimeoutError("OSDs never booted")
+    await cluster.wait_for_epoch(mon.osdmap.epoch)
+    return cluster
+
+
+async def _main(n_osds: int) -> None:
+    cluster = await start_cluster(n_osds)
+    client = await cluster.client()
+    status = await client.status()
+    print(f"cluster up: {status}")
+    pool = await client.pool_create("rbd", "replicated", pg_num=8, size=2)
+    io = client.ioctx(pool)
+    await io.write_full("hello", b"world")
+    print("hello ->", await io.read("hello"))
+    await cluster.stop()
+
+
+if __name__ == "__main__":
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--osds", type=int, default=3)
+    args = ap.parse_args()
+    asyncio.run(_main(args.osds))
