@@ -1,0 +1,325 @@
+/* Independent EC golden-vector generator.
+ *
+ * Re-derives the coding matrices and encode byte layouts of the jerasure /
+ * ISA-L codec families from their published algorithms, using from-scratch
+ * GF(2^8) arithmetic (carryless shift-xor multiply mod 0x11d, inverse by
+ * exhaustive search) — no lookup tables, no numpy, no code shared with the
+ * Python package.  The emitted per-chunk FNV-1a fingerprints pin the
+ * package's TPU encode output byte-for-byte (tests/test_ec_golden.py), the
+ * same role ceph-erasure-code-corpus plays for the reference
+ * (src/test/erasure-code/ceph_erasure_code_non_regression.cc:226).
+ *
+ * Build & run:  gcc -O2 -o gen gen.c && ./gen > ../../tests/golden/ec_golden.jsonl
+ */
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <stdint.h>
+
+/* ---------------- GF(2^8), poly 0x11d, from first principles ----------- */
+
+static int gf_mul(int a, int b) {
+    int r = 0;
+    a &= 0xff; b &= 0xff;
+    while (b) {
+        if (b & 1) r ^= a;
+        b >>= 1;
+        a <<= 1;
+        if (a & 0x100) a ^= 0x11d;
+    }
+    return r & 0xff;
+}
+
+static int gf_inv(int a) {
+    int x;
+    for (x = 1; x < 256; x++)
+        if (gf_mul(a, x) == 1) return x;
+    fprintf(stderr, "gf_inv(0)\n");
+    exit(1);
+}
+
+static int gf_div(int a, int b) { return gf_mul(a, gf_inv(b)); }
+
+static int gf_pow(int a, int n) {
+    int r = 1, i;
+    for (i = 0; i < n; i++) r = gf_mul(r, a);
+    return r;
+}
+
+/* ---------------- matrix builders -------------------------------------- */
+
+/* jerasure reed_sol: extended Vandermonde (k+m, k), systematized by
+ * elementary column operations, final parity-column normalization so the
+ * first parity row is all ones. */
+static void reed_sol_van_matrix(int k, int m, int *coding /* m*k */) {
+    int rows = k + m, cols = k;
+    int *v = calloc(rows * cols, sizeof(int));
+    int i, j, x;
+    v[0 * cols + 0] = 1;
+    for (i = 1; i < rows - 1; i++)
+        for (j = 0; j < cols; j++)
+            v[i * cols + j] = gf_pow(i, j);
+    v[(rows - 1) * cols + (cols - 1)] = 1;
+
+    for (i = 0; i < cols; i++) {
+        if (v[i * cols + i] == 0) {
+            for (j = i + 1; j < cols; j++)
+                if (v[i * cols + j] != 0) break;
+            if (j == cols) { fprintf(stderr, "systematize failed\n"); exit(1); }
+            for (x = 0; x < rows; x++) {
+                int t = v[x * cols + i];
+                v[x * cols + i] = v[x * cols + j];
+                v[x * cols + j] = t;
+            }
+        }
+        if (v[i * cols + i] != 1) {
+            int inv = gf_inv(v[i * cols + i]);
+            for (x = 0; x < rows; x++)
+                v[x * cols + i] = gf_mul(v[x * cols + i], inv);
+        }
+        for (j = 0; j < cols; j++) {
+            int f = v[i * cols + j];
+            if (j != i && f != 0)
+                for (x = 0; x < rows; x++)
+                    v[x * cols + j] ^= gf_mul(f, v[x * cols + i]);
+        }
+    }
+    /* normalization: first parity row becomes all ones (parity rows only) */
+    for (j = 0; j < cols; j++) {
+        int e = v[k * cols + j];
+        if (e != 0 && e != 1)
+            for (x = k; x < rows; x++)
+                v[x * cols + j] = gf_div(v[x * cols + j], e);
+    }
+    for (i = 0; i < m; i++)
+        for (j = 0; j < k; j++)
+            coding[i * k + j] = v[(k + i) * cols + j];
+    free(v);
+}
+
+/* jerasure reed_sol_r6: P = XOR row, Q = 2^j row */
+static void reed_sol_r6_matrix(int k, int *coding /* 2*k */) {
+    int j;
+    for (j = 0; j < k; j++) {
+        coding[0 * k + j] = 1;
+        coding[1 * k + j] = gf_pow(2, j);
+    }
+}
+
+/* jerasure cauchy_orig: 1 / (i ^ (m + j)) */
+static void cauchy_orig_matrix(int k, int m, int *coding) {
+    int i, j;
+    for (i = 0; i < m; i++)
+        for (j = 0; j < k; j++)
+            coding[i * k + j] = gf_inv(i ^ (m + j));
+}
+
+/* number of ones in the 8x8 bit-matrix of multiply-by-a */
+static int n_ones(int a) {
+    int t, u, n = 0;
+    for (u = 0; u < 8; u++) {
+        int col = gf_mul(a, 1 << u);
+        for (t = 0; t < 8; t++)
+            if (col & (1 << t)) n++;
+    }
+    return n;
+}
+
+/* jerasure cauchy_good: scale columns so row 0 is ones, then scale each
+ * later row by the divisor minimizing total bit-matrix ones */
+static void cauchy_good_matrix(int k, int m, int *coding) {
+    int i, j;
+    cauchy_orig_matrix(k, m, coding);
+    for (j = 0; j < k; j++)
+        if (coding[0 * k + j] != 1) {
+            int inv = gf_inv(coding[0 * k + j]);
+            for (i = 0; i < m; i++)
+                coding[i * k + j] = gf_mul(coding[i * k + j], inv);
+        }
+    for (i = 1; i < m; i++) {
+        int best = 0, best_j = -1, total, jj;
+        for (jj = 0; jj < k; jj++) best += n_ones(coding[i * k + jj]);
+        for (j = 0; j < k; j++) {
+            if (coding[i * k + j] == 1) continue;
+            {
+                int inv = gf_inv(coding[i * k + j]);
+                total = 0;
+                for (jj = 0; jj < k; jj++)
+                    total += n_ones(gf_mul(coding[i * k + jj], inv));
+                if (total < best) { best = total; best_j = j; }
+            }
+        }
+        if (best_j != -1) {
+            int inv = gf_inv(coding[i * k + best_j]);
+            for (j = 0; j < k; j++)
+                coding[i * k + j] = gf_mul(coding[i * k + j], inv);
+        }
+    }
+}
+
+/* ISA-L gf_gen_rs_matrix parity rows: row r = g^0..g^(k-1), g = 2^r */
+static void isa_rs_matrix(int k, int m, int *coding) {
+    int r, j, gen = 1;
+    for (r = 0; r < m; r++) {
+        int p = 1;
+        for (j = 0; j < k; j++) {
+            coding[r * k + j] = p;
+            p = gf_mul(p, gen);
+        }
+        gen = gf_mul(gen, 2);
+    }
+}
+
+/* ISA-L gf_gen_cauchy1_matrix parity rows: 1 / ((k + i) ^ j) */
+static void isa_cauchy_matrix(int k, int m, int *coding) {
+    int i, j;
+    for (i = 0; i < m; i++)
+        for (j = 0; j < k; j++)
+            coding[i * k + j] = gf_inv((k + i) ^ j);
+}
+
+/* ---------------- encodes ---------------------------------------------- */
+
+/* bytewise matrix encode: parity[i][b] = XOR_j mat[i][j] * data[j][b] */
+static void matrix_encode(const int *mat, int k, int m,
+                          uint8_t **data, uint8_t **parity, int size) {
+    int i, j, b;
+    for (i = 0; i < m; i++)
+        for (b = 0; b < size; b++) {
+            int acc = 0;
+            for (j = 0; j < k; j++)
+                acc ^= gf_mul(mat[i * k + j], data[j][b]);
+            parity[i][b] = (uint8_t)acc;
+        }
+}
+
+/* jerasure bit-matrix schedule encode, w=8, packetsize ps.
+ * Chunk layout: superblocks of w*ps bytes; packet row t of superblock s is
+ * bytes [s*w*ps + t*ps, +ps).  Parity packet (i, t) = XOR over (j, u) with
+ * bit t of (mat[i][j] * 2^u) set of data packet (j, u). */
+static void bitmatrix_encode(const int *mat, int k, int m, int ps,
+                             uint8_t **data, uint8_t **parity, int size) {
+    int w = 8;
+    int sb = w * ps;
+    int ns = size / sb;
+    int i, t, j, u, s, b;
+    for (i = 0; i < m; i++)
+        for (t = 0; t < w; t++)
+            for (s = 0; s < ns; s++) {
+                uint8_t *out = parity[i] + s * sb + t * ps;
+                memset(out, 0, ps);
+                for (j = 0; j < k; j++)
+                    for (u = 0; u < w; u++) {
+                        int col = gf_mul(mat[i * k + j], 1 << u);
+                        if (col & (1 << t)) {
+                            const uint8_t *in = data[j] + s * sb + u * ps;
+                            for (b = 0; b < ps; b++) out[b] ^= in[b];
+                        }
+                    }
+            }
+}
+
+/* ---------------- deterministic data + fingerprints -------------------- */
+
+static uint32_t lcg_state;
+static uint8_t lcg_next(void) {
+    lcg_state = (1103515245u * lcg_state + 12345u) & 0x7fffffffu;
+    return (uint8_t)((lcg_state >> 16) & 0xff);
+}
+
+static uint64_t fnv1a(const uint8_t *p, int n) {
+    uint64_t h = 1469598103934665603ull;
+    int i;
+    for (i = 0; i < n; i++) {
+        h ^= p[i];
+        h *= 1099511628211ull;
+    }
+    return h;
+}
+
+static void hex16(const uint8_t *p, char *out) {
+    int i;
+    for (i = 0; i < 16; i++) sprintf(out + 2 * i, "%02x", p[i]);
+    out[32] = 0;
+}
+
+/* ---------------- config table + main ---------------------------------- */
+
+typedef struct {
+    const char *plugin;
+    const char *technique;
+    int k, m, packetsize;
+    int object_size;   /* chosen pre-aligned: no padding ambiguity */
+    int seed;
+} Cfg;
+
+static const Cfg CONFIGS[] = {
+    {"jerasure", "reed_sol_van", 4, 2, 0, 4096, 1},
+    {"jerasure", "reed_sol_van", 8, 4, 0, 8192, 2},
+    {"jerasure", "reed_sol_van", 6, 3, 0, 6144, 3},
+    {"jerasure", "reed_sol_r6_op", 4, 2, 0, 4096, 4},
+    {"jerasure", "cauchy_orig", 3, 2, 8, 2304, 5},
+    {"jerasure", "cauchy_good", 4, 2, 8, 4096, 6},
+    {"jerasure", "cauchy_good", 5, 3, 8, 6400, 7},
+    {"isa", "reed_sol_van", 8, 4, 0, 8192, 8},
+    {"isa", "reed_sol_van", 4, 2, 0, 4096, 9},
+    {"isa", "cauchy", 8, 4, 0, 8192, 10},
+};
+
+int main(void) {
+    unsigned ci;
+    for (ci = 0; ci < sizeof(CONFIGS) / sizeof(CONFIGS[0]); ci++) {
+        const Cfg *c = &CONFIGS[ci];
+        int k = c->k, m = c->m;
+        int chunk = c->object_size / k;
+        int *mat = calloc(m * k, sizeof(int));
+        uint8_t **data = calloc(k, sizeof(uint8_t *));
+        uint8_t **parity = calloc(m, sizeof(uint8_t *));
+        int i, j;
+        char hexbuf[40];
+
+        if (!strcmp(c->plugin, "jerasure")) {
+            if (!strcmp(c->technique, "reed_sol_van")) reed_sol_van_matrix(k, m, mat);
+            else if (!strcmp(c->technique, "reed_sol_r6_op")) reed_sol_r6_matrix(k, mat);
+            else if (!strcmp(c->technique, "cauchy_orig")) cauchy_orig_matrix(k, m, mat);
+            else if (!strcmp(c->technique, "cauchy_good")) cauchy_good_matrix(k, m, mat);
+        } else {
+            if (!strcmp(c->technique, "cauchy")) isa_cauchy_matrix(k, m, mat);
+            else isa_rs_matrix(k, m, mat);
+        }
+
+        lcg_state = (uint32_t)c->seed;
+        for (i = 0; i < k; i++) {
+            data[i] = malloc(chunk);
+            for (j = 0; j < chunk; j++) data[i][j] = lcg_next();
+        }
+        for (i = 0; i < m; i++) parity[i] = malloc(chunk);
+
+        if (c->packetsize)
+            bitmatrix_encode(mat, k, m, c->packetsize, data, parity, chunk);
+        else
+            matrix_encode(mat, k, m, data, parity, chunk);
+
+        printf("{\"plugin\": \"%s\", \"technique\": \"%s\", \"k\": %d, "
+               "\"m\": %d, \"packetsize\": %d, \"object_size\": %d, "
+               "\"seed\": %d, \"chunk_size\": %d, \"matrix\": [",
+               c->plugin, c->technique, k, m, c->packetsize,
+               c->object_size, c->seed, chunk);
+        for (i = 0; i < m * k; i++)
+            printf("%s%d", i ? ", " : "", mat[i]);
+        printf("], \"chunks\": [");
+        for (i = 0; i < k + m; i++) {
+            const uint8_t *p = i < k ? data[i] : parity[i - k];
+            hex16(p, hexbuf);
+            printf("%s{\"fnv1a64\": \"%016llx\", \"head\": \"%s\"}",
+                   i ? ", " : "", (unsigned long long)fnv1a(p, chunk), hexbuf);
+        }
+        printf("]}\n");
+
+        for (i = 0; i < k; i++) free(data[i]);
+        for (i = 0; i < m; i++) free(parity[i]);
+        free(data); free(parity); free(mat);
+    }
+    return 0;
+}
