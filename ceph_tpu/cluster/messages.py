@@ -101,13 +101,20 @@ class MOSDRepOpReply(Message):
 
 @dataclass
 class MOSDECSubOpWrite(Message):
-    """Shard write (reference MOSDECSubOpWrite, ECBackend.cc:921)."""
+    """Shard write (reference MOSDECSubOpWrite, ECBackend.cc:921).
+
+    chunk_off/shard_size carry the RMW sub-range: data lands at chunk_off
+    within the shard, which is then truncated/zero-extended to shard_size
+    (zero stripes encode to zero parity — the code is linear — so extension
+    commutes with encode)."""
 
     reqid: Tuple[str, int] = ("", 0)
     pgid: Optional[PGid] = None
     oid: str = ""
     shard: int = -1
     data: bytes = b""
+    chunk_off: int = 0
+    shard_size: Optional[int] = None
     hinfo: Dict[str, Any] = field(default_factory=dict)
     epoch: int = 0
 
@@ -120,12 +127,15 @@ class MOSDECSubOpWriteReply(Message):
 
 @dataclass
 class MOSDECSubOpRead(Message):
-    """Shard read (reference handle_sub_read, ECBackend.cc:986)."""
+    """Shard read (reference handle_sub_read, ECBackend.cc:986).
+    off/length select a chunk sub-range (None = whole shard)."""
 
     reqid: Tuple[str, int] = ("", 0)
     pgid: Optional[PGid] = None
     oid: str = ""
     shard: int = -1
+    off: int = 0
+    length: Optional[int] = None
 
 
 @dataclass

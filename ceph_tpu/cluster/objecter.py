@@ -147,8 +147,23 @@ class IoCtx:
         if reply.result != 0:
             raise IOError(f"write_full({oid}) -> {reply.result}: {reply.data}")
 
-    async def read(self, oid: str) -> bytes:
-        reply = await self.objecter.op_submit(self.pool_id, oid, [("read", {})])
+    async def write(self, oid: str, data: bytes, offset: int = 0) -> None:
+        """Partial write at an offset — the EC read-modify-write path
+        (reference IoCtxImpl::write -> ECBackend::start_rmw)."""
+        reply = await self.objecter.op_submit(
+            self.pool_id, oid, [("write", {"offset": offset, "data": data})])
+        if reply.result != 0:
+            raise IOError(f"write({oid}) -> {reply.result}: {reply.data}")
+
+    async def read(self, oid: str, offset: int = 0,
+                   length: int = None) -> bytes:
+        args = {}
+        if offset:
+            args["offset"] = offset
+        if length is not None:
+            args["length"] = length
+        reply = await self.objecter.op_submit(
+            self.pool_id, oid, [("read", args)])
         if reply.result == -2:
             raise FileNotFoundError(oid)
         if reply.result != 0:

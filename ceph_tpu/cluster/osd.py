@@ -111,6 +111,14 @@ class OSDDaemon(Dispatcher):
             self._codecs[pool.pool_id] = codec
         return codec
 
+    def _sinfo(self, pool: PGPool, codec) -> "StripeInfo":
+        """Stripe layout for a pool (ECUtil::stripe_info_t analog)."""
+        from ceph_tpu.ec.stripe import StripeInfo
+
+        unit = int((pool.ec_profile or {}).get(
+            "stripe_unit", self.config.osd_ec_stripe_unit))
+        return StripeInfo(codec.get_data_chunk_count(), unit)
+
     # ------------------------------------------------------------- dispatch
 
     async def ms_dispatch(self, conn: Connection, msg) -> bool:
@@ -275,9 +283,16 @@ class OSDDaemon(Dispatcher):
                 r = await self._op_write_full(pool, st, msg.oid, args["data"])
                 await conn.send(M.MOSDOpReply(
                     reqid=msg.reqid, result=r, epoch=m.epoch))
+            elif opname == "write":
+                r = await self._op_write(pool, st, msg.oid,
+                                         args["offset"], args["data"])
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=r, epoch=m.epoch))
             elif opname == "read":
                 try:
-                    data = await self._op_read(pool, st, msg.oid)
+                    data = await self._op_read(
+                        pool, st, msg.oid,
+                        args.get("offset", 0), args.get("length"))
                     await conn.send(M.MOSDOpReply(
                         reqid=msg.reqid, result=0, data=data, epoch=m.epoch))
                 except FileNotFoundError:
@@ -310,12 +325,27 @@ class OSDDaemon(Dispatcher):
     async def _op_write_full(self, pool: PGPool, st: PGState, oid: str,
                              data: bytes) -> int:
         if pool.is_erasure():
-            return await self._ec_write(pool, st, oid, data)
+            return await self._ec_write(pool, st, oid, data, offset=None)
         version = self.store.get_version(_coll(st.pgid), oid) + 1
         txn = (Transaction()
                .remove(_coll(st.pgid), oid)
                .write(_coll(st.pgid), oid, 0, data)
                .set_version(_coll(st.pgid), oid, version))
+        return await self._replicate_txn(st, txn)
+
+    async def _op_write(self, pool: PGPool, st: PGState, oid: str,
+                        offset: int, data: bytes) -> int:
+        """Partial write at (offset, len) — the RMW path for EC pools
+        (reference ECBackend::start_rmw, ECBackend.cc:1785)."""
+        if pool.is_erasure():
+            return await self._ec_write(pool, st, oid, data, offset=offset)
+        version = self.store.get_version(_coll(st.pgid), oid) + 1
+        txn = (Transaction()
+               .write(_coll(st.pgid), oid, offset, data)
+               .set_version(_coll(st.pgid), oid, version))
+        return await self._replicate_txn(st, txn)
+
+    async def _replicate_txn(self, st: PGState, txn: Transaction) -> int:
         self.store.queue_transaction(txn)
         peers = [o for o in st.acting
                  if o != self.osd_id and o != CRUSH_ITEM_NONE]
@@ -347,21 +377,54 @@ class OSDDaemon(Dispatcher):
                 txn_blob=txn.encode(), epoch=self.osdmap.epoch))
         return 0
 
-    async def _op_read(self, pool: PGPool, st: PGState, oid: str) -> bytes:
+    async def _op_read(self, pool: PGPool, st: PGState, oid: str,
+                       offset: int = 0, length: Optional[int] = None) -> bytes:
         if pool.is_erasure():
-            return await self._ec_read(pool, st, oid)
-        return self.store.read(_coll(st.pgid), oid)
+            return await self._ec_read(pool, st, oid, offset, length)
+        return self.store.read(_coll(st.pgid), oid, offset, length)
 
     # ----------------------------------------------------------- EC backend
+    #
+    # Objects are striped (ECUtil::stripe_info_t math, ceph_tpu.ec.stripe):
+    # shard s holds stripe-chunk s of every stripe, concatenated.  Encode /
+    # decode of the whole touched stripe range happens in one batched TPU
+    # dispatch; partial writes are read-modify-write over stripe bounds
+    # (reference ECBackend::start_rmw, ECBackend.cc:1785-1886).
 
     async def _ec_write(self, pool: PGPool, st: PGState, oid: str,
-                        data: bytes) -> int:
-        """start_rmw analog for full-object writes: encode on the TPU,
-        fan shard writes out to the acting set (ECBackend.cc:1785,921)."""
+                        data: bytes, offset: Optional[int]) -> int:
+        from ceph_tpu.ec import stripe as stripemod
+
         codec = self._codec(pool)
+        sinfo = self._sinfo(pool, codec)
+        coll = _coll(st.pgid)
+        version = self.store.get_version(coll, oid) + 1
+
+        if offset is None:
+            # write_full: replace the object
+            new_size = len(data)
+            chunk_off = 0
+            shards = await self._compute(
+                stripemod.encode_stripes, codec, sinfo, data)
+        else:
+            sa = self.store.getattr(coll, oid, "size")
+            old_size = int(sa) if sa else 0
+            off0, len0 = sinfo.offset_len_to_stripe_bounds(offset, len(data))
+            chunk_off = sinfo.aligned_logical_offset_to_chunk_offset(off0)
+            old_in_range = max(0, min(old_size - off0, len0))
+            old_bytes = b""
+            if old_in_range:
+                old_bytes = await self._ec_read_stripes(
+                    pool, st, oid, chunk_off, old_in_range)
+            merged = stripemod.merge_range(
+                old_bytes, old_in_range, offset - off0, data)
+            new_size = max(old_size, offset + len(data))
+            shards = await self._compute(
+                stripemod.encode_stripes, codec, sinfo, merged)
+
+        shard_size = sinfo.shard_size(new_size)
+        hinfo = {"size": new_size, "version": version}
         n = codec.get_chunk_count()
-        chunks = await self._compute(codec.encode, range(n), data)
-        version = self.store.get_version(_coll(st.pgid), oid) + 1
         reqid = self._next_reqid()
         peers = []
         my_shard = None
@@ -371,16 +434,17 @@ class OSDDaemon(Dispatcher):
                 my_shard = shard
             elif osd != CRUSH_ITEM_NONE:
                 peers.append((osd, shard))
-        hinfo = {"size": len(data), "version": version}
         if my_shard is not None:
             self._apply_shard(st.pgid, oid, my_shard,
-                              chunks[my_shard].tobytes(), hinfo)
+                              shards[my_shard].tobytes(), chunk_off,
+                              shard_size, hinfo)
         if peers:
             fut = self._make_waiter(reqid, len(peers))
             for osd, shard in peers:
                 await self._send_osd(osd, M.MOSDECSubOpWrite(
                     reqid=reqid, pgid=st.pgid, oid=oid, shard=shard,
-                    data=chunks[shard].tobytes(), hinfo=hinfo,
+                    data=shards[shard].tobytes(), chunk_off=chunk_off,
+                    shard_size=shard_size, hinfo=hinfo,
                     epoch=self.osdmap.epoch))
             try:
                 await asyncio.wait_for(
@@ -392,35 +456,43 @@ class OSDDaemon(Dispatcher):
         return 0
 
     def _apply_shard(self, pgid: PGid, oid: str, shard: int, data: bytes,
-                     hinfo: Dict) -> None:
-        """Store one EC shard + its cumulative crc (ECUtil::HashInfo)."""
-        crc = crcmod.crc32c(0xFFFFFFFF, data)
+                     chunk_off: int, shard_size: int, hinfo: Dict) -> None:
+        """Apply a shard sub-range write + refresh the shard crc
+        (ECUtil::HashInfo analog; crc covers the whole shard)."""
+        coll = _coll(pgid)
         txn = (Transaction()
-               .remove(_coll(pgid), oid)
-               .write(_coll(pgid), oid, 0, data)
-               .setattr(_coll(pgid), oid, "shard", str(shard).encode())
-               .setattr(_coll(pgid), oid, "size",
-                        str(hinfo["size"]).encode())
-               .setattr(_coll(pgid), oid, "hinfo_crc", str(crc).encode())
-               .set_version(_coll(pgid), oid, hinfo["version"]))
+               .write(coll, oid, chunk_off, data)
+               .truncate(coll, oid, shard_size)
+               .setattr(coll, oid, "shard", str(shard).encode())
+               .setattr(coll, oid, "size", str(hinfo["size"]).encode())
+               .set_version(coll, oid, hinfo["version"]))
         self.store.queue_transaction(txn)
+        crc = crcmod.crc32c(0xFFFFFFFF, self.store.read(coll, oid))
+        self.store.queue_transaction(
+            Transaction().setattr(coll, oid, "hinfo_crc", str(crc).encode())
+            .set_version(coll, oid, hinfo["version"]))
 
     async def _handle_ec_write(self, conn: Connection,
                                msg: M.MOSDECSubOpWrite) -> None:
-        self._apply_shard(msg.pgid, msg.oid, msg.shard, msg.data, msg.hinfo)
+        shard_size = msg.shard_size if msg.shard_size is not None \
+            else msg.chunk_off + len(msg.data)
+        self._apply_shard(msg.pgid, msg.oid, msg.shard, msg.data,
+                          msg.chunk_off, shard_size, msg.hinfo)
         self.perf.inc("osd_ec_sub_writes")
         await conn.send(M.MOSDECSubOpWriteReply(reqid=msg.reqid, result=0))
 
     async def _handle_ec_read(self, conn: Connection,
                               msg: M.MOSDECSubOpRead) -> None:
         try:
-            data = self.store.read(_coll(msg.pgid), msg.oid)
+            full = self.store.read(_coll(msg.pgid), msg.oid)
             stored_crc = self.store.getattr(_coll(msg.pgid), msg.oid,
                                             "hinfo_crc")
-            # scrub-on-read: verify the chunk crc (ecbackend.rst:86-99)
+            # scrub-on-read: verify the shard crc (ecbackend.rst:86-99)
             if stored_crc is not None and \
-                    int(stored_crc) != crcmod.crc32c(0xFFFFFFFF, data):
+                    int(stored_crc) != crcmod.crc32c(0xFFFFFFFF, full):
                 raise IOError("chunk crc mismatch")
+            data = full[msg.off: msg.off + msg.length] \
+                if msg.length is not None else full[msg.off:]
             shard_attr = self.store.getattr(_coll(msg.pgid), msg.oid, "shard")
             shard = int(shard_attr) if shard_attr else msg.shard
             size = self.store.getattr(_coll(msg.pgid), msg.oid, "size")
@@ -432,15 +504,16 @@ class OSDDaemon(Dispatcher):
             await conn.send(M.MOSDECSubOpReadReply(
                 reqid=msg.reqid, result=-2, shard=msg.shard))
 
-    async def _gather_shards(self, pool: PGPool, st: PGState, oid: str,
-                             need_k: int) -> Tuple[Dict[int, bytes], int]:
-        """Collect >= k shards from the acting set (own shard free)."""
-        codec = self._codec(pool)
+    async def _gather_shards(
+        self, pool: PGPool, st: PGState, oid: str, need_k: int,
+        off: int = 0, length: Optional[int] = None,
+    ) -> Tuple[Dict[int, bytes], int]:
+        """Collect >= k shard (ranges) from the acting set (own shard free)."""
         shards: Dict[int, bytes] = {}
         size = 0
         my = self.store.stat(_coll(st.pgid), oid)
         if my is not None:
-            data = self.store.read(_coll(st.pgid), oid)
+            data = self.store.read(_coll(st.pgid), oid, off, length)
             shard_attr = self.store.getattr(_coll(st.pgid), oid, "shard")
             if shard_attr is not None:
                 shards[int(shard_attr)] = data
@@ -455,7 +528,8 @@ class OSDDaemon(Dispatcher):
             for shard, osd in peers:
                 try:
                     await self._send_osd(osd, M.MOSDECSubOpRead(
-                        reqid=reqid, pgid=st.pgid, oid=oid, shard=shard))
+                        reqid=reqid, pgid=st.pgid, oid=oid, shard=shard,
+                        off=off, length=length))
                 except ConnectionError:
                     fut.needed -= 1  # type: ignore[attr-defined]
             try:
@@ -472,22 +546,56 @@ class OSDDaemon(Dispatcher):
                         size = reply.hinfo["size"]
         return shards, size
 
-    async def _ec_read(self, pool: PGPool, st: PGState, oid: str) -> bytes:
-        """objects_read_async analog: min shards + TPU decode
-        (ECBackend.cc:2111,1588,2262)."""
-        codec = self._codec(pool)
-        k = codec.get_data_chunk_count()
-        shards, size = await self._gather_shards(pool, st, oid, k)
-        if len(shards) < k:
-            if not shards:
-                raise FileNotFoundError(oid)
-            raise IOError(f"only {len(shards)} of {k} shards for {oid}")
+    async def _ec_read_stripes(self, pool: PGPool, st: PGState, oid: str,
+                               chunk_off: int, logical_len: int) -> bytes:
+        """Read a stripe-aligned logical range: gather the touched chunk
+        range from >= k shards and decode it as a mini-object."""
+        from ceph_tpu.ec import stripe as stripemod
         import numpy as np
 
+        codec = self._codec(pool)
+        sinfo = self._sinfo(pool, codec)
+        k = codec.get_data_chunk_count()
+        nstripes = sinfo.object_stripes(logical_len)
+        chunk_len = nstripes * sinfo.chunk_size
+        shards, _ = await self._gather_shards(
+            pool, st, oid, k, off=chunk_off, length=chunk_len)
         avail = {s: np.frombuffer(d, dtype=np.uint8)
-                 for s, d in shards.items()}
-        out = await self._compute(codec.decode_concat, avail)
-        return out[:size]
+                 for s, d in shards.items()
+                 if len(d) == chunk_len}
+        if len(avail) < k:
+            raise IOError(
+                f"only {len(avail)} of {k} shard ranges for {oid}")
+        return await self._compute(
+            stripemod.decode_stripes, codec, sinfo, avail, logical_len)
+
+    async def _ec_read(self, pool: PGPool, st: PGState, oid: str,
+                       offset: int = 0, length: Optional[int] = None) -> bytes:
+        """objects_read_async analog: min shards + batched TPU decode
+        (ECBackend.cc:2111,1588,2262)."""
+        coll = _coll(st.pgid)
+        sa = self.store.getattr(coll, oid, "size")
+        if sa is None:
+            # primary lost its shard (or never had one): probe peers
+            codec = self._codec(pool)
+            shards, size = await self._gather_shards(
+                pool, st, oid, codec.get_data_chunk_count(), 0, 0)
+            if not shards and size == 0:
+                raise FileNotFoundError(oid)
+        else:
+            size = int(sa)
+        if length is None:
+            length = max(0, size - offset)
+        if length == 0 or offset >= size:
+            return b""
+        length = min(length, size - offset)
+        codec = self._codec(pool)
+        sinfo = self._sinfo(pool, codec)
+        off0, len0 = sinfo.offset_len_to_stripe_bounds(offset, length)
+        len0 = min(len0, max(0, size - off0))
+        chunk_off = sinfo.aligned_logical_offset_to_chunk_offset(off0)
+        out = await self._ec_read_stripes(pool, st, oid, chunk_off, len0)
+        return out[offset - off0: offset - off0 + length]
 
     # ------------------------------------------------------------- recovery
 
@@ -569,20 +677,25 @@ class OSDDaemon(Dispatcher):
 
     async def _recover_ec_object(self, pool: PGPool, st: PGState,
                                  oid: str) -> None:
-        """Reconstruct and re-distribute shards (TPU decode + encode)."""
-        codec = self._codec(pool)
-        k = codec.get_data_chunk_count()
-        shards, size = await self._gather_shards(pool, st, oid, k)
-        if len(shards) < k:
-            self.perf.inc("osd_unrecoverable")
-            return
+        """Reconstruct and re-distribute shards (batched TPU decode + encode,
+        ECBackend::run_recovery_op analog)."""
+        from ceph_tpu.ec import stripe as stripemod
         import numpy as np
 
+        codec = self._codec(pool)
+        sinfo = self._sinfo(pool, codec)
+        k = codec.get_data_chunk_count()
+        shards, size = await self._gather_shards(pool, st, oid, k)
+        shard_len = sinfo.shard_size(size)
         avail = {s: np.frombuffer(d, dtype=np.uint8)
-                 for s, d in shards.items()}
-        data = (await self._compute(codec.decode_concat, avail))[:size]
+                 for s, d in shards.items() if len(d) == shard_len}
+        if len(avail) < k:
+            self.perf.inc("osd_unrecoverable")
+            return
+        data = await self._compute(
+            stripemod.decode_stripes, codec, sinfo, avail, size)
         chunks = await self._compute(
-            codec.encode, range(codec.get_chunk_count()), data)
+            stripemod.encode_stripes, codec, sinfo, data)
         version = max((self.store.get_version(_coll(st.pgid), oid)), 1)
         hinfo = {"size": size, "version": version}
         for shard, osd in enumerate(st.acting):
@@ -590,12 +703,14 @@ class OSDDaemon(Dispatcher):
                 continue
             blob = chunks[shard].tobytes()
             if osd == self.osd_id:
-                self._apply_shard(st.pgid, oid, shard, blob, hinfo)
+                self._apply_shard(st.pgid, oid, shard, blob, 0,
+                                  shard_len, hinfo)
             else:
                 try:
                     await self._send_osd(osd, M.MOSDECSubOpWrite(
                         reqid=self._next_reqid(), pgid=st.pgid, oid=oid,
-                        shard=shard, data=blob, hinfo=hinfo,
+                        shard=shard, data=blob, chunk_off=0,
+                        shard_size=shard_len, hinfo=hinfo,
                         epoch=self.osdmap.epoch))
                 except ConnectionError:
                     pass

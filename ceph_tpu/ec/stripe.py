@@ -1,0 +1,168 @@
+"""EC stripe tessellation: logical<->chunk offset math + batched codecs.
+
+Behavioral mirror of ECUtil::stripe_info_t (reference src/osd/ECUtil.h:31-84):
+an EC object is a sequence of stripes, each stripe_width = k * stripe_unit
+logical bytes wide, cut into k data chunks of stripe_unit bytes; shard s of
+the object is the concatenation of that shard's chunk from every stripe.
+
+TPU-first design: the stripe axis is the batch axis.  Encoding an object is
+ONE device dispatch over (nstripes, k, unit); reading or recovering a range
+is one dispatch over the touched stripes.  This is the "long sequence"
+tessellation SURVEY §5 maps onto the MXU — where the reference loops
+per-stripe through jerasure_matrix_encode, we hand XLA the whole batch.
+
+Batch shapes are bucketed to powers of two so repeated object sizes reuse
+compiled executables instead of triggering per-size recompiles.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Mapping, Optional, Tuple
+
+import numpy as np
+
+
+class StripeInfo:
+    """stripe_info_t analog: all offset arithmetic for a (k, stripe_unit)
+    layout (reference ECUtil.h:31-84)."""
+
+    def __init__(self, k: int, stripe_unit: int):
+        if stripe_unit <= 0 or k <= 0:
+            raise ValueError("k and stripe_unit must be positive")
+        self.k = k
+        self.chunk_size = stripe_unit
+        self.stripe_width = k * stripe_unit
+
+    def logical_offset_is_stripe_aligned(self, logical: int) -> bool:
+        return logical % self.stripe_width == 0
+
+    def logical_to_prev_chunk_offset(self, offset: int) -> int:
+        return (offset // self.stripe_width) * self.chunk_size
+
+    def logical_to_next_chunk_offset(self, offset: int) -> int:
+        return ((offset + self.stripe_width - 1) // self.stripe_width) \
+            * self.chunk_size
+
+    def logical_to_prev_stripe_offset(self, offset: int) -> int:
+        return offset - (offset % self.stripe_width)
+
+    def logical_to_next_stripe_offset(self, offset: int) -> int:
+        rem = offset % self.stripe_width
+        return offset - rem + self.stripe_width if rem else offset
+
+    def aligned_logical_offset_to_chunk_offset(self, offset: int) -> int:
+        assert offset % self.stripe_width == 0
+        return (offset // self.stripe_width) * self.chunk_size
+
+    def aligned_chunk_offset_to_logical_offset(self, offset: int) -> int:
+        assert offset % self.chunk_size == 0
+        return (offset // self.chunk_size) * self.stripe_width
+
+    def offset_len_to_stripe_bounds(self, offset: int, length: int) -> Tuple[int, int]:
+        """(stripe-aligned offset, stripe-aligned length) covering the range
+        (reference offset_len_to_stripe_bounds)."""
+        off = self.logical_to_prev_stripe_offset(offset)
+        ln = self.logical_to_next_stripe_offset((offset - off) + length)
+        return off, ln
+
+    def object_stripes(self, logical_size: int) -> int:
+        return (logical_size + self.stripe_width - 1) // self.stripe_width \
+            if logical_size else 0
+
+    def shard_size(self, logical_size: int) -> int:
+        return self.object_stripes(logical_size) * self.chunk_size
+
+
+def _bucket(n: int) -> int:
+    """Round a stripe count up to a power of two: bounded compile count."""
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
+def encode_stripes(codec, sinfo: StripeInfo, data: bytes) -> np.ndarray:
+    """Encode a stripe-aligned-or-padded byte range in one device dispatch.
+
+    Returns (k+m, nstripes * unit) uint8: shard rows, chunk-per-stripe
+    concatenated.  ``data`` is zero-padded to the next stripe boundary.
+    """
+    k = sinfo.k
+    unit = sinfo.chunk_size
+    n = codec.get_chunk_count()
+    nstripes = sinfo.object_stripes(len(data))
+    if nstripes == 0:
+        return np.zeros((n, 0), dtype=np.uint8)
+    padded = nstripes * sinfo.stripe_width
+    buf = np.zeros(padded, dtype=np.uint8)
+    buf[: len(data)] = np.frombuffer(data, dtype=np.uint8)
+    batch = buf.reshape(nstripes, k, unit)
+    bb = _bucket(nstripes)
+    if bb != nstripes:
+        batch = np.concatenate(
+            [batch, np.zeros((bb - nstripes, k, unit), dtype=np.uint8)])
+    parity = np.asarray(codec.encode_batch(batch))[:nstripes]
+    full = np.concatenate([batch[:nstripes], parity], axis=1)  # (ns, n, unit)
+    return full.transpose(1, 0, 2).reshape(n, nstripes * unit)
+
+
+def decode_stripes(
+    codec,
+    sinfo: StripeInfo,
+    shards: Mapping[int, np.ndarray],
+    logical_size: int,
+) -> bytes:
+    """Rebuild the logical bytes from >= k shard rows in one dispatch.
+
+    ``shards`` maps shard id -> (nstripes * unit) bytes.  Missing data
+    shards are reconstructed batched (one erasure pattern for the whole
+    object, reference ECBackend reply aggregation + ECUtil::decode).
+    """
+    k = sinfo.k
+    unit = sinfo.chunk_size
+    n = codec.get_chunk_count()
+    nstripes = sinfo.object_stripes(logical_size)
+    if nstripes == 0:
+        return b""
+    shard_len = nstripes * unit
+    have = sorted(shards)
+    data_rows: Dict[int, np.ndarray] = {}
+    for s in have:
+        arr = np.asarray(shards[s], dtype=np.uint8)
+        if arr.shape[0] != shard_len:
+            raise ValueError(
+                f"shard {s}: {arr.shape[0]} bytes, want {shard_len}")
+        if s < k:
+            data_rows[s] = arr
+    missing = [s for s in range(k) if s not in data_rows]
+    if missing:
+        if len(have) < k:
+            raise ValueError(f"only {len(have)} of {k} shards")
+        full = np.zeros((nstripes, n, unit), dtype=np.uint8)
+        for s in have:
+            full[:, s, :] = np.asarray(
+                shards[s], dtype=np.uint8).reshape(nstripes, unit)
+        erasures = tuple(s for s in range(n) if s not in shards)
+        bb = _bucket(nstripes)
+        if bb != nstripes:
+            full = np.concatenate(
+                [full, np.zeros((bb - nstripes, n, unit), dtype=np.uint8)])
+        recovered = np.asarray(
+            codec.decode_batch(erasures, full))[:nstripes]
+        for idx, e in enumerate(erasures):
+            if e < k:
+                data_rows[e] = recovered[:, idx, :].reshape(shard_len)
+    stacked = np.stack([data_rows[s].reshape(nstripes, unit)
+                        for s in range(k)], axis=1)
+    return stacked.reshape(nstripes * sinfo.stripe_width)[
+        :logical_size].tobytes()
+
+
+def merge_range(old: bytes, old_size: int, offset: int, data: bytes) -> bytes:
+    """Overlay ``data`` at ``offset`` onto ``old`` (zero-extending holes);
+    returns the new logical object bytes."""
+    new_size = max(old_size, offset + len(data))
+    buf = np.zeros(new_size, dtype=np.uint8)
+    if old:
+        buf[: len(old)] = np.frombuffer(old, dtype=np.uint8)
+    buf[offset: offset + len(data)] = np.frombuffer(data, dtype=np.uint8)
+    return buf.tobytes()

@@ -254,3 +254,86 @@ def test_client_misdirect_resend():
             await cluster.stop()
 
     run(scenario())
+
+
+def test_ec_partial_write_rmw():
+    """Overwrite a sub-range of an EC object: read-modify-write over stripe
+    bounds (reference ECBackend::start_rmw, ECBackend.cc:1785)."""
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            profile = dict(EC_PROFILE, stripe_unit="64")
+            pool = await client.pool_create("ecpool", "erasure", pg_num=4,
+                                            ec_profile=profile)
+            io = client.ioctx(pool)
+            base = bytes(range(256)) * 4  # 1024 bytes = 8 stripes of 128
+            await io.write_full("rmw", base)
+            # unaligned overwrite inside one stripe
+            patch = b"X" * 50
+            await io.write("rmw", patch, offset=200)
+            expect = bytearray(base)
+            expect[200:250] = patch
+            assert await io.read("rmw") == bytes(expect)
+            # overwrite spanning stripe boundaries
+            patch2 = b"Y" * 300
+            await io.write("rmw", patch2, offset=100)
+            expect[100:400] = patch2
+            assert await io.read("rmw") == bytes(expect)
+            # appending extension past the old end
+            tail = b"Z" * 77
+            await io.write("rmw", tail, offset=len(expect) + 31)
+            expect_full = bytes(expect) + b"\0" * 31 + tail
+            assert await io.read("rmw") == expect_full
+            assert await io.stat("rmw") == len(expect_full)
+            # range reads
+            assert await io.read("rmw", offset=150, length=100) == \
+                expect_full[150:250]
+            assert await io.read("rmw", offset=1000) == expect_full[1000:]
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_ec_rmw_survives_shard_loss():
+    """RMW then kill an OSD: the modified object decodes correctly from the
+    survivors (stripe-consistent shards)."""
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            profile = dict(EC_PROFILE, stripe_unit="64")
+            pool = await client.pool_create("ecpool", "erasure", pg_num=4,
+                                            ec_profile=profile)
+            io = client.ioctx(pool)
+            base = b"A" * 640
+            await io.write_full("obj", base)
+            await io.write("obj", b"B" * 128, offset=256)
+            expect = b"A" * 256 + b"B" * 128 + b"A" * 256
+            victim = 0
+            await cluster.kill_osd(victim)
+            await cluster.wait_down(victim)
+            assert await io.read("obj") == expect
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_replicated_partial_write():
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("repl", "replicated",
+                                            pg_num=4, size=2)
+            io = client.ioctx(pool)
+            await io.write_full("p", b"0123456789")
+            await io.write("p", b"AB", offset=3)
+            assert await io.read("p") == b"012AB56789"
+            assert await io.read("p", offset=2, length=4) == b"2AB5"
+        finally:
+            await cluster.stop()
+
+    run(scenario())
