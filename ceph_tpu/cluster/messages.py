@@ -39,12 +39,23 @@ class MOSDAlive(Message):
 class MMonSubscribe(Message):
     what: str = "osdmap"
     addr: Optional[Addr] = None
+    since: int = 0  # subscriber's current epoch; 0 = send the full map
 
 
 @dataclass
 class MOSDMapMsg(Message):
     epoch: int = 0
     osdmap_blob: bytes = b""
+
+
+@dataclass
+class MOSDIncMapMsg(Message):
+    """Incremental map delta chain: apply in order on top of prev_epoch
+    (reference OSDMap::Incremental distribution)."""
+
+    prev_epoch: int = 0
+    epoch: int = 0
+    inc_blobs: List[bytes] = field(default_factory=list)
 
 
 @dataclass

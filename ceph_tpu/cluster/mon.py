@@ -27,6 +27,7 @@ from ceph_tpu.crush.types import (
     Rule,
 )
 from ceph_tpu.osdmap.osdmap import (
+    Incremental,
     OSDMap,
     PGPool,
     POOL_TYPE_ERASURE,
@@ -50,6 +51,10 @@ class Monitor(Dispatcher):
         self._tick_task: Optional[asyncio.Task] = None
         self._log: List[Tuple[str, object]] = []  # proposal log (Paxos seam)
         self._next_pool_id = max(self.osdmap.pools, default=0) + 1
+        # recent incrementals by resulting epoch (reference: mon keeps a
+        # window of full+inc maps; subscribers behind the window get a full
+        # map).  Size mirrors osd_map_cache_size.
+        self._inc_log: Dict[int, Incremental] = {}
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> Addr:
         addr = await self.messenger.bind(host, port)
@@ -67,7 +72,17 @@ class Monitor(Dispatcher):
         self._log.append((what, payload))
         self.perf.inc("mon_proposals")
 
-    async def _commit_map_change(self) -> None:
+    def _new_inc(self) -> Incremental:
+        return Incremental(epoch=self.osdmap.epoch + 1)
+
+    async def _commit_inc(self, inc: Incremental) -> None:
+        """Apply a delta to the authoritative map, log it, broadcast it."""
+        self.osdmap.apply_incremental(inc)
+        self._inc_log[inc.epoch] = inc
+        cutoff = inc.epoch - self.config.osd_map_cache_size
+        for e in [e for e in self._inc_log if e <= cutoff]:
+            del self._inc_log[e]
+        self.perf.inc("mon_map_epochs")
         await self._broadcast_map()
 
     # -- dispatch ----------------------------------------------------------
@@ -81,7 +96,7 @@ class Monitor(Dispatcher):
             return True
         if isinstance(msg, M.MMonSubscribe):
             self.subscribers.add(tuple(msg.addr))
-            await self._send_map(tuple(msg.addr))
+            await self._send_map(tuple(msg.addr), since=msg.since)
             return True
         if isinstance(msg, M.MMonCommand):
             await self._handle_command(conn, msg)
@@ -93,15 +108,12 @@ class Monitor(Dispatcher):
         m = self.osdmap
         if msg.osd_id >= m.max_osd:
             return
-        m.osd_addrs[msg.osd_id] = tuple(msg.addr)
-        if not m.osd_up[msg.osd_id]:
-            m.mark_up(msg.osd_id)
-        else:
-            m.epoch += 1
+        inc = self._new_inc()
+        inc.new_up[msg.osd_id] = tuple(msg.addr)
         self.down_since.pop(msg.osd_id, None)
         self.failure_reports.pop(msg.osd_id, None)
         self.perf.inc("mon_osd_boot")
-        await self._commit_map_change()
+        await self._commit_inc(inc)
 
     async def _handle_failure(self, msg: M.MOSDFailure) -> None:
         m = self.osdmap
@@ -113,11 +125,12 @@ class Monitor(Dispatcher):
         # can_mark_down analog: enough distinct reporters
         if len(reporters) >= self.config.mon_osd_min_down_reporters:
             self._propose("down", osd)
-            m.mark_down(osd)
+            inc = self._new_inc()
+            inc.new_down.append(osd)
             self.down_since[osd] = time.monotonic()
             self.failure_reports.pop(osd, None)
             self.perf.inc("mon_osd_marked_down")
-            await self._commit_map_change()
+            await self._commit_inc(inc)
 
     async def _handle_command(self, conn: Connection, msg: M.MMonCommand) -> None:
         cmd = msg.cmd
@@ -125,14 +138,16 @@ class Monitor(Dispatcher):
         try:
             prefix = cmd.get("prefix")
             if prefix == "osd pool create":
-                data = self._create_pool(cmd)
-                await self._commit_map_change()
+                data, inc = self._create_pool(cmd)
+                await self._commit_inc(inc)
             elif prefix == "osd out":
-                self.osdmap.mark_out(int(cmd["id"]))
-                await self._commit_map_change()
+                inc = self._new_inc()
+                inc.new_weights[int(cmd["id"])] = 0
+                await self._commit_inc(inc)
             elif prefix == "osd in":
-                self.osdmap.mark_in(int(cmd["id"]))
-                await self._commit_map_change()
+                inc = self._new_inc()
+                inc.new_weights[int(cmd["id"])] = 0x10000
+                await self._commit_inc(inc)
             elif prefix == "status":
                 m = self.osdmap
                 data = {
@@ -154,7 +169,8 @@ class Monitor(Dispatcher):
         reply = M.MMonCommandReply(tid=msg.tid, result=result, data=data)
         await conn.send(reply)
 
-    def _create_pool(self, cmd: Dict) -> int:
+    def _create_pool(self, cmd: Dict) -> Tuple[int, Incremental]:
+        """Build the pool + rule delta (committed by the caller)."""
         name = cmd["pool"]
         pool_type = POOL_TYPE_ERASURE if cmd.get("pool_type") == "erasure" \
             else POOL_TYPE_REPLICATED
@@ -165,6 +181,7 @@ class Monitor(Dispatcher):
                 root = bid
                 break
         ec_profile = dict(cmd.get("ec_profile") or {})
+        ruleno = len(m.crush.rules)  # appended by apply_incremental
         if pool_type == POOL_TYPE_ERASURE:
             from ceph_tpu.ec import factory
 
@@ -174,54 +191,73 @@ class Monitor(Dispatcher):
             size = codec.get_chunk_count()
             min_size = codec.get_data_chunk_count()
             # ErasureCode::create_rule analog: indep chooseleaf rule
-            ruleno = m.crush.add_rule(Rule(steps=[
+            rule = Rule(steps=[
                 (RULE_TAKE, root, 0),
                 (RULE_CHOOSELEAF_INDEP, size, 1),
-                (RULE_EMIT, 0, 0)], type=POOL_TYPE_ERASURE))
+                (RULE_EMIT, 0, 0)], type=POOL_TYPE_ERASURE)
         else:
             size = int(cmd.get("size", self.config.osd_pool_default_size))
             min_size = max(1, size - 1)
-            ruleno = m.crush.add_rule(Rule(steps=[
+            rule = Rule(steps=[
                 (RULE_TAKE, root, 0),
                 (RULE_CHOOSELEAF_FIRSTN, size, 1),
-                (RULE_EMIT, 0, 0)]))
+                (RULE_EMIT, 0, 0)])
         pg_num = int(cmd.get("pg_num", self.config.osd_pool_default_pg_num))
         pool_id = self._next_pool_id
         self._next_pool_id += 1
-        m.add_pool(PGPool(
+        inc = self._new_inc()
+        inc.new_rules.append(rule)
+        inc.new_pools[pool_id] = PGPool(
             pool_id=pool_id, type=pool_type, size=size, min_size=min_size,
             pg_num=pg_num, pgp_num=pg_num, crush_rule=ruleno,
-            ec_profile=ec_profile, name=name))
-        m.invalidate_mappers()  # rules changed
+            ec_profile=ec_profile, name=name)
         self._propose("pool_create", (pool_id, name))
         self.perf.inc("mon_pool_create")
-        return pool_id
+        return pool_id, inc
 
     # -- map distribution --------------------------------------------------
 
     async def _broadcast_map(self) -> None:
+        """Push the newest delta to subscribers (O(delta), not O(map))."""
         for addr in list(self.subscribers):
             try:
-                await self._send_map(addr)
+                await self._send_map(addr, since=self.osdmap.epoch - 1)
             except (ConnectionError, OSError):
                 self.subscribers.discard(addr)
 
-    async def _send_map(self, addr: Addr) -> None:
+    async def _send_map(self, addr: Addr, since: int = 0) -> None:
+        """Send incrementals covering (since, current] when the window has
+        them, else the full map (reference OSDMonitor send_incremental)."""
+        epoch = self.osdmap.epoch
+        if 0 < since <= epoch:
+            chain = []
+            e = since + 1
+            while e <= epoch and e in self._inc_log:
+                chain.append(pickle.dumps(self._inc_log[e]))
+                e += 1
+            if e > epoch:
+                # complete chain (possibly empty when already current; the
+                # empty message still acks the subscriber's refresh)
+                self.perf.inc("mon_inc_maps_sent")
+                await self.messenger.send_message(
+                    M.MOSDIncMapMsg(prev_epoch=since, epoch=epoch,
+                                    inc_blobs=chain), addr)
+                return
+        self.perf.inc("mon_full_maps_sent")
         blob = pickle.dumps(self.osdmap)
         await self.messenger.send_message(
-            M.MOSDMapMsg(epoch=self.osdmap.epoch, osdmap_blob=blob), addr)
+            M.MOSDMapMsg(epoch=epoch, osdmap_blob=blob), addr)
 
     async def _tick(self) -> None:
         """Down-out tick (reference OSDMonitor tick auto-out)."""
         while True:
             await asyncio.sleep(self.config.mon_tick_interval)
             now = time.monotonic()
-            changed = False
+            inc = self._new_inc()
             for osd, since in list(self.down_since.items()):
                 if now - since > self.config.mon_osd_down_out_interval and \
                         self.osdmap.osd_weight[osd] > 0:
-                    self.osdmap.mark_out(osd)
+                    inc.new_weights[osd] = 0
                     self.down_since.pop(osd)
-                    changed = True
-            if changed:
-                await self._commit_map_change()
+            if inc.new_weights:
+                await self._commit_inc(inc)

@@ -53,8 +53,26 @@ class Objecter(Dispatcher):
 
     async def ms_dispatch(self, conn: Connection, msg) -> bool:
         if isinstance(msg, M.MOSDMapMsg):
-            self.osdmap = pickle.loads(msg.osdmap_blob)
+            newmap = pickle.loads(msg.osdmap_blob)
+            if self.osdmap is None or newmap.epoch >= self.osdmap.epoch:
+                self.osdmap = newmap
             self._map_event.set()
+            return True
+        if isinstance(msg, M.MOSDIncMapMsg):
+            m = self.osdmap
+            if m is not None and msg.prev_epoch == m.epoch:
+                for blob in msg.inc_blobs:
+                    m.apply_incremental(pickle.loads(blob))
+                self._map_event.set()
+            elif m is not None and msg.epoch <= m.epoch:
+                self._map_event.set()  # already current
+            else:
+                # gap: resync from our epoch
+                await self.messenger.send_message(
+                    M.MMonSubscribe(what="osdmap",
+                                    addr=self.messenger.my_addr,
+                                    since=m.epoch if m else 0),
+                    self.mon_addr)
             return True
         if isinstance(msg, M.MOSDOpReply):
             fut = self._inflight.pop(tuple(msg.reqid), None)
@@ -83,7 +101,8 @@ class Objecter(Dispatcher):
     async def _refresh_map(self) -> None:
         self._map_event.clear()
         await self.messenger.send_message(
-            M.MMonSubscribe(what="osdmap", addr=self.messenger.my_addr),
+            M.MMonSubscribe(what="osdmap", addr=self.messenger.my_addr,
+                            since=self.osdmap.epoch if self.osdmap else 0),
             self.mon_addr)
         await asyncio.wait_for(self._map_event.wait(), timeout=10)
 

@@ -136,6 +136,9 @@ class OSDDaemon(Dispatcher):
         if isinstance(msg, M.MOSDMapMsg):
             await self._handle_map(msg)
             return True
+        if isinstance(msg, M.MOSDIncMapMsg):
+            await self._handle_inc_map(msg)
+            return True
         if isinstance(msg, M.MOSDOp):
             await self._handle_client_op(conn, msg)
             return True
@@ -218,11 +221,33 @@ class OSDDaemon(Dispatcher):
 
     # ------------------------------------------------------------ map flow
 
+    async def _handle_inc_map(self, msg: M.MOSDIncMapMsg) -> None:
+        """Apply a delta chain (reference handle_osd_map incremental path).
+        On an epoch gap, re-subscribe from our epoch to resync."""
+        m = self.osdmap
+        if m is None or msg.prev_epoch != m.epoch:
+            if m is not None and msg.epoch <= m.epoch:
+                return  # stale or duplicate
+            await self.messenger.send_message(
+                M.MMonSubscribe(what="osdmap", addr=self.messenger.my_addr,
+                                since=m.epoch if m else 0), self.mon_addr)
+            return
+        for blob in msg.inc_blobs:
+            m.apply_incremental(pickle.loads(blob))
+        self.perf.set("osd_map_epoch", m.epoch)
+        await self._post_map_update()
+
     async def _handle_map(self, msg: M.MOSDMapMsg) -> None:
         newmap: OSDMap = pickle.loads(msg.osdmap_blob)
         old = self.osdmap
+        if old is not None and newmap.epoch < old.epoch:
+            return  # stale full map
         self.osdmap = newmap
         self.perf.set("osd_map_epoch", newmap.epoch)
+        await self._post_map_update()
+
+    async def _post_map_update(self) -> None:
+        newmap = self.osdmap
         if not self._stopped and self.osd_id < newmap.max_osd and \
                 not newmap.osd_up[self.osd_id]:
             # the map says we are down but we are alive: re-boot (reference
@@ -242,9 +267,8 @@ class OSDDaemon(Dispatcher):
         m = self.osdmap
         changed = False
         for pool_id, pool in m.pools.items():
-            for seed in range(pool.pg_num):
-                pgid = PGid(pool_id, seed)
-                up, upp, acting, actp = m.pg_to_up_acting_osds(pgid)
+            for pgid, up, upp, acting, actp in self._pool_memberships(
+                    m, pool_id, pool):
                 mine = self.osd_id in [o for o in acting if o != CRUSH_ITEM_NONE]
                 old = self.pgs.get(pgid)
                 if mine:
@@ -258,6 +282,33 @@ class OSDDaemon(Dispatcher):
                     del self.pgs[pgid]
                     changed = True
         return changed
+
+    def _pool_memberships(self, m: OSDMap, pool_id: int, pool: PGPool):
+        """Yield (pgid, up, upp, acting, actp) for every PG of a pool.
+
+        Large pools go through the batched whole-pool placement (one TPU
+        dispatch via OSDMap.pool_mapping, which falls back to the scalar
+        mapper for map shapes the TensorMapper rejects); sparse pg_temp /
+        primary_temp overrides re-run the scalar chain per affected PG.
+        Small pools stay scalar — a per-epoch device dispatch costs more
+        than it saves below a few hundred PGs."""
+        batch_min = self.config.osd_map_batch_min_pgs
+        if pool.pg_num < batch_min:
+            for seed in range(pool.pg_num):
+                pgid = PGid(pool_id, seed)
+                yield (pgid, *m.pg_to_up_acting_osds(pgid))
+            return
+        up_arr, upp_arr = m.pool_mapping(pool_id)
+        for seed in range(pool.pg_num):
+            pgid = PGid(pool_id, seed)
+            if pgid in m.pg_temp or pgid in m.primary_temp:
+                yield (pgid, *m.pg_to_up_acting_osds(pgid))
+                continue
+            row = up_arr[seed]
+            up = [int(o) for o in row if o != CRUSH_ITEM_NONE] \
+                if pool.can_shift_osds() else [int(o) for o in row]
+            upp = int(upp_arr[seed])
+            yield pgid, up, upp, up, upp
 
     # -------------------------------------------------------- client ops
 

@@ -103,6 +103,24 @@ class PGPool:
         return stable + np.uint32(self.pool_id)
 
 
+@dataclass
+class Incremental:
+    """Map delta producing epoch ``epoch`` from ``epoch - 1`` (reference
+    OSDMap::Incremental, src/osd/OSDMap.h): the mon ships these instead of
+    re-serializing the world on every change; consumers apply them in
+    order."""
+
+    epoch: int
+    new_up: Dict[int, object] = field(default_factory=dict)  # osd -> addr
+    new_down: List[int] = field(default_factory=list)
+    new_weights: Dict[int, int] = field(default_factory=dict)
+    new_pools: Dict[int, "PGPool"] = field(default_factory=dict)
+    new_rules: List[object] = field(default_factory=list)  # appended in order
+    new_pg_temp: Dict["PGid", List[int]] = field(default_factory=dict)
+    new_primary_temp: Dict["PGid", int] = field(default_factory=dict)
+    new_primary_affinity: Dict[int, int] = field(default_factory=dict)
+
+
 class OSDMap:
     def __init__(self, crush: CrushMap, max_osd: int = 0):
         self.epoch = 1
@@ -179,12 +197,56 @@ class OSDMap:
         self.pools[pool.pool_id] = pool
         self.epoch += 1
 
+    def apply_incremental(self, inc: Incremental) -> None:
+        """Advance this map by one epoch delta (reference
+        OSDMap::apply_incremental, src/osd/OSDMap.cc)."""
+        if inc.epoch != self.epoch + 1:
+            raise ValueError(
+                f"incremental {inc.epoch} does not follow epoch {self.epoch}")
+        for osd, addr in inc.new_up.items():
+            if 0 <= osd < self.max_osd:
+                self.osd_up[osd] = True
+                if addr is not None:
+                    self.osd_addrs[osd] = tuple(addr)
+        for osd in inc.new_down:
+            if 0 <= osd < self.max_osd:
+                self.osd_up[osd] = False
+        for osd, w in inc.new_weights.items():
+            if 0 <= osd < self.max_osd:
+                self.osd_weight[osd] = w
+        for osd, aff in inc.new_primary_affinity.items():
+            self.set_primary_affinity(osd, aff)
+        for pg, temp in inc.new_pg_temp.items():
+            if temp:
+                self.pg_temp[pg] = list(temp)
+            else:
+                self.pg_temp.pop(pg, None)
+        for pg, tp in inc.new_primary_temp.items():
+            if tp >= 0:
+                self.primary_temp[pg] = tp
+            else:
+                self.primary_temp.pop(pg, None)
+        if inc.new_rules:
+            for rule in inc.new_rules:
+                self.crush.add_rule(rule)
+            self.invalidate_mappers()
+        for pool_id, pool in inc.new_pools.items():
+            self.pools[pool_id] = pool
+        self.epoch = inc.epoch
+
     @property
     def tensor_mapper(self):
         if self._tensor is None:
             from ceph_tpu.crush.mapper import TensorMapper
 
-            self._tensor = TensorMapper(self.crush)
+            try:
+                self._tensor = TensorMapper(self.crush)
+            except (NotImplementedError, AssertionError) as e:
+                # cache the rejection so every pool_mapping call does not
+                # retry construction against an unsupported map
+                self._tensor = e
+        if isinstance(self._tensor, Exception):
+            raise self._tensor
         return self._tensor
 
     # -- placement pipeline (scalar) ---------------------------------------
@@ -319,12 +381,28 @@ class OSDMap:
         pool = self.pools[pool_id]
         seeds = np.arange(pool.pg_num, dtype=np.uint32)
         pps = pool.raw_pg_to_pps_batch(seeds)
-        weights = np.zeros(self.crush.max_devices, dtype=np.uint32)
-        weights[: self.max_osd] = self.osd_weight
-        res, rlen = self.tensor_mapper.do_rule_batch(
-            pool.crush_rule, pps, pool.size, weights)
-        res = np.asarray(res)
-        rlen = np.asarray(rlen)
+        try:
+            mapper = self.tensor_mapper
+        except (NotImplementedError, AssertionError):
+            # map shape the vectorized mapper rejects (legacy tunables,
+            # non-straw2 buckets, sparse bucket ids): scalar fallback with
+            # identical semantics
+            res_l, rlen_l = [], []
+            for s in range(pool.pg_num):
+                raw = self._scalar.do_rule(pool.crush_rule, int(pps[s]),
+                                           pool.size, self.osd_weight)
+                res_l.append(raw + [0] * (pool.size - len(raw)))
+                rlen_l.append(len(raw))
+            res = np.asarray(res_l, dtype=np.int64).reshape(
+                pool.pg_num, pool.size)
+            rlen = np.asarray(rlen_l, dtype=np.int64)
+        else:
+            weights = np.zeros(self.crush.max_devices, dtype=np.uint32)
+            weights[: self.max_osd] = self.osd_weight
+            res, rlen = mapper.do_rule_batch(
+                pool.crush_rule, pps, pool.size, weights)
+            res = np.asarray(res)
+            rlen = np.asarray(rlen)
         up = np.full((pool.pg_num, pool.size), CRUSH_ITEM_NONE, dtype=np.int64)
         upp = np.full(pool.pg_num, -1, dtype=np.int64)
         # post-passes per PG on the host (vectorize later if they show up

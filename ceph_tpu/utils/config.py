@@ -37,6 +37,8 @@ OPTIONS: List[Option] = [
     Option("osd_recovery_delay_start", float, 0.0),
     Option("osd_client_op_timeout", float, 10.0),
     Option("osd_map_cache_size", int, 50),
+    Option("osd_map_batch_min_pgs", int, 256,
+           "pools with at least this many PGs use batched placement"),
     # mon
     Option("mon_osd_down_out_interval", float, 30.0,
            "auto-out after down this long"),

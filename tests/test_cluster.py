@@ -337,3 +337,27 @@ def test_replicated_partial_write():
             await cluster.stop()
 
     run(scenario())
+
+
+def test_map_distribution_is_incremental():
+    """After the initial full map, epoch churn ships deltas: the number of
+    full maps sent stays bounded by subscriber joins, not by epochs."""
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            for i in range(4):
+                await client.pool_create(f"p{i}", "replicated", pg_num=4,
+                                         size=2)
+            perf = cluster.mon.perf.dump()["mon"]
+            # 3 OSD subscribes + 1 client subscribe = at most a handful of
+            # full maps; the pool-create broadcasts must all be incremental
+            assert perf.get("mon_inc_maps_sent", 0) >= 8, perf
+            assert perf.get("mon_full_maps_sent", 0) <= 6, perf
+            # clients converge on the same epoch as the mon
+            await client.objecter._refresh_map()
+            assert client.objecter.osdmap.epoch == cluster.mon.osdmap.epoch
+        finally:
+            await cluster.stop()
+
+    run(scenario())

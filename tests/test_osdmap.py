@@ -113,3 +113,102 @@ def test_pps_batch_matches_scalar():
     batch = pool.raw_pg_to_pps_batch(seeds)
     for s in range(64):
         assert int(batch[s]) == pool.raw_pg_to_pps(s)
+
+
+def test_apply_incremental_matches_direct_mutation():
+    from ceph_tpu.osdmap.osdmap import Incremental
+
+    m = build_simple_osdmap(n_osds=16, pg_num=32)
+    direct = copy.deepcopy(m)
+    direct.mark_down(3)
+    direct.mark_out(3)
+    direct.mark_down(7)
+
+    inc = Incremental(epoch=m.epoch + 1)
+    inc.new_down.extend([3, 7])
+    inc.new_weights[3] = 0
+    m.apply_incremental(inc)
+
+    assert not m.osd_up[3] and not m.osd_up[7]
+    assert m.osd_weight[3] == 0
+    for seed in range(32):
+        assert m.pg_to_up_acting_osds(PGid(1, seed)) == \
+            direct.pg_to_up_acting_osds(PGid(1, seed))
+
+    # a gap is rejected
+    bad = Incremental(epoch=m.epoch + 5)
+    with pytest.raises(ValueError):
+        m.apply_incremental(bad)
+
+
+def test_apply_incremental_new_pool_and_rule():
+    from ceph_tpu.crush.types import (
+        RULE_CHOOSELEAF_FIRSTN, RULE_EMIT, RULE_TAKE, Rule)
+    from ceph_tpu.osdmap.osdmap import Incremental
+
+    m = build_simple_osdmap(n_osds=16, pg_num=32)
+    root = [bid for bid, b in m.crush.buckets.items() if b.type == 3][0]
+    ruleno = len(m.crush.rules)
+    inc = Incremental(epoch=m.epoch + 1)
+    inc.new_rules.append(Rule(steps=[
+        (RULE_TAKE, root, 0), (RULE_CHOOSELEAF_FIRSTN, 2, 1),
+        (RULE_EMIT, 0, 0)]))
+    inc.new_pools[9] = PGPool(pool_id=9, size=2, min_size=1, pg_num=16,
+                              pgp_num=16, crush_rule=ruleno, name="p9")
+    m.apply_incremental(inc)
+    up, upp, acting, actp = m.pg_to_up_acting_osds(PGid(9, 0))
+    assert len(up) == 2 and upp == up[0]
+
+
+def test_incremental_pg_temp_set_and_clear():
+    from ceph_tpu.osdmap.osdmap import Incremental
+
+    m = build_simple_osdmap(n_osds=16, pg_num=32)
+    pg = PGid(1, 5)
+    up, upp, _, _ = m.pg_to_up_acting_osds(pg)
+    temp = [o for o in range(16) if o not in up][:3]
+    inc = Incremental(epoch=m.epoch + 1)
+    inc.new_pg_temp[pg] = temp
+    m.apply_incremental(inc)
+    _, _, acting, actp = m.pg_to_up_acting_osds(pg)
+    assert acting == temp and actp == temp[0]
+    inc2 = Incremental(epoch=m.epoch + 1)
+    inc2.new_pg_temp[pg] = []
+    m.apply_incremental(inc2)
+    _, _, acting, _ = m.pg_to_up_acting_osds(pg)
+    assert acting == up
+
+
+def test_pool_mapping_scalar_fallback_uniform_bucket():
+    """A map the TensorMapper rejects (uniform bucket) must still batch-map
+    via the scalar fallback, matching the per-PG chain."""
+    from ceph_tpu.crush.types import (
+        Bucket, CrushMap, RULE_CHOOSELEAF_FIRSTN, RULE_EMIT, RULE_TAKE, Rule)
+
+    cmap = CrushMap()
+    host_ids = []
+    dev = 0
+    for h in range(4):
+        items = [dev, dev + 1]
+        dev += 2
+        hid = cmap.add_bucket(
+            Bucket(id=0, type=1, alg="uniform", items=items,
+                   weights=[0x10000, 0x10000]), name=f"host{h}")
+        host_ids.append(hid)
+    root = cmap.add_bucket(
+        Bucket(id=0, type=3, alg="straw2", items=host_ids,
+               weights=[0x20000] * 4), name="default")
+    ruleno = cmap.add_rule(Rule(steps=[
+        (RULE_TAKE, root, 0), (RULE_CHOOSELEAF_FIRSTN, 3, 1),
+        (RULE_EMIT, 0, 0)]))
+    m = OSDMap(cmap, max_osd=8)
+    m.add_pool(PGPool(pool_id=1, size=3, min_size=2, pg_num=32, pgp_num=32,
+                      crush_rule=ruleno, name="u"))
+    with pytest.raises(NotImplementedError):
+        _ = m.tensor_mapper
+    up, upp = m.pool_mapping(1)  # must not raise: scalar fallback
+    for seed in range(32):
+        su, supp, _, _ = m.pg_to_up_acting_osds(PGid(1, seed))
+        row = [int(o) for o in up[seed] if o != CRUSH_ITEM_NONE]
+        assert row == su, seed
+        assert int(upp[seed]) == supp
