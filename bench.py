@@ -4,13 +4,20 @@
 The reference tool (src/test/erasure-code/ceph_erasure_code_benchmark.cc)
 times plugin encode/decode over an object of --size for --iterations and
 prints seconds + KiB.  This harness runs the same configs (BASELINE.json)
-against the TPU batch engine and prints ONE JSON line:
+against the TPU batch engine and prints one JSON line per metric; the LAST
+line is always the headline (north-star) metric:
 
     {"metric": ..., "value": GB/s, "unit": "GB/s", "vs_baseline": x}
 
-Default metric: the north star — ISA-compatible RS k=8,m=4 encode at 4KiB
-stripes, batch=4096, on one chip.  --all prints every BASELINE config (one
-JSON line each; the last line is the headline metric).
+Measurement methodology (round 3, after the r01->r02 "regression"):
+each repeat enqueues `iters` dispatches back-to-back and blocks ONCE at the
+end — JAX async dispatch pipelines them, so the figure is sustained device
+throughput.  The old harness blocked per call, so it measured host<->device
+round-trip latency over the axon tunnel; that latency is environment-noisy
+(r01 408 vs r02 264 GB/s on an identical code path — both were samples of
+tunnel latency, not codec speed).  We take the median of `repeats` repeats
+and report min/max spread so an outlier can never silently become the
+number of record again.
 
 Baseline constant: the reference publishes no numbers (BASELINE.md); ISA-L
 single-socket RS(8,4) encode measures in the ~5 GB/s range on contemporary
@@ -20,6 +27,7 @@ locally-measured reference binary exists.
 
 import argparse
 import json
+import statistics
 import sys
 import time
 
@@ -28,97 +36,152 @@ import numpy as np
 BASELINE_GBPS = 5.0
 
 
-def _bench(fn, args, iters, warmup=3):
+def _bench(fn, args, iters, repeats=5, warmup=2):
+    """Median seconds-per-call over `repeats` pipelined timing windows.
+
+    Returns (median, min, max) of the per-call time.  Each window enqueues
+    `iters` async dispatches and blocks once, so per-call dispatch latency
+    is amortized and the device queue stays full (sustained throughput,
+    which is what the reference tool's bytes/seconds accounting reports for
+    a hot CPU loop, ceph_erasure_code_benchmark.cc:180-187).
+    """
     import jax
 
     for _ in range(warmup):
-        out = fn(*args)
+        jax.block_until_ready(fn(*args))
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(iters):
+            out = fn(*args)
         jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = fn(*args)
-        jax.block_until_ready(out)
-    dt = time.perf_counter() - t0
-    return dt / iters
+        times.append((time.perf_counter() - t0) / iters)
+    return statistics.median(times), min(times), max(times)
 
 
-def bench_ec(profile, batch, chunk, workload="encode", erasures=(0,), iters=20):
-    """Returns GB/s of input data processed (matching the reference tool's
-    accounting: object bytes per iteration / seconds,
+def bench_ec(profile, batch, chunk, workload="encode", erasures=(0,), iters=20,
+             repeats=5):
+    """Returns (median, min, max) GB/s of input data processed (matching the
+    reference tool's accounting: object bytes per iteration / seconds,
     ceph_erasure_code_benchmark.cc:187)."""
     import jax.numpy as jnp
 
     from ceph_tpu.ec import factory
 
-    codec = factory(profile)
+    codec = factory(dict(profile))
     k = codec.get_data_chunk_count()
     rng = np.random.default_rng(0)
     data = jnp.asarray(rng.integers(0, 256, (batch, k, chunk), dtype=np.uint8))
+    nbytes = batch * k * chunk
     if workload == "encode":
-        secs = _bench(codec.encode_batch, (data,), iters)
+        med, lo, hi = _bench(codec.encode_batch, (data,), iters, repeats)
     else:
         parity = codec.encode_batch(data)
         full = jnp.concatenate([data, jnp.asarray(parity)], axis=1)
-        secs = _bench(codec.decode_batch, (tuple(erasures), full), iters)
-    nbytes = batch * k * chunk
-    return nbytes / secs / 1e9
+        med, lo, hi = _bench(
+            codec.decode_batch, (tuple(erasures), full), iters, repeats)
+    return nbytes / med / 1e9, nbytes / hi / 1e9, nbytes / lo / 1e9
 
 
-def bench_crush(n_osds=10_000, n_pgs=1_000_000, iters=5):
+def bench_crush(n_osds=10_000, n_pgs=1_000_000, iters=3):
     """Whole-map PG->OSD placement throughput (mappings/s)."""
-    try:
-        from ceph_tpu.crush import bench_map
-    except ImportError:
-        return None
+    from ceph_tpu.crush import bench_map
+
     return bench_map(n_osds=n_osds, n_pgs=n_pgs, iters=iters)
+
+
+def bench_crc32c(batch=4096, length=4096, iters=20, repeats=5):
+    """Batched device crc32c GB/s (reference src/common/crc32c.cc asm path)."""
+    import jax.numpy as jnp
+
+    from ceph_tpu.ops.crc32c import crc32c_batch
+
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(rng.integers(0, 256, (batch, length), dtype=np.uint8))
+    med, lo, hi = _bench(crc32c_batch, (data,), iters, repeats)
+    nbytes = batch * length
+    return nbytes / med / 1e9, nbytes / hi / 1e9, nbytes / lo / 1e9
+
+
+EC_CONFIGS = [
+    # (name, profile, kwargs) — BASELINE.md metric table configs.
+    ("ec_encode_jerasure_rsvan_k4m2_1M",
+     {"plugin": "jerasure", "technique": "reed_sol_van", "k": "4", "m": "2"},
+     dict(batch=16, chunk=262144, workload="encode")),
+    ("ec_decode_jerasure_rsvan_k4m2_1M_e2",
+     {"plugin": "jerasure", "technique": "reed_sol_van", "k": "4", "m": "2"},
+     dict(batch=16, chunk=262144, workload="decode", erasures=(0, 5))),
+    ("ec_encode_lrc_k4m2l3",
+     {"plugin": "lrc", "k": "4", "m": "2", "l": "3"},
+     dict(batch=1024, chunk=4096, workload="encode")),
+    ("ec_decode_lrc_k4m2l3_e1",
+     {"plugin": "lrc", "k": "4", "m": "2", "l": "3"},
+     dict(batch=1024, chunk=4096, workload="decode", erasures=(1,))),
+    ("ec_decode_shec_643_e3",
+     {"plugin": "shec", "k": "6", "m": "4", "c": "3"},
+     dict(batch=1024, chunk=4096, workload="decode", erasures=(0, 3, 7))),
+    ("ec_decode_isa_k8m4_4k_e1",
+     {"plugin": "isa", "k": "8", "m": "4"},
+     dict(batch=4096, chunk=512, workload="decode", erasures=(2,))),
+]
 
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--all", action="store_true", help="run every BASELINE config")
+    ap.add_argument("--all", action="store_true",
+                    help="compat alias: the full metric set is the default now")
+    ap.add_argument("--headline-only", action="store_true",
+                    help="skip the full metric set, print only the headline")
     ap.add_argument("--iterations", type=int, default=20)
+    ap.add_argument("--repeats", type=int, default=5)
     args = ap.parse_args()
 
     results = []
-    if args.all:
-        configs = [
-            ("ec_encode_jerasure_rsvan_k4m2_1M",
-             {"plugin": "jerasure", "technique": "reed_sol_van", "k": "4", "m": "2"},
-             dict(batch=16, chunk=262144, workload="encode")),
-            ("ec_encode_lrc_k4m2l3",
-             {"plugin": "lrc", "k": "4", "m": "2", "l": "3"},
-             dict(batch=1024, chunk=4096, workload="encode")),
-            ("ec_decode_shec_643",
-             {"plugin": "shec", "k": "6", "m": "4", "c": "3"},
-             dict(batch=1024, chunk=4096, workload="decode", erasures=(0, 3, 7))),
-            ("ec_decode_isa_k8m4_4k_e1",
-             {"plugin": "isa", "k": "8", "m": "4"},
-             dict(batch=4096, chunk=512, workload="decode", erasures=(2,))),
-        ]
-        for name, profile, kw in configs:
+    if not args.headline_only:
+        for name, profile, kw in EC_CONFIGS:
             try:
-                gbps = bench_ec(profile, iters=args.iterations, **kw)
-            except Exception as e:  # plugin not yet implemented
-                print(json.dumps({"metric": name, "error": str(e)}), file=sys.stderr)
+                med, lo, hi = bench_ec(profile, iters=args.iterations,
+                                       repeats=args.repeats, **kw)
+            except Exception as e:
+                print(json.dumps({"metric": name, "error": repr(e)}),
+                      file=sys.stderr)
                 continue
-            results.append({"metric": name, "value": round(gbps, 3), "unit": "GB/s",
-                            "vs_baseline": round(gbps / BASELINE_GBPS, 3)})
-        pg_per_s = bench_crush()
-        if pg_per_s:
-            results.append({"metric": "crush_map_10kosd_1Mpg", "value": round(pg_per_s),
-                            "unit": "mappings/s", "vs_baseline": None})
+            results.append({
+                "metric": name, "value": round(med, 3), "unit": "GB/s",
+                "vs_baseline": round(med / BASELINE_GBPS, 3),
+                "min": round(lo, 3), "max": round(hi, 3)})
+        try:
+            med, lo, hi = bench_crc32c(iters=args.iterations,
+                                       repeats=args.repeats)
+            results.append({
+                "metric": "crc32c_batch_4096x4KiB", "value": round(med, 3),
+                "unit": "GB/s", "vs_baseline": None,
+                "min": round(lo, 3), "max": round(hi, 3)})
+        except Exception as e:
+            print(json.dumps({"metric": "crc32c_batch_4096x4KiB",
+                              "error": repr(e)}), file=sys.stderr)
+        try:
+            pg_per_s = bench_crush()
+            results.append({
+                "metric": "crush_map_10kosd_1Mpg", "value": round(pg_per_s),
+                "unit": "mappings/s", "vs_baseline": None})
+        except Exception as e:
+            print(json.dumps({"metric": "crush_map_10kosd_1Mpg",
+                              "error": repr(e)}), file=sys.stderr)
         for r in results:
             print(json.dumps(r))
 
-    # headline metric (always last / only line): north-star encode config
-    gbps = bench_ec({"plugin": "isa", "k": "8", "m": "4"},
-                    batch=4096, chunk=512, workload="encode",
-                    iters=args.iterations)
+    # headline metric (always the LAST line): north-star encode config
+    med, lo, hi = bench_ec({"plugin": "isa", "k": "8", "m": "4"},
+                           batch=4096, chunk=512, workload="encode",
+                           iters=args.iterations, repeats=args.repeats)
     print(json.dumps({
         "metric": "ec_encode_isa_k8m4_4KiB_stripe_batch4096",
-        "value": round(gbps, 3),
+        "value": round(med, 3),
         "unit": "GB/s",
-        "vs_baseline": round(gbps / BASELINE_GBPS, 3),
+        "vs_baseline": round(med / BASELINE_GBPS, 3),
+        "min": round(lo, 3), "max": round(hi, 3),
     }))
 
 

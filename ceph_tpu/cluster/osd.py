@@ -70,6 +70,7 @@ class OSDDaemon(Dispatcher):
         self.pgs: Dict[PGid, PGState] = {}
         self.perf = PerfCounters(f"osd.{osd_id}")
         self._codecs: Dict[int, object] = {}
+        self._obj_locks: Dict[Tuple[PGid, str], list] = {}  # [Lock, refcount]
         self._pending: Dict[Tuple, Tuple[asyncio.Future, List]] = {}
         self._tid = 0
         self._tasks: List[asyncio.Task] = []
@@ -444,6 +445,33 @@ class OSDDaemon(Dispatcher):
 
     async def _ec_write(self, pool: PGPool, st: PGState, oid: str,
                         data: bytes, offset: Optional[int]) -> int:
+        """Per-object write serialization: the EC RMW sequence (read old
+        stripes, merge, re-encode, fan out shard writes) suspends at several
+        awaits; two concurrent partial writes interleaving there would
+        commit a mix of shard versions from both writers — parity
+        inconsistent with data.  The reference serializes overlapping RMWs
+        in the ECBackend pipeline (ECBackend::start_rmw wait queue).
+
+        Locks are refcounted and pruned at zero so the dict doesn't grow
+        with every distinct object ever written; the count is incremented
+        synchronously (no await between lookup and increment), so a pruned
+        entry can never race with a contender holding the old lock.
+        """
+        key = (st.pgid, oid)
+        entry = self._obj_locks.get(key)
+        if entry is None:
+            entry = self._obj_locks[key] = [asyncio.Lock(), 0]
+        entry[1] += 1
+        try:
+            async with entry[0]:
+                return await self._ec_write_locked(pool, st, oid, data, offset)
+        finally:
+            entry[1] -= 1
+            if entry[1] == 0:
+                self._obj_locks.pop(key, None)
+
+    async def _ec_write_locked(self, pool: PGPool, st: PGState, oid: str,
+                               data: bytes, offset: Optional[int]) -> int:
         from ceph_tpu.ec import stripe as stripemod
 
         codec = self._codec(pool)
@@ -657,7 +685,12 @@ class OSDDaemon(Dispatcher):
                 try:
                     await self._recover_pg(st)
                 except Exception:
+                    # count AND surface: a silently-failing recovery loop
+                    # means a pool that never re-protects itself
                     self.perf.inc("osd_recovery_errors")
+                    import logging
+                    logging.getLogger("ceph_tpu.osd").exception(
+                        "osd.%d: recovery of pg %s failed", self.osd_id, pgid)
 
     async def _recover_pg(self, st: PGState) -> None:
         """Primary-driven resync: query members, reconstruct, push."""

@@ -164,13 +164,20 @@ class MatrixCodec(ErasureCode):
     def encode_batch(self, data) -> np.ndarray:
         return self.engine.encode_parity_batch(data)
 
-    def decode_batch(self, erasures: Tuple[int, ...], chunks) -> np.ndarray:
-        """chunks: (B, k+m, S) with erased positions ignored; returns
-        (B, len(erasures), S) reconstructions, device-resident."""
+    def decode_batch(self, erasures: Tuple[int, ...], chunks,
+                     want: Tuple[int, ...] = None) -> np.ndarray:
+        """chunks: (B, k+m, S) with erased positions ignored (zeros ok).
+
+        ``erasures`` lists EVERY unavailable chunk id (they are excluded
+        from the source set); ``want`` selects which of them to rebuild
+        (default: all).  Returns (B, len(want), S), device-resident.
+        """
+        if want is None:
+            want = tuple(erasures)
         avail = tuple(i for i in range(self.k + self.m) if i not in erasures)
         src = avail[: self.k]
         data = jnp.asarray(chunks)[:, list(src), :]
-        return self.engine.reconstruct_batch(src, tuple(erasures), data)
+        return self.engine.reconstruct_batch(src, tuple(want), data)
 
 
 class BitmatrixCodec(MatrixCodec):

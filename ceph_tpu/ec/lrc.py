@@ -377,6 +377,79 @@ class ErasureCodeLrc(ErasureCode):
             raise ECError(errno.EIO,
                           f"unable to read {sorted(want_to_read_erasures)}")
 
+    # -- batched device paths -----------------------------------------------
+    #
+    # The cluster stripe layer (ceph_tpu.ec.stripe) talks in LOGICAL chunk
+    # ids: data chunks 0..k-1 then coding chunks k..n-1, the same order
+    # chunk_index() maps to positions.  Layers think in POSITIONS (indices
+    # into the mapping string), so the batch paths convert at the boundary.
+
+    def _positions(self):
+        data_pos = self.chunk_mapping[: self.data_chunk_count]
+        coding_pos = self.chunk_mapping[self.data_chunk_count:]
+        return data_pos, coding_pos
+
+    def encode_batch(self, data):
+        """(B, k, S) logical data -> (B, m, S) coding chunks, device-resident.
+
+        Applies every layer in order like encode_chunks: each layer gathers
+        its data-position subset and computes its parities with the layer
+        codec's batched MXU path (reference encode_chunks routing,
+        ErasureCodeLrc.cc:744 — but over the whole stripe batch at once).
+        """
+        import jax.numpy as jnp
+
+        data = jnp.asarray(data)
+        b, k, s = data.shape
+        n = self.chunk_count
+        data_pos, coding_pos = self._positions()
+        full = jnp.zeros((b, n, s), dtype=jnp.uint8)
+        full = full.at[:, jnp.asarray(data_pos), :].set(data)
+        for layer in self.layers:
+            sub = full[:, jnp.asarray(layer.data), :]
+            parity = layer.erasure_code.encode_batch(sub)
+            full = full.at[:, jnp.asarray(layer.coding), :].set(parity)
+        return full[:, jnp.asarray(coding_pos), :]
+
+    def decode_batch(self, erasures, chunks, want=None):
+        """Batched single-pattern reconstruction, walking layers bottom-up
+        exactly like decode_chunks.  ``chunks``: (B, n, S) in logical order
+        with zeros at erased ids; ``erasures`` = every unavailable logical
+        id; ``want`` = subset to return (default all).  Returns
+        (B, len(want), S)."""
+        import jax.numpy as jnp
+
+        if want is None:
+            want = tuple(erasures)
+        chunks = jnp.asarray(chunks)
+        b, n, s = chunks.shape
+        logical_to_pos = list(self.chunk_mapping)
+        # repack into positional order
+        full = jnp.zeros((b, n, s), dtype=jnp.uint8)
+        full = full.at[:, jnp.asarray(logical_to_pos), :].set(chunks)
+        erased_pos = {logical_to_pos[e] for e in erasures}
+        want_pos = {logical_to_pos[e] for e in want}
+        for layer in reversed(self.layers):
+            layer_erased = [c for c in layer.chunks if c in erased_pos]
+            if not layer_erased:
+                continue
+            if len(layer_erased) > layer.erasure_code.get_coding_chunk_count():
+                continue
+            local_ids = {c: j for j, c in enumerate(layer.chunks)}
+            local_erasures = tuple(local_ids[c] for c in layer_erased)
+            sub = full[:, jnp.asarray(layer.chunks), :]
+            out = layer.erasure_code.decode_batch(local_erasures, sub)
+            full = full.at[:, jnp.asarray(layer_erased), :].set(out)
+            erased_pos -= set(layer_erased)
+            if not erased_pos & want_pos:
+                break
+        if erased_pos & want_pos:
+            raise ECError(
+                errno.EIO,
+                f"unable to reconstruct positions {sorted(erased_pos & want_pos)}")
+        out_pos = [logical_to_pos[e] for e in want]
+        return full[:, jnp.asarray(out_pos), :]
+
     # -- CRUSH rule generation ----------------------------------------------
 
     def create_rule(self, name: str, cmap) -> int:

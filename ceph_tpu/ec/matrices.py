@@ -66,11 +66,13 @@ def reed_sol_vandermonde_coding_matrix(k: int, m: int) -> np.ndarray:
     """(m, k) coding matrix: systematized extended Vandermonde, bottom m rows.
 
     After systematization, jerasure's reed_sol_big_vandermonde_distribution_
-    matrix ends by scaling each parity *column* by the inverse of its first-
-    parity-row entry so row 0 of the coding block is all ones (making the
-    first parity a plain XOR).  Column scaling by nonzero constants preserves
-    the MDS property; omitting it produced parity bytes incompatible with
-    jerasure for k >= 4.
+    matrix performs two normalizations (reed_sol.c): first scale each parity
+    *column* by the inverse of its first-parity-row entry so row 0 of the
+    coding block is all ones (making the first parity a plain XOR), then
+    scale each parity *row* i >= 1 by the inverse of its column-0 entry so
+    column 0 of the coding block is all ones too.  Both operations multiply
+    a row/column by a nonzero constant, preserving the MDS property; both
+    are required for parity bytes compatible with jerasure.
     """
     v = reed_sol_extended_vandermonde(k + m, k)
     v = _systematize_vandermonde(v)
@@ -81,6 +83,11 @@ def reed_sol_vandermonde_coding_matrix(k: int, m: int) -> np.ndarray:
         if e not in (0, 1):
             coding[:, j] = gf8.gf_mul(coding[:, j], gf8.gf_inv(e))
     assert np.all(coding[0] == 1), "first parity row must be all ones"
+    for i in range(1, m):
+        e = int(coding[i, 0])
+        if e not in (0, 1):
+            coding[i] = gf8.gf_mul(coding[i], gf8.gf_inv(e))
+    assert np.all(coding[:, 0] == 1), "first parity column must be all ones"
     return coding
 
 

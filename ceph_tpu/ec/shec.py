@@ -342,26 +342,66 @@ class ErasureCodeShec(MatrixCodec):
         bitmat = jnp.asarray(gf8.expand_bitmatrix(rmat))
         return np.asarray(_encode_cols(bitmat, jnp.asarray(data)))
 
-    def decode_batch(self, erasures: Tuple[int, ...], chunks) -> np.ndarray:
+    def decode_batch(self, erasures: Tuple[int, ...], chunks,
+                     want: Tuple[int, ...] = None) -> np.ndarray:
         """Batched single-pattern reconstruction on device: build the plan
-        once, apply the recovery matrix to the whole stripe batch."""
+        once, apply ONE recovery matrix to the whole stripe batch.
+        ``erasures`` = every unavailable chunk id; ``want`` = the subset to
+        rebuild (default all of them).
+
+        Erased parity rows are handled by composing the coding row with the
+        data-recovery expressions (same composition the reference performs
+        chunk-at-a-time in shec_matrix_decode, ErasureCodeShec.cc:526-756):
+        every data chunk j is either an available source (identity row) or a
+        solved combination of the plan's sources (its inverse row), so
+        parity i = coding[i] @ [data exprs] is itself one row over sources.
+        """
         import jax.numpy as jnp
 
         from ceph_tpu.ec.codec import _encode_batch_jit
 
+        if want is None:
+            want = tuple(erasures)
         n = self.k + self.m
         avails = [0 if i in erasures else 1 for i in range(n)]
-        want = [1 if i in erasures else 0 for i in range(n)]
-        srcs, cols, inv, _ = self._make_decoding_plan(want, avails)
-        rows = []
+        want_vec = [1 if i in want else 0 for i in range(n)]
+        srcs, cols, inv, _ = self._make_decoding_plan(want_vec, avails)
         src_list = list(srcs)
-        for e in erasures:
-            if e < self.k:
-                ci = cols.index(e)
-                rows.append(inv[ci])
+        pos = {s: i for i, s in enumerate(src_list)}
+        # available data chunks in an erased parity's support feed the
+        # composition directly; extend the source list with them
+        for e in want:
+            if e >= self.k:
+                for j in range(self.k):
+                    if self.engine.coding[e - self.k, j] and avails[j] \
+                            and j not in pos:
+                        pos[j] = len(src_list)
+                        src_list.append(j)
+        S = len(src_list)
+
+        def data_expr(j: int) -> np.ndarray:
+            """Row expressing data chunk j over src_list."""
+            row = np.zeros(S, dtype=np.uint8)
+            if avails[j]:
+                row[pos[j]] = 1
             else:
-                # parity: compose coding row with data recovery
-                raise NotImplementedError("batched parity recovery")
+                ci = cols.index(j)
+                for r_i, s in enumerate(srcs):
+                    row[pos[s]] = inv[ci][r_i]
+            return row
+
+        rows = []
+        for e in want:
+            if e < self.k:
+                rows.append(data_expr(e))
+            else:
+                crow = self.engine.coding[e - self.k]
+                acc = np.zeros(S, dtype=np.uint8)
+                for j in range(self.k):
+                    cj = int(crow[j])
+                    if cj:
+                        acc ^= gf8.gf_mul(cj, data_expr(j))
+                rows.append(acc)
         rmat = np.stack(rows).astype(np.uint8)
         bitmat = jnp.asarray(gf8.expand_bitmatrix(rmat))
         data = jnp.asarray(chunks)[:, src_list, :]

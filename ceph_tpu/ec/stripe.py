@@ -141,16 +141,21 @@ def decode_stripes(
         for s in have:
             full[:, s, :] = np.asarray(
                 shards[s], dtype=np.uint8).reshape(nstripes, unit)
+        # erasures = every absent shard (absent parity must never be used
+        # as a decode source); want = only the missing DATA shards, since
+        # this function returns logical bytes — absent parity (possibly
+        # simply not requested) is not reconstructed, and non-MDS codecs
+        # (shec) don't search for a needlessly hard recovery plan.
         erasures = tuple(s for s in range(n) if s not in shards)
+        want = tuple(s for s in range(k) if s not in shards)
         bb = _bucket(nstripes)
         if bb != nstripes:
             full = np.concatenate(
                 [full, np.zeros((bb - nstripes, n, unit), dtype=np.uint8)])
         recovered = np.asarray(
-            codec.decode_batch(erasures, full))[:nstripes]
-        for idx, e in enumerate(erasures):
-            if e < k:
-                data_rows[e] = recovered[:, idx, :].reshape(shard_len)
+            codec.decode_batch(erasures, full, want=want))[:nstripes]
+        for idx, e in enumerate(want):
+            data_rows[e] = recovered[:, idx, :].reshape(shard_len)
     stacked = np.stack([data_rows[s].reshape(nstripes, unit)
                         for s in range(k)], axis=1)
     return stacked.reshape(nstripes * sinfo.stripe_width)[

@@ -85,11 +85,20 @@ static void reed_sol_van_matrix(int k, int m, int *coding /* m*k */) {
                     v[x * cols + j] ^= gf_mul(f, v[x * cols + i]);
         }
     }
-    /* normalization: first parity row becomes all ones (parity rows only) */
+    /* normalization 1: first parity row becomes all ones (column scaling,
+     * parity rows only) */
     for (j = 0; j < cols; j++) {
         int e = v[k * cols + j];
         if (e != 0 && e != 1)
             for (x = k; x < rows; x++)
+                v[x * cols + j] = gf_div(v[x * cols + j], e);
+    }
+    /* normalization 2: first parity column becomes all ones (row scaling of
+     * parity rows 1..m-1, jerasure reed_sol.c second normalization step) */
+    for (x = k + 1; x < rows; x++) {
+        int e = v[x * cols + 0];
+        if (e != 0 && e != 1)
+            for (j = 0; j < cols; j++)
                 v[x * cols + j] = gf_div(v[x * cols + j], e);
     }
     for (i = 0; i < m; i++)

@@ -196,3 +196,50 @@ def test_crush_steps_json_profile():
 def test_registry_exposes_lrc():
     codec = factory({"plugin": "lrc", "k": "4", "m": "2", "l": "3"})
     assert isinstance(codec, ErasureCodeLrc)
+
+
+def test_batch_encode_matches_single():
+    import numpy as np
+
+    codec = make_lrc({"plugin": "lrc", "k": "4", "m": "2", "l": "3"})
+    n = codec.get_chunk_count()
+    k = codec.get_data_chunk_count()
+    rng = np.random.default_rng(21)
+    batch = rng.integers(0, 256, (4, k, 64), dtype=np.uint8)
+    parity = np.asarray(codec.encode_batch(batch))
+    assert parity.shape == (4, n - k, 64)
+    # compare each stripe against the single-stripe encode_chunks path
+    for b in range(4):
+        chunks = {
+            codec.chunk_index(i): batch[b, i].copy() for i in range(k)
+        }
+        for i in range(k, n):
+            chunks[codec.chunk_index(i)] = np.zeros(64, dtype=np.uint8)
+        codec.encode_chunks(chunks)
+        for i in range(n - k):
+            pos = codec.chunk_index(k + i)
+            assert np.array_equal(parity[b, i], chunks[pos]), (b, i)
+
+
+def test_batch_decode_roundtrip():
+    import numpy as np
+
+    codec = make_lrc({"plugin": "lrc", "k": "4", "m": "2", "l": "3"})
+    n = codec.get_chunk_count()
+    k = codec.get_data_chunk_count()
+    rng = np.random.default_rng(22)
+    batch = rng.integers(0, 256, (4, k, 64), dtype=np.uint8)
+    parity = np.asarray(codec.encode_batch(batch))
+    full = np.concatenate([batch, parity], axis=1)
+    # single local erasure: recovered from its local group
+    zeroed = full.copy()
+    zeroed[:, 1, :] = 0
+    out = np.asarray(codec.decode_batch((1,), zeroed))
+    assert np.array_equal(out[:, 0, :], batch[:, 1, :])
+    # two erasures incl. a coding chunk
+    zeroed = full.copy()
+    zeroed[:, 0, :] = 0
+    zeroed[:, k, :] = 0
+    out = np.asarray(codec.decode_batch((0, k), zeroed))
+    assert np.array_equal(out[:, 0, :], batch[:, 0, :])
+    assert np.array_equal(out[:, 1, :], parity[:, 0, :])
