@@ -428,101 +428,121 @@ class TensorMapper:
 
     # ------------------------------------------------------------- rule VM
 
+    # Device-resident map tensors the rule functions need.  They are
+    # threaded through jit as ARGUMENTS (run(..., tensors)) with the traced
+    # values temporarily bound onto self during tracing — a jit closure
+    # over a device-resident array permanently degrades every subsequent
+    # dispatch in the process on the axon platform (~150x slowdown).
+    _TENSOR_ATTRS = ("items", "iweights", "sizes", "btypes", "recip_hi",
+                     "recip_lo", "_rh", "_lh", "_ll", "_lnn")
+
+    def _tensor_args(self):
+        return {a: getattr(self, a) for a in self._TENSOR_ATTRS}
+
     def _build_rule_fn(self, ruleno: int, result_max: int):
         m = self.map
         t = m.tunables
         rule = m.rules[ruleno]
 
-        def run(xs, weights):
-            self._w = weights
-            L = xs.shape[0]
-            choose_tries = t.choose_total_tries + 1
-            choose_leaf_tries = 0
-            vary_r = t.chooseleaf_vary_r
-            stable = t.chooseleaf_stable
-            w_items = jnp.full((L, result_max), CRUSH_ITEM_NONE, dtype=I32)
-            wsize = jnp.zeros(L, dtype=I32)
-            result = jnp.full((L, result_max), CRUSH_ITEM_NONE, dtype=I32)
-            rlen = jnp.zeros(L, dtype=I32)
-            for op, arg1, arg2 in rule.steps:
-                if op == RULE_TAKE:
-                    w_items = w_items.at[:, 0].set(arg1)
-                    wsize = jnp.full(L, 1, dtype=I32)
-                elif op == RULE_SET_CHOOSE_TRIES:
-                    if arg1 > 0:
-                        choose_tries = arg1
-                elif op == RULE_SET_CHOOSELEAF_TRIES:
-                    if arg1 > 0:
-                        choose_leaf_tries = arg1
-                elif op == RULE_SET_CHOOSELEAF_VARY_R:
-                    if arg1 >= 0:
-                        vary_r = arg1
-                elif op == RULE_SET_CHOOSELEAF_STABLE:
-                    if arg1 >= 0:
-                        stable = arg1
-                elif op in (RULE_SET_CHOOSE_LOCAL_TRIES,
-                            RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES):
-                    if arg1 > 0:
-                        raise NotImplementedError("local retries not vectorized")
-                elif op in (RULE_CHOOSE_FIRSTN, RULE_CHOOSELEAF_FIRSTN,
-                            RULE_CHOOSE_INDEP, RULE_CHOOSELEAF_INDEP):
-                    firstn = op in (RULE_CHOOSE_FIRSTN, RULE_CHOOSELEAF_FIRSTN)
-                    recurse = op in (RULE_CHOOSELEAF_FIRSTN, RULE_CHOOSELEAF_INDEP)
-                    numrep = arg1
-                    if numrep <= 0:
-                        numrep += result_max
-                        if numrep <= 0:
-                            continue
-                    o_items = jnp.full((L, result_max), CRUSH_ITEM_NONE, dtype=I32)
-                    osize = jnp.zeros(L, dtype=I32)
-                    # Each W entry gets an independent output segment
-                    # (reference passes o+osize per input bucket).
-                    for i in range(result_max):
-                        mask = (i < wsize) & (w_items[:, i] < 0)
-                        take = w_items[:, i]
-                        if firstn:
-                            if choose_leaf_tries:
-                                recurse_tries = choose_leaf_tries
-                            elif t.chooseleaf_descend_once:
-                                recurse_tries = 1
-                            else:
-                                recurse_tries = choose_tries
-                            vals, cnt = self._choose_firstn_vec(
-                                take, xs, numrep, arg2, choose_tries,
-                                recurse_tries, recurse, vary_r, stable, mask)
-                            ncols = numrep
-                            cnt = jnp.where(mask, cnt, 0)
-                        else:
-                            # out_size depends on osize only when segments
-                            # overflow result_max; clamp below on append
-                            vals = self._choose_indep_vec(
-                                take, xs, numrep, numrep, arg2, choose_tries,
-                                choose_leaf_tries if choose_leaf_tries else 1,
-                                recurse, mask)
-                            ncols = numrep
-                            cnt = jnp.where(mask, numrep, 0)
-                        for j in range(ncols):
-                            valid = (j < cnt) & (osize < result_max)
-                            slot = jnp.arange(result_max)[None, :] == osize[:, None]
-                            o_items = jnp.where(
-                                slot & valid[:, None], vals[:, j][:, None], o_items)
-                            osize = osize + valid.astype(I32)
-                    w_items = o_items
-                    wsize = osize
-                elif op == RULE_EMIT:
-                    for j in range(result_max):
-                        valid = (j < wsize) & (rlen < result_max)
-                        slot = jnp.arange(result_max)[None, :] == rlen[:, None]
-                        result = jnp.where(
-                            slot & valid[:, None], w_items[:, j][:, None], result)
-                        rlen = rlen + valid.astype(I32)
-                    wsize = jnp.zeros(L, dtype=I32)
-                else:
-                    raise NotImplementedError(f"rule op {op}")
-            return result, rlen
+        def run(xs, weights, tensors):
+            saved = {a: getattr(self, a) for a in self._TENSOR_ATTRS}
+            for a, v in tensors.items():
+                setattr(self, a, v)
+            try:
+                return self._run_rule(xs, weights, rule, t, result_max)
+            finally:
+                for a, v in saved.items():
+                    setattr(self, a, v)
 
         return jax.jit(run)
 
+    def _run_rule(self, xs, weights, rule, t, result_max: int):
+        self._w = weights
+        L = xs.shape[0]
+        choose_tries = t.choose_total_tries + 1
+        choose_leaf_tries = 0
+        vary_r = t.chooseleaf_vary_r
+        stable = t.chooseleaf_stable
+        w_items = jnp.full((L, result_max), CRUSH_ITEM_NONE, dtype=I32)
+        wsize = jnp.zeros(L, dtype=I32)
+        result = jnp.full((L, result_max), CRUSH_ITEM_NONE, dtype=I32)
+        rlen = jnp.zeros(L, dtype=I32)
+        for op, arg1, arg2 in rule.steps:
+            if op == RULE_TAKE:
+                w_items = w_items.at[:, 0].set(arg1)
+                wsize = jnp.full(L, 1, dtype=I32)
+            elif op == RULE_SET_CHOOSE_TRIES:
+                if arg1 > 0:
+                    choose_tries = arg1
+            elif op == RULE_SET_CHOOSELEAF_TRIES:
+                if arg1 > 0:
+                    choose_leaf_tries = arg1
+            elif op == RULE_SET_CHOOSELEAF_VARY_R:
+                if arg1 >= 0:
+                    vary_r = arg1
+            elif op == RULE_SET_CHOOSELEAF_STABLE:
+                if arg1 >= 0:
+                    stable = arg1
+            elif op in (RULE_SET_CHOOSE_LOCAL_TRIES,
+                        RULE_SET_CHOOSE_LOCAL_FALLBACK_TRIES):
+                if arg1 > 0:
+                    raise NotImplementedError("local retries not vectorized")
+            elif op in (RULE_CHOOSE_FIRSTN, RULE_CHOOSELEAF_FIRSTN,
+                        RULE_CHOOSE_INDEP, RULE_CHOOSELEAF_INDEP):
+                firstn = op in (RULE_CHOOSE_FIRSTN, RULE_CHOOSELEAF_FIRSTN)
+                recurse = op in (RULE_CHOOSELEAF_FIRSTN, RULE_CHOOSELEAF_INDEP)
+                numrep = arg1
+                if numrep <= 0:
+                    numrep += result_max
+                    if numrep <= 0:
+                        continue
+                o_items = jnp.full((L, result_max), CRUSH_ITEM_NONE, dtype=I32)
+                osize = jnp.zeros(L, dtype=I32)
+                # Each W entry gets an independent output segment
+                # (reference passes o+osize per input bucket).
+                for i in range(result_max):
+                    mask = (i < wsize) & (w_items[:, i] < 0)
+                    take = w_items[:, i]
+                    if firstn:
+                        if choose_leaf_tries:
+                            recurse_tries = choose_leaf_tries
+                        elif t.chooseleaf_descend_once:
+                            recurse_tries = 1
+                        else:
+                            recurse_tries = choose_tries
+                        vals, cnt = self._choose_firstn_vec(
+                            take, xs, numrep, arg2, choose_tries,
+                            recurse_tries, recurse, vary_r, stable, mask)
+                        ncols = numrep
+                        cnt = jnp.where(mask, cnt, 0)
+                    else:
+                        # out_size depends on osize only when segments
+                        # overflow result_max; clamp below on append
+                        vals = self._choose_indep_vec(
+                            take, xs, numrep, numrep, arg2, choose_tries,
+                            choose_leaf_tries if choose_leaf_tries else 1,
+                            recurse, mask)
+                        ncols = numrep
+                        cnt = jnp.where(mask, numrep, 0)
+                    for j in range(ncols):
+                        valid = (j < cnt) & (osize < result_max)
+                        slot = jnp.arange(result_max)[None, :] == osize[:, None]
+                        o_items = jnp.where(
+                            slot & valid[:, None], vals[:, j][:, None], o_items)
+                        osize = osize + valid.astype(I32)
+                w_items = o_items
+                wsize = osize
+            elif op == RULE_EMIT:
+                for j in range(result_max):
+                    valid = (j < wsize) & (rlen < result_max)
+                    slot = jnp.arange(result_max)[None, :] == rlen[:, None]
+                    result = jnp.where(
+                        slot & valid[:, None], w_items[:, j][:, None], result)
+                    rlen = rlen + valid.astype(I32)
+                wsize = jnp.zeros(L, dtype=I32)
+            else:
+                raise NotImplementedError(f"rule op {op}")
+        return result, rlen
     def do_rule_batch(self, ruleno: int, xs, result_max: int, weights):
         """Map a batch of x values; returns (N, result_max) int32 with
         CRUSH_ITEM_NONE padding, plus lengths, matching crush_do_rule."""
@@ -530,6 +550,7 @@ class TensorMapper:
         if key not in self._compiled:
             self._compiled[key] = self._build_rule_fn(ruleno, result_max)
         fn = self._compiled[key]
+        tensors = self._tensor_args()
         xs = jnp.asarray(xs, dtype=U32)
         weights = jnp.asarray(weights, dtype=U32)
         n = xs.shape[0]
@@ -541,7 +562,7 @@ class TensorMapper:
             if part.shape[0] < self.chunk and n > self.chunk:
                 pad = self.chunk - part.shape[0]
                 part = jnp.pad(part, (0, pad))
-            res, rl = fn(part, weights)
+            res, rl = fn(part, weights, tensors)
             if pad:
                 res = res[:-pad]
                 rl = rl[:-pad]

@@ -95,6 +95,13 @@ class ErasureCode(ErasureCodeInterface):
     def get_chunk_mapping(self) -> List[int]:
         return self.chunk_mapping
 
+    def stripe_unit(self, default: int) -> int:
+        """Smallest cluster stripe unit >= ``default`` this codec's batch
+        layout accepts (packet-interleaved codecs need multiples of
+        w*packetsize; wide fields need word multiples).  Used at pool
+        create so profile defaults always compose."""
+        return default
+
     # -- minimum_to_decode (greedy base semantics) --------------------------
 
     def minimum_to_decode(

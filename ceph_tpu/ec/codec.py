@@ -31,7 +31,7 @@ from ceph_tpu.ec import matrices
 from ceph_tpu.ec.base import ErasureCode
 from ceph_tpu.ec.interface import ECError
 from ceph_tpu.ec.table_cache import DecodeTableCache
-from ceph_tpu.ops import gf8
+from ceph_tpu.ops import gf8, gfw
 
 
 @functools.lru_cache(maxsize=64)
@@ -57,24 +57,101 @@ def _encode_batch_jit(bitmat, data):
     return out.reshape(r, b, s).transpose(1, 0, 2)
 
 
-class _DeviceMatrixEngine:
-    """Shared encode/decode engine over a (k+m, k) generator matrix."""
+@functools.partial(jax.jit, static_argnums=(2,))
+def _gather_encode_batch_jit(bitmat, chunks, src):
+    """chunks (B, n, S) -> (B, r, S) using only the src rows.
 
-    def __init__(self, k: int, m: int, coding: np.ndarray):
+    The row gather is INSIDE the jit so a decode is one device dispatch —
+    an eager gather followed by the matmul costs a second round trip
+    through the runtime per call, which dominates at small batch shapes."""
+    data = chunks[:, list(src), :]
+    b, k, s = data.shape
+    cols = data.transpose(1, 0, 2).reshape(k, b * s)
+    out = gf8.bitmatrix_matmul(bitmat, cols)
+    r = out.shape[0]
+    return out.reshape(r, b, s).transpose(1, 0, 2)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _gather_encode_batch_w_jit(bitmat, chunks, src, word_bytes: int):
+    """Word-generalized variant of _gather_encode_batch_jit."""
+    data = chunks[:, list(src), :]
+    b, k, s = data.shape
+    cols = data.transpose(1, 0, 2).reshape(k, b * s)
+    out = gfw.bitmatrix_matmul_w(bitmat, cols, word_bytes)
+    r = out.shape[0]
+    return out.reshape(r, b, s).transpose(1, 0, 2)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _pkt_batch_apply(lane_mat, data, w: int, p: int, src=None):
+    """Packet-interleaved batch apply for bit-matrix codes.
+
+    data (B, c, S) where every chunk is super-blocks of w*p bytes (packet
+    row t of super-block s holds bit-plane t); lane_mat is the
+    byte-lane-expanded (8rw, 8cw) selection matrix.  One MXU matmul for
+    the WHOLE batch (jerasure_schedule_encode semantics over all stripes
+    at once, reference ErasureCodeJerasure.cc:260).  ``src`` (static)
+    optionally selects source rows inside the jit."""
+    if src is not None:
+        data = data[:, list(src), :]
+    b, c, s = data.shape
+    ns = s // (w * p)
+    rows = (
+        data.reshape(b, c, ns, w, p)
+        .transpose(1, 3, 0, 2, 4)
+        .reshape(c * w, b * ns * p)
+    )
+    out = gf8.bitmatrix_matmul(lane_mat, rows)          # (r*w, b*ns*p)
+    r = out.shape[0] // w
+    return (
+        out.reshape(r, w, b, ns, p)
+        .transpose(2, 0, 3, 1, 4)
+        .reshape(b, r, s)
+    )
+
+
+class _DeviceMatrixEngine:
+    """Shared encode/decode engine over a (k+m, k) generator matrix.
+
+    w=8 uses the table-driven gf8 host helpers; w in {16, 32} uses the
+    scalar gfw field (matrices are k x m WORDS — still tiny) and the
+    word-generalized device matmul.  Either way the data path is ONE MXU
+    GF(2) matmul."""
+
+    def __init__(self, k: int, m: int, coding: np.ndarray, w: int = 8):
         self.k = k
         self.m = m
-        self.coding = coding.astype(np.uint8)
+        self.w = w
+        self.word_bytes = w // 8
+        if w == 8:
+            self.coding = coding.astype(np.uint8)
+            self._enc_bitmat = jnp.asarray(gf8.expand_bitmatrix(self.coding))
+        else:
+            self.coding = coding.astype(np.uint64)
+            self._enc_bitmat = jnp.asarray(
+                gfw.expand_bitmatrix_w(self.coding, w))
         self.generator = matrices.generator_matrix(self.coding)
-        self._enc_bitmat = jnp.asarray(gf8.expand_bitmatrix(self.coding))
         self._decode_cache = DecodeTableCache()
+
+    def _apply(self, bitmat, data: np.ndarray) -> np.ndarray:
+        if self.w == 8:
+            return np.asarray(_encode_cols(bitmat, jnp.asarray(data)))
+        return np.asarray(
+            gfw.bitmatrix_matmul_w(bitmat, jnp.asarray(data), self.word_bytes))
+
+    def _apply_batch(self, bitmat, data):
+        if self.w == 8:
+            return _encode_batch_jit(bitmat, jnp.asarray(data))
+        return gfw.encode_batch_w(bitmat, jnp.asarray(data), self.word_bytes)
 
     def encode_parity(self, data: np.ndarray) -> np.ndarray:
         """(k, S) -> (m, S) on device."""
-        return np.asarray(_encode_cols(self._enc_bitmat, jnp.asarray(data)))
+        return self._apply(self._enc_bitmat, data)
 
     def encode_parity_batch(self, data) -> jnp.ndarray:
         """(B, k, S) -> (B, m, S), stays on device."""
-        return _encode_batch_jit(self._enc_bitmat, jnp.asarray(data))
+        return self._apply_batch(self._enc_bitmat, data)
 
     def decode_matrix(
         self, src_rows: Tuple[int, ...], out_rows: Tuple[int, ...]
@@ -87,21 +164,42 @@ class _DeviceMatrixEngine:
         row with the inverse.
         """
         sub = self.generator[list(src_rows)]
-        inv = gf8.gf_invert_matrix(sub)
+        if self.w == 8:
+            inv = gf8.gf_invert_matrix(sub)
+            rows = []
+            for e in out_rows:
+                if e < self.k:
+                    rows.append(inv[e])
+                else:
+                    rows.append(gf8.gf_matmul_ref(
+                        self.coding[e - self.k][None, :], inv)[0])
+            return np.stack(rows).astype(np.uint8)
+        gf = gfw.field(self.w)
+        inv = gfw.gfw_invert_matrix(sub, self.w)
         rows = []
         for e in out_rows:
             if e < self.k:
                 rows.append(inv[e])
             else:
-                rows.append(gf8.gf_matmul_ref(self.coding[e - self.k][None, :], inv)[0])
-        return np.stack(rows).astype(np.uint8)
+                crow = [int(x) for x in self.coding[e - self.k]]
+                row = []
+                for c in range(self.k):
+                    acc = 0
+                    for t in range(self.k):
+                        acc ^= gf.mul(crow[t], int(inv[t][c]))
+                    row.append(acc)
+                rows.append(np.array(row, dtype=np.uint64))
+        return np.stack(rows)
 
     def decode_bitmat(self, src_rows: Tuple[int, ...], out_rows: Tuple[int, ...]):
         key = (src_rows, out_rows)
         bitmat = self._decode_cache.get(key)
         if bitmat is None:
             rmat = self.decode_matrix(src_rows, out_rows)
-            bitmat = jnp.asarray(gf8.expand_bitmatrix(rmat))
+            if self.w == 8:
+                bitmat = jnp.asarray(gf8.expand_bitmatrix(rmat))
+            else:
+                bitmat = jnp.asarray(gfw.expand_bitmatrix_w(rmat, self.w))
             self._decode_cache.put(key, bitmat)
         return bitmat
 
@@ -110,18 +208,68 @@ class _DeviceMatrixEngine:
     ) -> np.ndarray:
         """data (k, S) from src_rows -> (len(out_rows), S)."""
         bitmat = self.decode_bitmat(src_rows, out_rows)
-        return np.asarray(_encode_cols(bitmat, jnp.asarray(data)))
+        return self._apply(bitmat, data)
 
     def reconstruct_batch(
         self, src_rows: Tuple[int, ...], out_rows: Tuple[int, ...], data
     ):
         """(B, k, S) from src_rows -> (B, len(out_rows), S), on device."""
         bitmat = self.decode_bitmat(src_rows, out_rows)
-        return _encode_batch_jit(bitmat, jnp.asarray(data))
+        return self._apply_batch(bitmat, data)
+
+    def reconstruct_batch_from(
+        self, src_rows: Tuple[int, ...], out_rows: Tuple[int, ...], chunks
+    ):
+        """Like reconstruct_batch but takes the FULL (B, n, S) chunk array
+        and gathers src rows inside one jitted dispatch."""
+        bitmat = self.decode_bitmat(src_rows, out_rows)
+        chunks = jnp.asarray(chunks)
+        if self.w == 8:
+            return _gather_encode_batch_jit(bitmat, chunks, tuple(src_rows))
+        return _gather_encode_batch_w_jit(
+            bitmat, chunks, tuple(src_rows), self.word_bytes)
+
+
+class _DeviceBitEngine:
+    """Engine for NATIVE GF(2) bit-matrix codes (liberation family): the
+    code is defined directly by an (m*w, k*w) 0/1 matrix with no byte
+    matrix behind it.  Decode inverts the k*w x k*w survivor bit-matrix
+    over GF(2) — the same solve jerasure performs on its bit-matrices."""
+
+    def __init__(self, k: int, m: int, w: int, coding_bits: np.ndarray):
+        self.k = k
+        self.m = m
+        self.w = w
+        self.coding_bits = np.asarray(coding_bits, dtype=np.uint8)
+        self.generator_bits = np.vstack(
+            [np.eye(k * w, dtype=np.uint8), self.coding_bits])
+        self._decode_cache = DecodeTableCache()
+
+    def decode_bits(self, src: Tuple[int, ...],
+                    out: Tuple[int, ...]) -> np.ndarray:
+        key = (src, out)
+        cached = self._decode_cache.get(key)
+        if cached is not None:
+            return cached
+        k, w = self.k, self.w
+        g = np.vstack([
+            self.generator_bits[s * w:(s + 1) * w] for s in src])  # (kw, kw)
+        inv = gfw.gf2_invert_matrix(g)
+        rows = []
+        for e in out:
+            if e < k:
+                rows.append(inv[e * w:(e + 1) * w])
+            else:
+                block = self.coding_bits[(e - k) * w:(e - k + 1) * w]
+                rows.append((block.astype(np.int32) @ inv.astype(np.int32))
+                            .astype(np.uint8) & 1)
+        rmat = np.vstack(rows)
+        self._decode_cache.put(key, rmat)
+        return rmat
 
 
 class MatrixCodec(ErasureCode):
-    """Bytewise GF(2^8) matrix code; subclasses supply the coding matrix."""
+    """Bytewise GF(2^w) matrix code; subclasses supply the coding matrix."""
 
     def __init__(self):
         super().__init__()
@@ -131,7 +279,8 @@ class MatrixCodec(ErasureCode):
         raise NotImplementedError
 
     def prepare(self) -> None:
-        self.engine = _DeviceMatrixEngine(self.k, self.m, self.build_coding_matrix())
+        self.engine = _DeviceMatrixEngine(
+            self.k, self.m, self.build_coding_matrix(), w=self.w)
 
     # -- single-stripe paths (reference-API compatible) ---------------------
 
@@ -164,6 +313,10 @@ class MatrixCodec(ErasureCode):
     def encode_batch(self, data) -> np.ndarray:
         return self.engine.encode_parity_batch(data)
 
+    def stripe_unit(self, default: int) -> int:
+        wb = self.w // 8
+        return ((default + wb - 1) // wb) * wb
+
     def decode_batch(self, erasures: Tuple[int, ...], chunks,
                      want: Tuple[int, ...] = None) -> np.ndarray:
         """chunks: (B, k+m, S) with erased positions ignored (zeros ok).
@@ -176,28 +329,59 @@ class MatrixCodec(ErasureCode):
             want = tuple(erasures)
         avail = tuple(i for i in range(self.k + self.m) if i not in erasures)
         src = avail[: self.k]
-        data = jnp.asarray(chunks)[:, list(src), :]
-        return self.engine.reconstruct_batch(src, tuple(want), data)
+        return self.engine.reconstruct_batch_from(src, tuple(want), chunks)
 
 
 class BitmatrixCodec(MatrixCodec):
-    """Packet-interleaved bit-matrix code (jerasure cauchy family, w=8).
+    """Packet-interleaved bit-matrix code (jerasure cauchy + liberation
+    families).
 
     Chunk layout follows jerasure_schedule_encode: a chunk is a sequence of
     super-blocks of w*packetsize bytes; packet-row t of a super-block holds
     bits "t" of the w-bit field elements.  Encode selects and XORs packets
     according to the (m*w, k*w) bit-matrix — on the MXU this is the same
     GF(2) matmul with the bit-matrix Kronecker-expanded over byte lanes.
+
+    Subclasses supply the bit-matrices: the cauchy family derives them from
+    a GF(2^8) byte matrix (expand_bitmatrix is a ring homomorphism, so byte
+    inversion and bit inversion agree); the liberation family overrides
+    ``_encode_bits``/``_decode_bits`` with native GF(2) constructions.
     """
 
     def __init__(self):
         super().__init__()
         self.packetsize = 2048
 
+    # -- bit-matrix sources (overridden by native bit-matrix codes) ---------
+
+    def _encode_bits(self) -> np.ndarray:
+        """(m*w, k*w) GF(2) encode matrix."""
+        return gf8.expand_bitmatrix(self.engine.coding)
+
+    def _decode_bits(self, src: Tuple[int, ...],
+                     out: Tuple[int, ...]) -> np.ndarray:
+        """(len(out)*w, k*w) GF(2) recovery matrix over the src chunks."""
+        return gf8.expand_bitmatrix(self.engine.decode_matrix(src, out))
+
+    # -- packet layout ------------------------------------------------------
+
+    def stripe_unit(self, default: int) -> int:
+        quantum = self.w * self.packetsize
+        return ((default + quantum - 1) // quantum) * quantum
+
+    def _check_layout(self, s: int) -> None:
+        if s % (self.w * self.packetsize):
+            raise ECError(
+                errno.EINVAL,
+                f"chunk size {s} must be a multiple of w*packetsize = "
+                f"{self.w * self.packetsize} (choose packetsize/profile "
+                "accordingly, reference jerasure blocksize contract)")
+
     def _layout_rows(self, data: np.ndarray) -> np.ndarray:
         """(c, S) chunks -> (c*w, S/w) packet-row matrix."""
         c, s = data.shape
         w, p = self.w, self.packetsize
+        self._check_layout(s)
         ns = s // (w * p)
         return (
             data.reshape(c, ns, w, p).transpose(0, 2, 1, 3).reshape(c * w, ns * p)
@@ -214,11 +398,12 @@ class BitmatrixCodec(MatrixCodec):
         lane = _lane_expand(m01.tobytes(), m01.shape)
         return np.asarray(_encode_cols(lane, jnp.asarray(rows)))
 
+    # -- single-stripe paths ------------------------------------------------
+
     def encode_chunks(self, chunks: Dict[int, np.ndarray]) -> None:
         data = np.stack([chunks[i] for i in range(self.k)])
         rows = self._layout_rows(data)
-        bitmat = gf8.expand_bitmatrix(self.engine.coding)  # (m*w, k*w) over GF(2)
-        prows = self._apply_bitmat(bitmat, rows)
+        prows = self._apply_bitmat(self._encode_bits(), rows)
         parity = self._unlayout_rows(prows, data.shape[1])
         for i in range(self.m):
             chunks[self.k + i][...] = parity[i]
@@ -234,10 +419,31 @@ class BitmatrixCodec(MatrixCodec):
             raise ECError(errno.EIO, "not enough chunks to decode")
         erased = tuple(i for i in range(self.k + self.m) if i not in chunks)
         src = tuple(avail[: self.k])
-        rmat = self.engine.decode_matrix(src, erased)
         data = np.stack([np.asarray(chunks[i], dtype=np.uint8) for i in src])
         rows = self._layout_rows(data)
-        out_rows = self._apply_bitmat(gf8.expand_bitmatrix(rmat), rows)
+        out_rows = self._apply_bitmat(self._decode_bits(src, erased), rows)
         out = self._unlayout_rows(out_rows, data.shape[1])
         for idx, e in enumerate(erased):
             decoded[e][...] = out[idx]
+
+    # -- batched device paths (packet-aware, overriding the bytewise
+    #    MatrixCodec versions so batch and single-stripe bytes agree) -------
+
+    def encode_batch(self, data) -> np.ndarray:
+        data = jnp.asarray(data)
+        self._check_layout(data.shape[2])
+        m01 = self._encode_bits()
+        lane = _lane_expand(m01.tobytes(), m01.shape)
+        return _pkt_batch_apply(lane, data, self.w, self.packetsize)
+
+    def decode_batch(self, erasures: Tuple[int, ...], chunks,
+                     want: Tuple[int, ...] = None) -> np.ndarray:
+        if want is None:
+            want = tuple(erasures)
+        avail = tuple(i for i in range(self.k + self.m) if i not in erasures)
+        src = avail[: self.k]
+        chunks = jnp.asarray(chunks)
+        self._check_layout(chunks.shape[2])
+        m01 = self._decode_bits(src, tuple(want))
+        lane = _lane_expand(m01.tobytes(), m01.shape)
+        return _pkt_batch_apply(lane, chunks, self.w, self.packetsize, src)

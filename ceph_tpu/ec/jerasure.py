@@ -1,16 +1,17 @@
-"""The jerasure codec family.
+"""The jerasure codec family — all 7 techniques.
 
 Behavioral mirror of reference src/erasure-code/jerasure/
 ErasureCodeJerasure.{h,cc} and ErasureCodePluginJerasure.cc:42-56: technique
 selection by profile, per-technique alignment/chunk-size rules
 (ErasureCodeJerasure.cc:74-97), Vandermonde/RAID-6/Cauchy matrix generation
-(:199,245,301).  w=8 matrix semantics (gf-complete poly 0x11d).
+(:199,245,301), liberation-family bit-matrix preparation (:437-496).
 
-Techniques: reed_sol_van, reed_sol_r6_op (bytewise matrix codes),
-cauchy_orig, cauchy_good (packet-interleaved bit-matrix codes).  The
-liberation / blaum_roth / liber8tion minimal-density bit-matrix builders are
-not yet implemented; requesting them raises, matching the plugin's behavior
-for an unknown technique rather than silently substituting.
+Techniques: reed_sol_van, reed_sol_r6_op (bytewise matrix codes, w in
+{8, 16, 32} over gf-complete's default polynomials), cauchy_orig,
+cauchy_good (packet-interleaved bit-matrix codes, w=8), liberation,
+blaum_roth, liber8tion (native minimal-density GF(2) bit-matrices with
+packetsize semantics — see ceph_tpu.ec.liberation for the constructions
+and the liber8tion byte-compat caveat).
 """
 
 from __future__ import annotations
@@ -19,8 +20,9 @@ import errno
 
 import numpy as np
 
+from ceph_tpu.ec import liberation as libmod
 from ceph_tpu.ec import matrices
-from ceph_tpu.ec.codec import BitmatrixCodec, MatrixCodec
+from ceph_tpu.ec.codec import BitmatrixCodec, MatrixCodec, _DeviceBitEngine
 from ceph_tpu.ec.interface import ECError, ErasureCodeProfile
 
 LARGEST_VECTOR_WORDSIZE = 16
@@ -89,14 +91,15 @@ class ReedSolomonVandermonde(ErasureCodeJerasure):
             profile["w"] = "8"
             self.w = 8
             raise ECError(errno.EINVAL, "w must be in {8, 16, 32}")
-        if self.w != 8:
-            raise NotImplementedError("tpu jerasure supports w=8 matrix codes")
         self.per_chunk_alignment = self.to_bool(
             "jerasure-per-chunk-alignment", profile, "false"
         )
 
     def build_coding_matrix(self) -> np.ndarray:
-        return matrices.reed_sol_vandermonde_coding_matrix(self.k, self.m)
+        if self.w == 8:
+            return matrices.reed_sol_vandermonde_coding_matrix(self.k, self.m)
+        return matrices.reed_sol_vandermonde_coding_matrix_w(
+            self.k, self.m, self.w)
 
 
 class ReedSolomonRAID6(ErasureCodeJerasure):
@@ -111,11 +114,11 @@ class ReedSolomonRAID6(ErasureCodeJerasure):
             profile["w"] = "8"
             self.w = 8
             raise ECError(errno.EINVAL, "w must be in {8, 16, 32}")
-        if self.w != 8:
-            raise NotImplementedError("tpu jerasure supports w=8 matrix codes")
 
     def build_coding_matrix(self) -> np.ndarray:
-        return matrices.reed_sol_r6_coding_matrix(self.k)
+        if self.w == 8:
+            return matrices.reed_sol_r6_coding_matrix(self.k)
+        return matrices.reed_sol_r6_coding_matrix_w(self.k, self.w)
 
 
 class Cauchy(BitmatrixCodec, ErasureCodeJerasure):
@@ -167,6 +170,109 @@ class CauchyGood(Cauchy):
         return matrices.cauchy_good_coding_matrix(self.k, self.m)
 
 
+def _is_prime(n: int) -> bool:
+    if n < 2:
+        return False
+    return all(n % d for d in range(2, int(n ** 0.5) + 1))
+
+
+class Liberation(BitmatrixCodec, ErasureCodeJerasure):
+    """Native minimal-density bit-matrix RAID-6 (m=2) code with packetsize
+    semantics (reference ErasureCodeJerasureLiberation,
+    ErasureCodeJerasure.cc:353-441; bit-matrix from ceph_tpu.ec.liberation).
+    """
+
+    DEFAULT_PACKETSIZE = "2048"
+    technique_name = "liberation"
+
+    def __init__(self):
+        ErasureCodeJerasure.__init__(self, self.technique_name)
+        self.DEFAULT_K = "2"
+        self.DEFAULT_M = "2"
+        self.DEFAULT_W = "7"
+        self.packetsize = 0
+        self.bit_engine: _DeviceBitEngine = None
+
+    def parse(self, profile: ErasureCodeProfile) -> None:
+        ErasureCodeJerasure.parse(self, profile)
+        profile.pop("m", None)
+        self.m = 2
+        self.packetsize = self.to_int(
+            "packetsize", profile, self.DEFAULT_PACKETSIZE)
+        if not self.check_k():
+            raise ECError(errno.EINVAL,
+                          f"k={self.k} must be <= w={self.w}")
+        if not self.check_w():
+            raise ECError(errno.EINVAL,
+                          f"w={self.w} must be greater than two and be prime")
+        if self.packetsize <= 0 or self.packetsize % 4:
+            raise ECError(errno.EINVAL,
+                          "packetsize must be a positive multiple of 4")
+
+    def check_k(self) -> bool:
+        return self.k <= self.w
+
+    def check_w(self) -> bool:
+        # reference ErasureCodeJerasureLiberation::check_w (:371-379)
+        return self.w > 2 and _is_prime(self.w)
+
+    def get_alignment(self) -> int:
+        # reference ErasureCodeJerasureLiberation::get_alignment (:353-359)
+        alignment = self.k * self.w * self.packetsize * 4
+        if (self.w * self.packetsize * 4) % LARGEST_VECTOR_WORDSIZE:
+            alignment = self.k * self.w * self.packetsize * \
+                LARGEST_VECTOR_WORDSIZE
+        return alignment
+
+    get_chunk_size = ErasureCodeJerasure.get_chunk_size
+
+    def build_bitmatrix(self) -> np.ndarray:
+        return libmod.liberation_coding_bitmatrix(self.k, self.w)
+
+    def prepare(self) -> None:
+        self.bit_engine = _DeviceBitEngine(
+            self.k, self.m, self.w, self.build_bitmatrix())
+
+    def _encode_bits(self) -> np.ndarray:
+        return self.bit_engine.coding_bits
+
+    def _decode_bits(self, src, out) -> np.ndarray:
+        return self.bit_engine.decode_bits(tuple(src), tuple(out))
+
+
+class BlaumRoth(Liberation):
+    technique_name = "blaum_roth"
+
+    def check_w(self) -> bool:
+        # reference tolerates w=7 for backward compatibility
+        # (ErasureCodeJerasure.cc:446-459)
+        if self.w == 7:
+            return True
+        return self.w > 2 and _is_prime(self.w + 1)
+
+    def build_bitmatrix(self) -> np.ndarray:
+        return libmod.blaum_roth_coding_bitmatrix(self.k, self.w)
+
+
+class Liber8tion(Liberation):
+    technique_name = "liber8tion"
+
+    def __init__(self):
+        super().__init__()
+        self.DEFAULT_W = "8"
+
+    def parse(self, profile: ErasureCodeProfile) -> None:
+        # reference Liber8tion::parse pins m=2, w=8 (:470-490)
+        profile.pop("w", None)
+        super().parse(profile)
+
+    def check_w(self) -> bool:
+        return self.w == 8
+
+    def build_bitmatrix(self) -> np.ndarray:
+        return libmod.liber8tion_coding_bitmatrix(self.k)
+
+
 def make_jerasure(profile: ErasureCodeProfile):
     """Technique dispatch (reference ErasureCodePluginJerasure.cc:42-56)."""
     technique = profile.get("technique", "reed_sol_van")
@@ -175,11 +281,12 @@ def make_jerasure(profile: ErasureCodeProfile):
         "reed_sol_r6_op": ReedSolomonRAID6,
         "cauchy_orig": CauchyOrig,
         "cauchy_good": CauchyGood,
+        "liberation": Liberation,
+        "blaum_roth": BlaumRoth,
+        "liber8tion": Liber8tion,
     }
     if technique not in TECHNIQUES:
         raise ECError(errno.ENOENT, f"unknown technique {technique}")
-    if technique not in table:
-        raise NotImplementedError(f"technique {technique} not yet implemented")
     codec = table[technique]()
     codec.init(profile)
     return codec

@@ -58,6 +58,11 @@ class ErasureCodeLrc(ErasureCode):
         self.chunk_count = 0
         self.data_chunk_count = 0
         self.rule_steps: List[Step] = [Step("chooseleaf", "host", 0)]
+        # jitted batch entry points: the layer routing must be ONE device
+        # dispatch — eager per-layer gathers/scatters cost a runtime round
+        # trip each, which dominates end-to-end throughput
+        self._enc_jit = None
+        self._dec_jit: Dict = {}
 
     # -- profile parsing ----------------------------------------------------
 
@@ -395,19 +400,39 @@ class ErasureCodeLrc(ErasureCode):
         Applies every layer in order like encode_chunks: each layer gathers
         its data-position subset and computes its parities with the layer
         codec's batched MXU path (reference encode_chunks routing,
-        ErasureCodeLrc.cc:744 — but over the whole stripe batch at once).
+        ErasureCodeLrc.cc:744 — but over the whole stripe batch at once,
+        the whole walk traced into ONE jitted dispatch).
+
+        CRITICAL: the per-layer encode bit-matrices are passed as jit
+        ARGUMENTS, never captured by the trace — a jit closure over a
+        device-resident array permanently degrades every subsequent
+        dispatch in the process on the axon platform (~150x).
         """
+        import jax
+
+        if self._enc_jit is None:
+            self._enc_jit = jax.jit(self._encode_batch_impl)
+        mats = tuple(layer.erasure_code.engine._enc_bitmat
+                     for layer in self.layers)
+        return self._enc_jit(data, mats)
+
+    def _encode_batch_impl(self, data, mats):
         import jax.numpy as jnp
 
-        data = jnp.asarray(data)
+        from ceph_tpu.ops import gf8
+
+        data = jnp.asarray(data, dtype=jnp.uint8)
         b, k, s = data.shape
         n = self.chunk_count
         data_pos, coding_pos = self._positions()
         full = jnp.zeros((b, n, s), dtype=jnp.uint8)
         full = full.at[:, jnp.asarray(data_pos), :].set(data)
-        for layer in self.layers:
+        for layer, bitmat in zip(self.layers, mats):
             sub = full[:, jnp.asarray(layer.data), :]
-            parity = layer.erasure_code.encode_batch(sub)
+            lk = len(layer.data)
+            cols = sub.transpose(1, 0, 2).reshape(lk, b * s)
+            out = gf8.bitmatrix_matmul(bitmat, cols)
+            parity = out.reshape(out.shape[0], b, s).transpose(1, 0, 2)
             full = full.at[:, jnp.asarray(layer.coding), :].set(parity)
         return full[:, jnp.asarray(coding_pos), :]
 
@@ -416,19 +441,48 @@ class ErasureCodeLrc(ErasureCode):
         exactly like decode_chunks.  ``chunks``: (B, n, S) in logical order
         with zeros at erased ids; ``erasures`` = every unavailable logical
         id; ``want`` = subset to return (default all).  Returns
-        (B, len(want), S)."""
-        import jax.numpy as jnp
+        (B, len(want), S).  Jitted per erasure pattern: the whole walk is
+        one device dispatch, recovery plans cached like the reference's
+        decode-table caches."""
+        import jax
 
         if want is None:
             want = tuple(erasures)
-        chunks = jnp.asarray(chunks)
-        b, n, s = chunks.shape
+        key = (tuple(erasures), tuple(want))
+        cached = self._dec_jit.get(key)
+        if cached is None:
+            # resolve the layer plan AND every recovery bit-matrix on the
+            # host once per pattern; matrices flow in as jit arguments
+            # (never closure constants — see encode_batch)
+            steps, out_pos = self._decode_plan(key[0], key[1])
+            mats = tuple(
+                layer.erasure_code.engine.decode_bitmat(
+                    self._layer_src(layer, local_erasures), local_erasures)
+                for layer, local_erasures, _ in steps)
+            plan = tuple((tuple(layer.chunks),
+                          self._layer_src(layer, local_erasures),
+                          tuple(layer_erased))
+                         for layer, local_erasures, layer_erased in steps)
+            fn = jax.jit(lambda chunks, mats: self._decode_batch_impl(
+                chunks, plan, out_pos, mats))
+            cached = self._dec_jit[key] = (fn, mats)
+        fn, mats = cached
+        return fn(chunks, mats)
+
+    @staticmethod
+    def _layer_src(layer, local_erasures):
+        ln = len(layer.chunks)
+        lk = layer.erasure_code.get_data_chunk_count()
+        avail = tuple(i for i in range(ln) if i not in local_erasures)
+        return avail[:lk]
+
+    def _decode_plan(self, erasures, want):
+        """Host-side routing decisions for one erasure pattern: which
+        layers run, with which local erasures."""
         logical_to_pos = list(self.chunk_mapping)
-        # repack into positional order
-        full = jnp.zeros((b, n, s), dtype=jnp.uint8)
-        full = full.at[:, jnp.asarray(logical_to_pos), :].set(chunks)
         erased_pos = {logical_to_pos[e] for e in erasures}
         want_pos = {logical_to_pos[e] for e in want}
+        steps = []
         for layer in reversed(self.layers):
             layer_erased = [c for c in layer.chunks if c in erased_pos]
             if not layer_erased:
@@ -436,10 +490,9 @@ class ErasureCodeLrc(ErasureCode):
             if len(layer_erased) > layer.erasure_code.get_coding_chunk_count():
                 continue
             local_ids = {c: j for j, c in enumerate(layer.chunks)}
-            local_erasures = tuple(local_ids[c] for c in layer_erased)
-            sub = full[:, jnp.asarray(layer.chunks), :]
-            out = layer.erasure_code.decode_batch(local_erasures, sub)
-            full = full.at[:, jnp.asarray(layer_erased), :].set(out)
+            steps.append(
+                (layer, tuple(local_ids[c] for c in layer_erased),
+                 tuple(layer_erased)))
             erased_pos -= set(layer_erased)
             if not erased_pos & want_pos:
                 break
@@ -447,8 +500,28 @@ class ErasureCodeLrc(ErasureCode):
             raise ECError(
                 errno.EIO,
                 f"unable to reconstruct positions {sorted(erased_pos & want_pos)}")
-        out_pos = [logical_to_pos[e] for e in want]
-        return full[:, jnp.asarray(out_pos), :]
+        out_pos = tuple(logical_to_pos[e] for e in want)
+        return steps, out_pos
+
+    def _decode_batch_impl(self, chunks, plan, out_pos, mats):
+        import jax.numpy as jnp
+
+        from ceph_tpu.ops import gf8
+
+        chunks = jnp.asarray(chunks, dtype=jnp.uint8)
+        b, n, s = chunks.shape
+        logical_to_pos = list(self.chunk_mapping)
+        # repack into positional order
+        full = jnp.zeros((b, n, s), dtype=jnp.uint8)
+        full = full.at[:, jnp.asarray(logical_to_pos), :].set(chunks)
+        for (layer_chunks, src, layer_erased), bitmat in zip(plan, mats):
+            srcs_global = [layer_chunks[i] for i in src]
+            sub = full[:, jnp.asarray(srcs_global), :]
+            cols = sub.transpose(1, 0, 2).reshape(len(src), b * s)
+            out = gf8.bitmatrix_matmul(bitmat, cols)
+            out = out.reshape(out.shape[0], b, s).transpose(1, 0, 2)
+            full = full.at[:, jnp.asarray(list(layer_erased)), :].set(out)
+        return full[:, jnp.asarray(list(out_pos)), :]
 
     # -- CRUSH rule generation ----------------------------------------------
 

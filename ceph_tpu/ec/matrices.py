@@ -172,4 +172,74 @@ def isa_cauchy_matrix(k: int, m: int) -> np.ndarray:
 def generator_matrix(coding: np.ndarray) -> np.ndarray:
     """Full (k+m, k) generator: identity stacked on the coding rows."""
     m, k = coding.shape
-    return np.vstack([np.eye(k, dtype=np.uint8), coding])
+    return np.vstack([np.eye(k, dtype=coding.dtype), coding])
+
+
+# ---------------------------------------------------------------------------
+# Wide-field (w in {16, 32}) builders — same constructions over GF(2^w)
+# scalar arithmetic (ceph_tpu.ops.gfw); matrices are k x m WORDS, host-side.
+# ---------------------------------------------------------------------------
+
+
+def reed_sol_vandermonde_coding_matrix_w(k: int, m: int, w: int) -> np.ndarray:
+    """(m, k) uint64 coding matrix over GF(2^w): identical algorithm to the
+    w=8 builder (extended Vandermonde -> column systematization -> the two
+    jerasure normalizations), with gf-complete's default polynomial for w."""
+    from ceph_tpu.ops import gfw
+
+    if w == 8:
+        return reed_sol_vandermonde_coding_matrix(k, m).astype(np.uint64)
+    gf = gfw.field(w)
+    rows, cols = k + m, k
+    v = [[0] * cols for _ in range(rows)]
+    v[0][0] = 1
+    for i in range(1, rows - 1):
+        for j in range(cols):
+            v[i][j] = gf.pow(i, j)
+    v[rows - 1][cols - 1] = 1
+    # systematize by elementary column operations
+    for i in range(cols):
+        if v[i][i] == 0:
+            for j in range(i + 1, cols):
+                if v[i][j] != 0:
+                    for r in range(rows):
+                        v[r][i], v[r][j] = v[r][j], v[r][i]
+                    break
+            else:
+                raise ValueError("vandermonde systematization failed")
+        if v[i][i] != 1:
+            inv = gf.inv(v[i][i])
+            for r in range(rows):
+                v[r][i] = gf.mul(v[r][i], inv)
+        for j in range(cols):
+            if j != i and v[i][j] != 0:
+                f = v[i][j]
+                for r in range(rows):
+                    v[r][j] ^= gf.mul(f, v[r][i])
+    coding = [row[:] for row in v[k:]]
+    # normalization 1: first parity row all ones (column scaling)
+    for j in range(k):
+        e = coding[0][j]
+        if e not in (0, 1):
+            inv = gf.inv(e)
+            for i in range(m):
+                coding[i][j] = gf.mul(coding[i][j], inv)
+    # normalization 2: first parity column all ones (row scaling, rows 1+)
+    for i in range(1, m):
+        e = coding[i][0]
+        if e not in (0, 1):
+            inv = gf.inv(e)
+            coding[i] = [gf.mul(x, inv) for x in coding[i]]
+    return np.array(coding, dtype=np.uint64)
+
+
+def reed_sol_r6_coding_matrix_w(k: int, w: int) -> np.ndarray:
+    """RAID-6 over GF(2^w): P = XOR, Q = sum 2^j d_j."""
+    from ceph_tpu.ops import gfw
+
+    gf = gfw.field(w)
+    mat = np.zeros((2, k), dtype=np.uint64)
+    mat[0, :] = 1
+    for j in range(k):
+        mat[1, j] = gf.pow(2, j)
+    return mat

@@ -126,6 +126,8 @@ class ErasureCodeShec(MatrixCodec):
         # decode-plan cache keyed by (want, avails) bit patterns
         # (ErasureCodeShecTableCache semantics)
         self._plan_cache: Dict[Tuple, Tuple] = {}
+        # batched recovery matrices per (erasures, want) pattern
+        self._batch_cache: Dict[Tuple, Tuple] = {}
 
     # -- profile ------------------------------------------------------------
 
@@ -358,10 +360,16 @@ class ErasureCodeShec(MatrixCodec):
         """
         import jax.numpy as jnp
 
-        from ceph_tpu.ec.codec import _encode_batch_jit
+        from ceph_tpu.ec.codec import _gather_encode_batch_jit
 
         if want is None:
             want = tuple(erasures)
+        cache_key = (tuple(erasures), tuple(want))
+        cached = self._batch_cache.get(cache_key)
+        if cached is not None:
+            bitmat, src_list = cached
+            return _gather_encode_batch_jit(
+                bitmat, jnp.asarray(chunks), tuple(src_list))
         n = self.k + self.m
         avails = [0 if i in erasures else 1 for i in range(n)]
         want_vec = [1 if i in want else 0 for i in range(n)]
@@ -404,8 +412,9 @@ class ErasureCodeShec(MatrixCodec):
                 rows.append(acc)
         rmat = np.stack(rows).astype(np.uint8)
         bitmat = jnp.asarray(gf8.expand_bitmatrix(rmat))
-        data = jnp.asarray(chunks)[:, src_list, :]
-        return _encode_batch_jit(bitmat, data)
+        self._batch_cache[cache_key] = (bitmat, tuple(src_list))
+        return _gather_encode_batch_jit(
+            bitmat, jnp.asarray(chunks), tuple(src_list))
 
 
 def make_shec(profile: ErasureCodeProfile):

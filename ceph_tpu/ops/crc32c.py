@@ -166,6 +166,43 @@ def _message_bitmat(block: int) -> np.ndarray:
     return m
 
 
+def _crc32c_batch_jit():
+    """Build the jitted device path lazily (jax import stays optional)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.ops import gf8
+
+    @jax.jit
+    def fn(bitmat, data, const):
+        # bitmatrix_matmul wants (k, n) columns: one block per column;
+        # the WHOLE batch CRC is one dispatch — transpose, matmul, and the
+        # byte->u32 recombination all inside the jit
+        out_bytes = gf8.bitmatrix_matmul(bitmat, data.T)   # (4, N)
+        crcs = (
+            out_bytes[0].astype(jnp.uint32)
+            | (out_bytes[1].astype(jnp.uint32) << 8)
+            | (out_bytes[2].astype(jnp.uint32) << 16)
+            | (out_bytes[3].astype(jnp.uint32) << 24)
+        )
+        return crcs ^ const
+
+    return fn
+
+
+_batch_jit = None
+
+
+@functools.lru_cache(maxsize=16)
+def _message_bitmat_dev(block: int):
+    """Device-resident copy of the message matrix, cached per block size —
+    re-uploading ~1 MiB per call would defeat the one-dispatch hot path.
+    It stays a jit ARGUMENT (never a closure constant; axon constraint)."""
+    import jax.numpy as jnp
+
+    return jnp.asarray(_message_bitmat(block))
+
+
 def crc32c_batch(data, seed: int = 0xFFFFFFFF):
     """(N, B) uint8 blocks -> (N,) uint32 CRCs, computed on device.
 
@@ -174,18 +211,11 @@ def crc32c_batch(data, seed: int = 0xFFFFFFFF):
     """
     import jax.numpy as jnp
 
-    from ceph_tpu.ops import gf8
-
+    global _batch_jit
+    if _batch_jit is None:
+        _batch_jit = _crc32c_batch_jit()
     data = jnp.asarray(data)
     n, block = data.shape
-    bitmat = jnp.asarray(_message_bitmat(block))
-    # bitmatrix_matmul wants (k, n) columns: one block per column
-    out_bytes = gf8.bitmatrix_matmul(bitmat, data.T)       # (4, N)
-    crcs = (
-        out_bytes[0].astype(jnp.uint32)
-        | (out_bytes[1].astype(jnp.uint32) << 8)
-        | (out_bytes[2].astype(jnp.uint32) << 16)
-        | (out_bytes[3].astype(jnp.uint32) << 24)
-    )
+    bitmat = _message_bitmat_dev(block)
     const = np.uint32(crc32c_zeros(seed, block))
-    return crcs ^ const
+    return _batch_jit(bitmat, data, const)

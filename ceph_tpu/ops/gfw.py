@@ -1,0 +1,212 @@
+"""GF(2^w) arithmetic for w in {8, 16, 32} + w-bit-word device packing.
+
+Behavioral reference: gf-complete's default fields (galois_init_default_field
+— reference jerasure_init.cc:27-37 selects them), used by jerasure's matrix
+codes for w in {8, 16, 32}.  Polynomials are gf-complete's defaults:
+
+    w=8  : x^8  + x^4  + x^3 + x^2 + 1          (0x11d)
+    w=16 : x^16 + x^12 + x^3 + x   + 1          (0x1100b)
+    w=32 : x^32 + x^22 + x^2 + x   + 1          (0x100400007)
+
+TPU-first design: identical to the w=8 path (ceph_tpu.ops.gf8) — multiply
+by a constant ``a`` is GF(2)-linear, so each matrix entry expands to a
+(w, w) bit-matrix and the whole encode/decode becomes ONE GF(2) matmul on
+the MXU.  Only the *word* granularity changes: chunks are sequences of
+little-endian w-bit words, so bit-row t of word-lane layout comes from
+byte t//8, bit t%8.  Host-side helpers (matrix build/invert) are scalar
+Python ints — they touch k x m entries, never data.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class GFW:
+    """Scalar GF(2^w) arithmetic over Python ints (host-side, tiny)."""
+
+    POLY = {8: 0x11D, 16: 0x1100B, 32: 0x100400007}
+
+    def __init__(self, w: int):
+        if w not in self.POLY:
+            raise ValueError(f"unsupported w={w}")
+        self.w = w
+        self.poly = self.POLY[w]
+        self.mask = (1 << w) - 1
+
+    def mul(self, a: int, b: int) -> int:
+        """Carryless multiply mod the field polynomial."""
+        a &= self.mask
+        b &= self.mask
+        r = 0
+        while b:
+            if b & 1:
+                r ^= a
+            b >>= 1
+            a <<= 1
+            if a >> self.w:
+                a ^= self.poly
+        return r
+
+    def pow(self, a: int, n: int) -> int:
+        r = 1
+        a &= self.mask
+        while n:
+            if n & 1:
+                r = self.mul(r, a)
+            a = self.mul(a, a)
+            n >>= 1
+        return r
+
+    def inv(self, a: int) -> int:
+        if a == 0:
+            raise ZeroDivisionError("gf inv(0)")
+        return self.pow(a, (1 << self.w) - 2)
+
+    def div(self, a: int, b: int) -> int:
+        return self.mul(a, self.inv(b))
+
+    def bitmat(self, a: int) -> np.ndarray:
+        """(w, w) GF(2) matrix of multiply-by-a, LSB-first:
+        out[t, u] = bit t of a * 2^u."""
+        w = self.w
+        out = np.zeros((w, w), dtype=np.uint8)
+        for u in range(w):
+            col = self.mul(a, 1 << u)
+            for t in range(w):
+                out[t, u] = (col >> t) & 1
+        return out
+
+
+@functools.lru_cache(maxsize=8)
+def field(w: int) -> GFW:
+    return GFW(w)
+
+
+def expand_bitmatrix_w(mat: np.ndarray, w: int) -> np.ndarray:
+    """Expand an (r, k) word matrix into its (rw, kw) GF(2) bit-matrix
+    (generalizes gf8.expand_bitmatrix; same semantics as jerasure's
+    jerasure_matrix_to_bitmatrix for any w)."""
+    gf = field(w)
+    mat = np.asarray(mat, dtype=np.uint64)
+    r, k = mat.shape
+    out = np.zeros((r * w, k * w), dtype=np.uint8)
+    for i in range(r):
+        for j in range(k):
+            out[i * w:(i + 1) * w, j * w:(j + 1) * w] = gf.bitmat(int(mat[i, j]))
+    return out
+
+
+def gfw_invert_matrix(a: np.ndarray, w: int) -> np.ndarray:
+    """Gauss-Jordan inversion over GF(2^w); scalar host math (k x k words).
+    Equivalent of ISA-L gf_invert_matrix / jerasure invert_matrix for the
+    wide fields."""
+    gf = field(w)
+    a = [[int(x) for x in row] for row in np.asarray(a, dtype=np.uint64)]
+    n = len(a)
+    inv = [[1 if i == j else 0 for j in range(n)] for i in range(n)]
+    for col in range(n):
+        pivot = next((r for r in range(col, n) if a[r][col]), None)
+        if pivot is None:
+            raise ValueError(f"singular at column {col}")
+        if pivot != col:
+            a[col], a[pivot] = a[pivot], a[col]
+            inv[col], inv[pivot] = inv[pivot], inv[col]
+        scale = gf.inv(a[col][col])
+        a[col] = [gf.mul(x, scale) for x in a[col]]
+        inv[col] = [gf.mul(x, scale) for x in inv[col]]
+        for r in range(n):
+            if r != col and a[r][col]:
+                f = a[r][col]
+                a[r] = [x ^ gf.mul(f, y) for x, y in zip(a[r], a[col])]
+                inv[r] = [x ^ gf.mul(f, y) for x, y in zip(inv[r], inv[col])]
+    return np.array(inv, dtype=np.uint64)
+
+
+def gf2_invert_matrix(a: np.ndarray) -> np.ndarray:
+    """Invert a 0/1 matrix over GF(2) (numpy, host).  Used to build decode
+    matrices for the native bit-matrix codes (liberation family), the same
+    solve jerasure performs on the bit-matrix itself."""
+    a = np.array(a, dtype=np.uint8) & 1
+    n = a.shape[0]
+    if a.shape != (n, n):
+        raise ValueError("square matrix required")
+    inv = np.eye(n, dtype=np.uint8)
+    for col in range(n):
+        rows = np.nonzero(a[col:, col])[0]
+        if rows.size == 0:
+            raise ValueError(f"singular at column {col}")
+        pivot = col + int(rows[0])
+        if pivot != col:
+            a[[col, pivot]] = a[[pivot, col]]
+            inv[[col, pivot]] = inv[[pivot, col]]
+        elim = np.nonzero(a[:, col])[0]
+        for r in elim:
+            if r != col:
+                a[r] ^= a[col]
+                inv[r] ^= inv[col]
+    return inv
+
+
+# ---------------------------------------------------------------------------
+# Device packing for w-bit little-endian words
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnums=1)
+def unpack_bits_w(data, word_bytes: int):
+    """(k, n) uint8 -> (k*w, n/word_bytes) int8 of {0,1}.
+
+    Bit t of word lane = bit t%8 of byte t//8 (little-endian words, the
+    layout galois_wNN_region_multiply sees on x86)."""
+    k, n = data.shape
+    nw = n // word_bytes
+    words = data.reshape(k, nw, word_bytes)
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (words[:, :, :, None] >> shifts) & jnp.uint8(1)   # (k, nw, wb, 8)
+    w = word_bytes * 8
+    return (
+        bits.reshape(k, nw, w).transpose(0, 2, 1).reshape(k * w, nw)
+        .astype(jnp.int8)
+    )
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def pack_bits_w(bits, word_bytes: int):
+    """(r*w, nw) {0,1} -> (r, nw*word_bytes) uint8 (inverse of
+    unpack_bits_w)."""
+    w = word_bytes * 8
+    rw, nw = bits.shape
+    r = rw // w
+    b = bits.reshape(r, word_bytes, 8, nw).astype(jnp.int32)
+    weights = (1 << jnp.arange(8, dtype=jnp.int32))[None, None, :, None]
+    by = jnp.sum(b * weights, axis=2)                         # (r, wb, nw)
+    return by.transpose(0, 2, 1).reshape(r, nw * word_bytes).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnums=2)
+def bitmatrix_matmul_w(bitmat, data, word_bytes: int):
+    """Device GF matmul over w-bit words: ONE MXU int8 matmul.
+
+    bitmat: (rw, kw) {0,1}; data: (k, n) uint8 of k chunks; returns (r, n).
+    """
+    d_bits = unpack_bits_w(data, word_bytes)
+    acc = jax.lax.dot_general(
+        bitmat.astype(jnp.int8), d_bits,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return pack_bits_w(acc & 1, word_bytes)
+
+
+@functools.partial(jax.jit, static_argnums=2)
+def encode_batch_w(bitmat, data, word_bytes: int):
+    """(B, k, S) -> (B, r, S) through the word-generalized matmul."""
+    b, k, s = data.shape
+    cols = data.transpose(1, 0, 2).reshape(k, b * s)
+    out = bitmatrix_matmul_w(bitmat, cols, word_bytes)
+    r = out.shape[0]
+    return out.reshape(r, b, s).transpose(1, 0, 2)

@@ -188,6 +188,170 @@ static void isa_cauchy_matrix(int k, int m, int *coding) {
             coding[i * k + j] = gf_inv((k + i) ^ j);
 }
 
+/* ---------------- wide fields GF(2^w), w in {16, 32} -------------------- */
+
+static uint64_t gfw_poly(int w) {
+    return w == 8 ? 0x11d : w == 16 ? 0x1100b : 0x100400007ULL;
+}
+
+static uint64_t gfw_mul(int w, uint64_t a, uint64_t b) {
+    uint64_t poly = gfw_poly(w), mask = (w == 64) ? ~0ULL : ((1ULL << w) - 1);
+    uint64_t r = 0;
+    a &= mask; b &= mask;
+    while (b) {
+        if (b & 1) r ^= a;
+        b >>= 1;
+        a <<= 1;
+        if (a >> w) a ^= poly;
+    }
+    return r & mask;
+}
+
+static uint64_t gfw_pow(int w, uint64_t a, uint64_t n) {
+    uint64_t r = 1;
+    while (n) {
+        if (n & 1) r = gfw_mul(w, r, a);
+        a = gfw_mul(w, a, a);
+        n >>= 1;
+    }
+    return r;
+}
+
+static uint64_t gfw_inv(int w, uint64_t a) {
+    /* a^(2^w - 2) */
+    return gfw_pow(w, a, ((w == 32) ? 0xffffffffULL : ((1ULL << w) - 1)) - 1);
+}
+
+static uint64_t gfw_div(int w, uint64_t a, uint64_t b) {
+    return gfw_mul(w, a, gfw_inv(w, b));
+}
+
+/* jerasure reed_sol over GF(2^w): same extended-Vandermonde systematization
+ * + the two normalizations as reed_sol_van_matrix, word arithmetic */
+static void reed_sol_van_matrix_w(int k, int m, int w, uint64_t *coding) {
+    int rows = k + m, cols = k;
+    uint64_t *v = calloc(rows * cols, sizeof(uint64_t));
+    int i, j, x;
+    v[0] = 1;
+    for (i = 1; i < rows - 1; i++)
+        for (j = 0; j < cols; j++)
+            v[i * cols + j] = gfw_pow(w, i, j);
+    v[(rows - 1) * cols + (cols - 1)] = 1;
+    for (i = 0; i < cols; i++) {
+        if (v[i * cols + i] == 0) {
+            for (j = i + 1; j < cols; j++)
+                if (v[i * cols + j] != 0) break;
+            if (j == cols) { fprintf(stderr, "systematize failed\n"); exit(1); }
+            for (x = 0; x < rows; x++) {
+                uint64_t t = v[x * cols + i];
+                v[x * cols + i] = v[x * cols + j];
+                v[x * cols + j] = t;
+            }
+        }
+        if (v[i * cols + i] != 1) {
+            uint64_t inv = gfw_inv(w, v[i * cols + i]);
+            for (x = 0; x < rows; x++)
+                v[x * cols + i] = gfw_mul(w, v[x * cols + i], inv);
+        }
+        for (j = 0; j < cols; j++) {
+            uint64_t f = v[i * cols + j];
+            if (j != i && f != 0)
+                for (x = 0; x < rows; x++)
+                    v[x * cols + j] ^= gfw_mul(w, f, v[x * cols + i]);
+        }
+    }
+    for (j = 0; j < cols; j++) {
+        uint64_t e = v[k * cols + j];
+        if (e != 0 && e != 1)
+            for (x = k; x < rows; x++)
+                v[x * cols + j] = gfw_div(w, v[x * cols + j], e);
+    }
+    for (x = k + 1; x < rows; x++) {
+        uint64_t e = v[x * cols + 0];
+        if (e != 0 && e != 1)
+            for (j = 0; j < cols; j++)
+                v[x * cols + j] = gfw_div(w, v[x * cols + j], e);
+    }
+    for (i = 0; i < m; i++)
+        for (j = 0; j < k; j++)
+            coding[i * k + j] = v[(k + i) * cols + j];
+    free(v);
+}
+
+static void reed_sol_r6_matrix_w(int k, int w, uint64_t *coding) {
+    int j;
+    for (j = 0; j < k; j++) {
+        coding[0 * k + j] = 1;
+        coding[1 * k + j] = gfw_pow(w, 2, j);
+    }
+}
+
+/* ---------------- native GF(2) bit-matrices (liberation family) --------- */
+
+/* Plank's Liberation construction (w prime, k <= w, m=2): row 0 block =
+ * [I..I]; row 1 block j = I cyclically shifted by j, plus for j>0 one
+ * extra bit at (i, (i+j-1) mod w), i = (j*(w-1)/2) mod w. */
+static void lib_bitmatrix(int k, int w, uint8_t *bm /* 2w x kw */) {
+    int i, j, t;
+    memset(bm, 0, 2 * w * k * w);
+    for (t = 0; t < w; t++)
+        for (j = 0; j < k; j++)
+            bm[t * k * w + j * w + t] = 1;
+    for (j = 0; j < k; j++) {
+        for (i = 0; i < w; i++)
+            bm[(w + i) * k * w + j * w + (j + i) % w] = 1;
+        if (j > 0) {
+            i = (j * ((w - 1) / 2)) % w;
+            bm[(w + i) * k * w + j * w + (i + j - 1) % w] = 1;
+        }
+    }
+}
+
+/* Blaum-Roth over GF(2)[x]/M_p(x), p = w+1: row 1 block j = multiply by
+ * x^j; column u of block j = x^(j+u) reduced mod M_p = 1 + x + ... + x^w */
+static uint64_t br_reduce(uint64_t bits, int w) {
+    uint64_t M = ((uint64_t)1 << (w + 1)) - 1;   /* 1 + x + ... + x^w */
+    int d;
+    for (d = 63; d >= w; d--)
+        if ((bits >> d) & 1) bits ^= M << (d - w);
+    return bits;
+}
+
+static void br_bitmatrix(int k, int w, uint8_t *bm) {
+    int i, j, t, u;
+    memset(bm, 0, 2 * w * k * w);
+    for (t = 0; t < w; t++)
+        for (j = 0; j < k; j++)
+            bm[t * k * w + j * w + t] = 1;
+    for (j = 0; j < k; j++)
+        for (u = 0; u < w; u++) {
+            uint64_t col = br_reduce((uint64_t)1 << (j + u), w);
+            for (i = 0; i < w; i++)
+                if ((col >> i) & 1)
+                    bm[(w + i) * k * w + j * w + u] = 1;
+        }
+}
+
+/* liber8tion-style (w=8, m=2): row 1 block j = GF(2^8) multiply-by-(2^j)
+ * bit-matrix (deterministic stand-in for Plank's searched matrices; see
+ * ceph_tpu/ec/liberation.py docstring) */
+static void l8_bitmatrix(int k, uint8_t *bm) {
+    int w = 8, i, j, t, u, g = 1;
+    memset(bm, 0, 2 * w * k * w);
+    for (t = 0; t < w; t++)
+        for (j = 0; j < k; j++)
+            bm[t * k * w + j * w + t] = 1;
+    for (j = 0; j < k; j++) {
+        for (u = 0; u < w; u++) {
+            int col = gf_mul(g, 1 << u);
+            for (t = 0; t < w; t++)
+                if ((col >> t) & 1)
+                    bm[(w + t) * k * w + j * w + u] = 1;
+        }
+        g = gf_mul(g, 2);
+    }
+}
+
 /* ---------------- encodes ---------------------------------------------- */
 
 /* bytewise matrix encode: parity[i][b] = XOR_j mat[i][j] * data[j][b] */
@@ -229,6 +393,44 @@ static void bitmatrix_encode(const int *mat, int k, int m, int ps,
             }
 }
 
+/* wordwise matrix encode over GF(2^w), little-endian w-bit words */
+static void matrix_encode_w(const uint64_t *mat, int k, int m, int w,
+                            uint8_t **data, uint8_t **parity, int size) {
+    int wb = w / 8, nw = size / wb, i, j, n, b;
+    for (i = 0; i < m; i++)
+        for (n = 0; n < nw; n++) {
+            uint64_t acc = 0;
+            for (j = 0; j < k; j++) {
+                uint64_t v = 0;
+                for (b = 0; b < wb; b++)
+                    v |= (uint64_t)data[j][n * wb + b] << (8 * b);
+                acc ^= gfw_mul(w, mat[i * k + j], v);
+            }
+            for (b = 0; b < wb; b++)
+                parity[i][n * wb + b] = (acc >> (8 * b)) & 0xff;
+        }
+}
+
+/* packet-interleaved encode from an explicit (mw x kw) 0/1 bit-matrix */
+static void bitmatrix01_encode(const uint8_t *bm, int k, int m, int w, int ps,
+                               uint8_t **data, uint8_t **parity, int size) {
+    int sb = w * ps;
+    int ns = size / sb;
+    int i, t, j, u, s, b;
+    for (i = 0; i < m; i++)
+        for (t = 0; t < w; t++)
+            for (s = 0; s < ns; s++) {
+                uint8_t *out = parity[i] + s * sb + t * ps;
+                memset(out, 0, ps);
+                for (j = 0; j < k; j++)
+                    for (u = 0; u < w; u++)
+                        if (bm[(i * w + t) * (k * w) + j * w + u]) {
+                            const uint8_t *in = data[j] + s * sb + u * ps;
+                            for (b = 0; b < ps; b++) out[b] ^= in[b];
+                        }
+            }
+}
+
 /* ---------------- deterministic data + fingerprints -------------------- */
 
 static uint32_t lcg_state;
@@ -258,45 +460,51 @@ static void hex16(const uint8_t *p, char *out) {
 typedef struct {
     const char *plugin;
     const char *technique;
-    int k, m, packetsize;
+    int k, m, w, packetsize;
     int object_size;   /* chosen pre-aligned: no padding ambiguity */
     int seed;
 } Cfg;
 
 static const Cfg CONFIGS[] = {
-    {"jerasure", "reed_sol_van", 4, 2, 0, 4096, 1},
-    {"jerasure", "reed_sol_van", 8, 4, 0, 8192, 2},
-    {"jerasure", "reed_sol_van", 6, 3, 0, 6144, 3},
-    {"jerasure", "reed_sol_r6_op", 4, 2, 0, 4096, 4},
-    {"jerasure", "cauchy_orig", 3, 2, 8, 2304, 5},
-    {"jerasure", "cauchy_good", 4, 2, 8, 4096, 6},
-    {"jerasure", "cauchy_good", 5, 3, 8, 6400, 7},
-    {"isa", "reed_sol_van", 8, 4, 0, 8192, 8},
-    {"isa", "reed_sol_van", 4, 2, 0, 4096, 9},
-    {"isa", "cauchy", 8, 4, 0, 8192, 10},
+    {"jerasure", "reed_sol_van", 4, 2, 8, 0, 4096, 1},
+    {"jerasure", "reed_sol_van", 8, 4, 8, 0, 8192, 2},
+    {"jerasure", "reed_sol_van", 6, 3, 8, 0, 6144, 3},
+    {"jerasure", "reed_sol_r6_op", 4, 2, 8, 0, 4096, 4},
+    {"jerasure", "cauchy_orig", 3, 2, 8, 8, 2304, 5},
+    {"jerasure", "cauchy_good", 4, 2, 8, 8, 4096, 6},
+    {"jerasure", "cauchy_good", 5, 3, 8, 8, 6400, 7},
+    {"isa", "reed_sol_van", 8, 4, 8, 0, 8192, 8},
+    {"isa", "reed_sol_van", 4, 2, 8, 0, 4096, 9},
+    {"isa", "cauchy", 8, 4, 8, 0, 8192, 10},
+    /* wide fields */
+    {"jerasure", "reed_sol_van", 4, 2, 16, 0, 8192, 11},
+    {"jerasure", "reed_sol_van", 4, 2, 32, 0, 8192, 12},
+    {"jerasure", "reed_sol_r6_op", 4, 2, 16, 0, 8192, 13},
+    /* liberation family (native bit-matrices) */
+    {"jerasure", "liberation", 4, 2, 7, 4, 896, 14},
+    {"jerasure", "blaum_roth", 4, 2, 6, 4, 1152, 15},
+    {"jerasure", "liber8tion", 5, 2, 8, 4, 1920, 16},
 };
+
+static int is_native_bitmatrix(const Cfg *c) {
+    return !strcmp(c->technique, "liberation") ||
+           !strcmp(c->technique, "blaum_roth") ||
+           !strcmp(c->technique, "liber8tion");
+}
 
 int main(void) {
     unsigned ci;
     for (ci = 0; ci < sizeof(CONFIGS) / sizeof(CONFIGS[0]); ci++) {
         const Cfg *c = &CONFIGS[ci];
-        int k = c->k, m = c->m;
+        int k = c->k, m = c->m, w = c->w;
         int chunk = c->object_size / k;
         int *mat = calloc(m * k, sizeof(int));
+        uint64_t *matw = calloc(m * k, sizeof(uint64_t));
+        uint8_t *bm = calloc(m * w * k * w, 1);
         uint8_t **data = calloc(k, sizeof(uint8_t *));
         uint8_t **parity = calloc(m, sizeof(uint8_t *));
         int i, j;
         char hexbuf[40];
-
-        if (!strcmp(c->plugin, "jerasure")) {
-            if (!strcmp(c->technique, "reed_sol_van")) reed_sol_van_matrix(k, m, mat);
-            else if (!strcmp(c->technique, "reed_sol_r6_op")) reed_sol_r6_matrix(k, mat);
-            else if (!strcmp(c->technique, "cauchy_orig")) cauchy_orig_matrix(k, m, mat);
-            else if (!strcmp(c->technique, "cauchy_good")) cauchy_good_matrix(k, m, mat);
-        } else {
-            if (!strcmp(c->technique, "cauchy")) isa_cauchy_matrix(k, m, mat);
-            else isa_rs_matrix(k, m, mat);
-        }
 
         lcg_state = (uint32_t)c->seed;
         for (i = 0; i < k; i++) {
@@ -305,18 +513,51 @@ int main(void) {
         }
         for (i = 0; i < m; i++) parity[i] = malloc(chunk);
 
-        if (c->packetsize)
-            bitmatrix_encode(mat, k, m, c->packetsize, data, parity, chunk);
-        else
-            matrix_encode(mat, k, m, data, parity, chunk);
+        if (is_native_bitmatrix(c)) {
+            if (!strcmp(c->technique, "liberation")) lib_bitmatrix(k, w, bm);
+            else if (!strcmp(c->technique, "blaum_roth")) br_bitmatrix(k, w, bm);
+            else l8_bitmatrix(k, bm);
+            bitmatrix01_encode(bm, k, m, w, c->packetsize, data, parity, chunk);
+        } else if (w != 8) {
+            if (!strcmp(c->technique, "reed_sol_van"))
+                reed_sol_van_matrix_w(k, m, w, matw);
+            else reed_sol_r6_matrix_w(k, w, matw);
+            matrix_encode_w(matw, k, m, w, data, parity, chunk);
+        } else {
+            if (!strcmp(c->plugin, "jerasure")) {
+                if (!strcmp(c->technique, "reed_sol_van")) reed_sol_van_matrix(k, m, mat);
+                else if (!strcmp(c->technique, "reed_sol_r6_op")) reed_sol_r6_matrix(k, mat);
+                else if (!strcmp(c->technique, "cauchy_orig")) cauchy_orig_matrix(k, m, mat);
+                else if (!strcmp(c->technique, "cauchy_good")) cauchy_good_matrix(k, m, mat);
+            } else {
+                if (!strcmp(c->technique, "cauchy")) isa_cauchy_matrix(k, m, mat);
+                else isa_rs_matrix(k, m, mat);
+            }
+            if (c->packetsize)
+                bitmatrix_encode(mat, k, m, c->packetsize, data, parity, chunk);
+            else
+                matrix_encode(mat, k, m, data, parity, chunk);
+        }
 
         printf("{\"plugin\": \"%s\", \"technique\": \"%s\", \"k\": %d, "
-               "\"m\": %d, \"packetsize\": %d, \"object_size\": %d, "
-               "\"seed\": %d, \"chunk_size\": %d, \"matrix\": [",
-               c->plugin, c->technique, k, m, c->packetsize,
+               "\"m\": %d, \"w\": %d, \"packetsize\": %d, \"object_size\": %d, "
+               "\"seed\": %d, \"chunk_size\": %d, ",
+               c->plugin, c->technique, k, m, w, c->packetsize,
                c->object_size, c->seed, chunk);
-        for (i = 0; i < m * k; i++)
-            printf("%s%d", i ? ", " : "", mat[i]);
+        if (is_native_bitmatrix(c)) {
+            printf("\"bitmatrix\": [");
+            for (i = 0; i < m * w * k * w; i++)
+                printf("%s%d", i ? ", " : "", bm[i]);
+        } else if (w != 8) {
+            printf("\"matrix\": [");
+            for (i = 0; i < m * k; i++)
+                printf("%s%llu", i ? ", " : "",
+                       (unsigned long long)matw[i]);
+        } else {
+            printf("\"matrix\": [");
+            for (i = 0; i < m * k; i++)
+                printf("%s%d", i ? ", " : "", mat[i]);
+        }
         printf("], \"chunks\": [");
         for (i = 0; i < k + m; i++) {
             const uint8_t *p = i < k ? data[i] : parity[i - k];
@@ -328,7 +569,7 @@ int main(void) {
 
         for (i = 0; i < k; i++) free(data[i]);
         for (i = 0; i < m; i++) free(parity[i]);
-        free(data); free(parity); free(mat);
+        free(data); free(parity); free(mat); free(matw); free(bm);
     }
     return 0;
 }

@@ -45,26 +45,38 @@ def _cases():
 
 def _case_id(case):
     return (f"{case['plugin']}-{case['technique']}-k{case['k']}m{case['m']}"
+            + (f"-w{case['w']}" if case.get("w", 8) != 8 else "")
             + (f"-ps{case['packetsize']}" if case["packetsize"] else ""))
 
 
 @pytest.mark.parametrize("case", _cases(), ids=_case_id)
 def test_encode_bytes_match_independent_oracle(case):
+    w = case.get("w", 8)
     profile = {
         "plugin": case["plugin"],
         "technique": case["technique"],
         "k": str(case["k"]),
         "m": str(case["m"]),
+        "w": str(w),
     }
     if case["packetsize"]:
         profile["packetsize"] = str(case["packetsize"])
     codec = factory(profile)
 
-    # coding matrix must match element-for-element
-    mat = np.asarray(case["matrix"], dtype=np.uint8).reshape(
-        case["m"], case["k"])
-    assert np.array_equal(codec.engine.coding, mat), (
-        f"coding matrix differs from oracle:\n{codec.engine.coding}\nvs\n{mat}")
+    if "bitmatrix" in case:
+        # native GF(2) bit-matrix code (liberation family)
+        bm = np.asarray(case["bitmatrix"], dtype=np.uint8).reshape(
+            case["m"] * w, case["k"] * w)
+        assert np.array_equal(codec.bit_engine.coding_bits, bm), (
+            "bit-matrix differs from oracle")
+    else:
+        # coding matrix must match element-for-element
+        mat = np.asarray(case["matrix"], dtype=np.uint64).reshape(
+            case["m"], case["k"])
+        assert np.array_equal(
+            codec.engine.coding.astype(np.uint64), mat), (
+            f"coding matrix differs from oracle:\n{codec.engine.coding}"
+            f"\nvs\n{mat}")
 
     # chunk geometry must agree (object sizes were chosen pre-aligned)
     assert codec.get_chunk_size(case["object_size"]) == case["chunk_size"]
@@ -86,5 +98,11 @@ def test_golden_file_covers_all_implemented_techniques():
     assert ("jerasure", "reed_sol_r6_op") in seen
     assert ("jerasure", "cauchy_orig") in seen
     assert ("jerasure", "cauchy_good") in seen
+    assert ("jerasure", "liberation") in seen
+    assert ("jerasure", "blaum_roth") in seen
+    assert ("jerasure", "liber8tion") in seen
     assert ("isa", "reed_sol_van") in seen
     assert ("isa", "cauchy") in seen
+    wides = {(c["plugin"], c["technique"], c.get("w", 8)) for c in _cases()}
+    assert ("jerasure", "reed_sol_van", 16) in wides
+    assert ("jerasure", "reed_sol_van", 32) in wides
