@@ -98,9 +98,13 @@ class MOSDOpReply(Message):
 
 @dataclass
 class MOSDRepOp(Message):
+    """Replica transaction (reference MOSDRepOp): carries the pg log entry
+    so every member's log advances identically with the mutation."""
+
     reqid: Tuple[str, int] = ("", 0)
     pgid: Optional[PGid] = None
     txn_blob: bytes = b""
+    entry: Any = None            # pglog.LogEntry
     epoch: int = 0
 
 
@@ -127,6 +131,7 @@ class MOSDECSubOpWrite(Message):
     chunk_off: int = 0
     shard_size: Optional[int] = None
     hinfo: Dict[str, Any] = field(default_factory=dict)
+    entry: Any = None            # pglog.LogEntry
     epoch: int = 0
 
 
@@ -160,13 +165,17 @@ class MOSDECSubOpReadReply(Message):
 
 @dataclass
 class MOSDPGPush(Message):
-    """Recovery push (reference push/pull recovery, ReplicatedBackend)."""
+    """Recovery push (reference push/pull recovery, ReplicatedBackend).
+    op="push" writes the object; op="delete" removes it (a logged delete
+    replayed onto a stale member)."""
 
     pgid: Optional[PGid] = None
     oid: str = ""
     shard: int = -1  # -1 for replicated full object
+    op: str = "push"
     data: bytes = b""
     version: int = 0
+    entry: Any = None            # pglog.LogEntry
     xattrs: Dict[str, bytes] = field(default_factory=dict)
 
 

@@ -90,6 +90,7 @@ class Messenger:
         self._out: Dict[Addr, Connection] = {}
         self._accepted: List[Connection] = []
         self._tasks: List[asyncio.Task] = []
+        self._closing = False
         self.my_addr: Optional[Addr] = None
 
     def add_dispatcher(self, d: Dispatcher) -> None:
@@ -102,9 +103,17 @@ class Messenger:
 
     async def _accept(self, reader, writer) -> None:
         conn = Connection(self, reader, writer)
+        if self._closing:
+            # a peer raced our shutdown: refuse, or the read loop would
+            # keep Server.wait_closed() (which since py3.12 awaits every
+            # handler) hanging until the PEER closes — a distributed
+            # shutdown deadlock when that peer stops after us
+            await conn.close()
+            return
         self._accepted.append(conn)
-        self._tasks.append(asyncio.current_task() or
-                           asyncio.create_task(asyncio.sleep(0)))
+        task = asyncio.current_task()
+        if task is not None:
+            self._tasks.append(task)
         await self._read_loop(conn)
 
     async def _read_loop(self, conn: Connection) -> None:
@@ -144,11 +153,18 @@ class Messenger:
         await conn.send(msg)
 
     async def shutdown(self) -> None:
-        for conn in list(self._out.values()) + self._accepted:
-            await conn.close()
+        self._closing = True
         if self._server:
             self._server.close()
-            await self._server.wait_closed()
+        for conn in list(self._out.values()) + list(self._accepted):
+            await conn.close()
+        # cancel + drain reader/handler tasks BEFORE wait_closed: since
+        # py3.12 wait_closed() awaits every connection handler, and a
+        # handler blocked in its read loop only exits via EOF or cancel
         for t in self._tasks:
             if not t.done():
                 t.cancel()
+        if self._tasks:
+            await asyncio.gather(*self._tasks, return_exceptions=True)
+        if self._server:
+            await self._server.wait_closed()

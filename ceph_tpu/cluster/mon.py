@@ -47,6 +47,10 @@ class Monitor(Dispatcher):
         self.subscribers: Set[Addr] = set()
         self.failure_reports: Dict[int, Set[int]] = {}
         self.down_since: Dict[int, float] = {}
+        # last beacon per osd (reference MOSDBeacon/last_osd_report): lets
+        # the tick mark OSDs down even when no reporters remain (e.g. the
+        # whole cluster stopped at once)
+        self.last_beacon: Dict[int, float] = {}
         self.perf = PerfCounters("mon")
         self._tick_task: Optional[asyncio.Task] = None
         self._log: List[Tuple[str, object]] = []  # proposal log (Paxos seam)
@@ -94,6 +98,10 @@ class Monitor(Dispatcher):
         if isinstance(msg, M.MOSDFailure):
             await self._handle_failure(msg)
             return True
+        if isinstance(msg, M.MOSDAlive):
+            if 0 <= msg.osd_id < self.osdmap.max_osd:
+                self.last_beacon[msg.osd_id] = time.monotonic()
+            return True
         if isinstance(msg, M.MMonSubscribe):
             self.subscribers.add(tuple(msg.addr))
             await self._send_map(tuple(msg.addr), since=msg.since)
@@ -112,6 +120,7 @@ class Monitor(Dispatcher):
         inc.new_up[msg.osd_id] = tuple(msg.addr)
         self.down_since.pop(msg.osd_id, None)
         self.failure_reports.pop(msg.osd_id, None)
+        self.last_beacon[msg.osd_id] = time.monotonic()
         self.perf.inc("mon_osd_boot")
         await self._commit_inc(inc)
 
@@ -185,11 +194,19 @@ class Monitor(Dispatcher):
         if pool_type == POOL_TYPE_ERASURE:
             from ceph_tpu.ec import factory
 
-            codec = factory(ec_profile or {"plugin": "jerasure",
-                                           "technique": "reed_sol_van",
-                                           "k": "2", "m": "1"})
+            if not ec_profile:
+                ec_profile = {"plugin": "jerasure",
+                              "technique": "reed_sol_van",
+                              "k": "2", "m": "1"}
+            codec = factory(ec_profile)
             size = codec.get_chunk_count()
             min_size = codec.get_data_chunk_count()
+            # compose the stripe unit with the codec's layout constraints
+            # (packet-interleaved codecs need w*packetsize multiples) so
+            # default profiles never EINVAL deep in the data path
+            ec_profile["stripe_unit"] = str(codec.stripe_unit(
+                int(ec_profile.get("stripe_unit",
+                                   self.config.osd_ec_stripe_unit))))
             # ErasureCode::create_rule analog: indep chooseleaf rule
             rule = Rule(steps=[
                 (RULE_TAKE, root, 0),
@@ -249,7 +266,8 @@ class Monitor(Dispatcher):
             M.MOSDMapMsg(epoch=epoch, osdmap_blob=blob), addr)
 
     async def _tick(self) -> None:
-        """Down-out tick (reference OSDMonitor tick auto-out)."""
+        """Down-out + beacon-staleness tick (reference OSDMonitor tick:
+        auto-out and mark-down of osds whose beacons went silent)."""
         while True:
             await asyncio.sleep(self.config.mon_tick_interval)
             now = time.monotonic()
@@ -259,5 +277,12 @@ class Monitor(Dispatcher):
                         self.osdmap.osd_weight[osd] > 0:
                     inc.new_weights[osd] = 0
                     self.down_since.pop(osd)
-            if inc.new_weights:
+            for osd, last in list(self.last_beacon.items()):
+                if self.osdmap.osd_up[osd] and \
+                        now - last > self.config.mon_osd_beacon_grace:
+                    inc.new_down.append(osd)
+                    self.down_since[osd] = now
+                    self.last_beacon.pop(osd)
+                    self.perf.inc("mon_osd_marked_down")
+            if inc.new_weights or inc.new_down:
                 await self._commit_inc(inc)

@@ -110,7 +110,9 @@ class Objecter(Dispatcher):
 
     async def op_submit(self, pool_id: int, oid: str,
                         ops: List[Tuple[str, Dict[str, Any]]],
-                        timeout: float = 30.0) -> M.MOSDOpReply:
+                        timeout: Optional[float] = None) -> M.MOSDOpReply:
+        if timeout is None:
+            timeout = self.config.rados_osd_op_timeout
         deadline = asyncio.get_event_loop().time() + timeout
         backoff = 0.05
         while True:
@@ -160,29 +162,33 @@ class IoCtx:
         self.objecter = objecter
         self.pool_id = pool_id
 
-    async def write_full(self, oid: str, data: bytes) -> None:
+    async def write_full(self, oid: str, data: bytes,
+                         timeout: float = None) -> None:
         reply = await self.objecter.op_submit(
-            self.pool_id, oid, [("write_full", {"data": data})])
+            self.pool_id, oid, [("write_full", {"data": data})],
+            timeout=timeout)
         if reply.result != 0:
             raise IOError(f"write_full({oid}) -> {reply.result}: {reply.data}")
 
-    async def write(self, oid: str, data: bytes, offset: int = 0) -> None:
+    async def write(self, oid: str, data: bytes, offset: int = 0,
+                    timeout: float = None) -> None:
         """Partial write at an offset — the EC read-modify-write path
         (reference IoCtxImpl::write -> ECBackend::start_rmw)."""
         reply = await self.objecter.op_submit(
-            self.pool_id, oid, [("write", {"offset": offset, "data": data})])
+            self.pool_id, oid, [("write", {"offset": offset, "data": data})],
+            timeout=timeout)
         if reply.result != 0:
             raise IOError(f"write({oid}) -> {reply.result}: {reply.data}")
 
     async def read(self, oid: str, offset: int = 0,
-                   length: int = None) -> bytes:
+                   length: int = None, timeout: float = None) -> bytes:
         args = {}
         if offset:
             args["offset"] = offset
         if length is not None:
             args["length"] = length
         reply = await self.objecter.op_submit(
-            self.pool_id, oid, [("read", args)])
+            self.pool_id, oid, [("read", args)], timeout=timeout)
         if reply.result == -2:
             raise FileNotFoundError(oid)
         if reply.result != 0:

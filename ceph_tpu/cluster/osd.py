@@ -19,6 +19,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
 from ceph_tpu.cluster import messages as M
+from ceph_tpu.cluster import pglog
 from ceph_tpu.cluster.messenger import (
     Addr,
     Connection,
@@ -26,11 +27,19 @@ from ceph_tpu.cluster.messenger import (
     EntityName,
     Messenger,
 )
+from ceph_tpu.cluster.pglog import LogEntry, PGInfo, PGLog
 from ceph_tpu.cluster.store import MemStore, ObjectStore, Transaction
 from ceph_tpu.crush.types import CRUSH_ITEM_NONE
 from ceph_tpu.ops import crc32c as crcmod
 from ceph_tpu.osdmap.osdmap import OSDMap, PGid, PGPool
 from ceph_tpu.utils import Config, PerfCounters
+
+# the per-PG metadata object holding the persisted log + last_update
+# (reference: the pgmeta ghobject, PG::_init / read_info)
+PGMETA = "_pgmeta_"
+# the daemon-level metadata collection: superblock with the current osdmap
+# (reference OSDSuperblock, read at OSD::init, src/osd/OSD.cc:2556)
+METACOLL = "meta"
 
 
 @dataclass
@@ -39,6 +48,17 @@ class PGState:
     up: List[int] = field(default_factory=list)
     acting: List[int] = field(default_factory=list)
     primary: int = -1
+    # pg_info_t analog: every mutation advances last_update and appends to
+    # the log (reference PG.h pg_log)
+    last_update: pglog.Eversion = pglog.ZERO
+    log: PGLog = field(default_factory=PGLog)
+    # per-PG op serialization domain (reference PG lock / ShardedOpWQ,
+    # src/osd/OSD.h:1599): mutations hold this across their whole
+    # fan-out so concurrent writes order identically on all replicas
+    lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+
+    def info(self) -> PGInfo:
+        return PGInfo(last_update=self.last_update, log_tail=self.log.tail)
 
 
 @dataclass
@@ -49,7 +69,9 @@ class MOSDPGQuery(M.Message):
 @dataclass
 class MOSDPGQueryReply(M.Message):
     pgid: Optional[PGid] = None
-    objects: Dict[str, int] = field(default_factory=dict)  # oid -> version
+    objects: Dict[str, int] = field(default_factory=dict)  # oid -> seq
+    info: Optional[PGInfo] = None
+    log: Optional[PGLog] = None
 
 
 def _coll(pgid: PGid) -> str:
@@ -70,7 +92,6 @@ class OSDDaemon(Dispatcher):
         self.pgs: Dict[PGid, PGState] = {}
         self.perf = PerfCounters(f"osd.{osd_id}")
         self._codecs: Dict[int, object] = {}
-        self._obj_locks: Dict[Tuple[PGid, str], list] = {}  # [Lock, refcount]
         self._pending: Dict[Tuple, Tuple[asyncio.Future, List]] = {}
         self._tid = 0
         self._tasks: List[asyncio.Task] = []
@@ -81,24 +102,120 @@ class OSDDaemon(Dispatcher):
     # ------------------------------------------------------------ lifecycle
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> Addr:
+        self.store.mount()
+        since = self._load_superblock()
         addr = await self.messenger.bind(host, port)
         await self.messenger.send_message(
             M.MOSDBoot(osd_id=self.osd_id, addr=addr), self.mon_addr)
         await self.messenger.send_message(
-            M.MMonSubscribe(what="osdmap", addr=addr), self.mon_addr)
+            M.MMonSubscribe(what="osdmap", addr=addr, since=since),
+            self.mon_addr)
         loop = asyncio.get_event_loop()
         self._tasks.append(loop.create_task(self._heartbeat_loop()))
         return addr
+
+    def _load_superblock(self) -> int:
+        """Resume from the persisted osdmap + PG logs (reference
+        read_superblock + load_pgs, OSD.cc:2556,2572).  Returns the epoch
+        to subscribe from (0 = never booted)."""
+        blob = self.store.getattr(METACOLL, "superblock", "osdmap")
+        if blob is None:
+            return 0
+        self.osdmap = pickle.loads(blob)
+        self.perf.set("osd_map_epoch", self.osdmap.epoch)
+        self._advance_pgs()  # reloads per-PG logs from their pgmeta objects
+        return self.osdmap.epoch
+
+    def _save_superblock(self) -> None:
+        self.store.queue_transaction(
+            Transaction()
+            .create_collection(METACOLL)
+            .setattr(METACOLL, "superblock", "osdmap",
+                     pickle.dumps(self.osdmap)))
 
     async def stop(self) -> None:
         self._stopped = True
         for t in self._tasks:
             t.cancel()
         await self.messenger.shutdown()
+        self.store.umount()
 
     def _next_reqid(self) -> Tuple[str, int]:
         self._tid += 1
         return (f"osd.{self.osd_id}", self._tid)
+
+    # --------------------------------------------------------- pg log state
+
+    def _next_version(self, st: PGState) -> pglog.Eversion:
+        """eversion for the next mutation: (map epoch, next seq)."""
+        return (self.osdmap.epoch if self.osdmap else 0, st.last_update[1] + 1)
+
+    @staticmethod
+    def _meta_key(version: pglog.Eversion) -> str:
+        return f"{version[0]:010d}.{version[1]:012d}"
+
+    def _log_mutation(self, st: PGState, op: str, oid: str,
+                      version: pglog.Eversion,
+                      entry: Optional[LogEntry] = None):
+        """Append a log entry + persist it INCREMENTALLY to the pgmeta
+        object (one omap key per entry + a head attr), so a restarted OSD
+        peers from its on-store log instead of backfilling and the hot
+        write path never re-serializes the whole log (reference: log
+        entries ride the op's own transaction, PG::write_if_dirty).
+        Replicas pass the primary's ``entry`` through verbatim so every
+        member's log (incl. prior_version chains) stays byte-identical.
+        Returns the appended LogEntry, or None for a replayed duplicate."""
+        if version <= st.last_update:
+            return None  # replayed/duplicate entry
+        if entry is None:
+            entry = LogEntry(op=op, oid=oid, version=version,
+                             prior_version=st.last_update)
+        st.log.append(entry)
+        st.last_update = version
+        dropped = st.log.trim()
+        coll = _coll(st.pgid)
+        txn = (Transaction()
+               .omap_set(coll, PGMETA,
+                         {self._meta_key(version): pickle.dumps(entry)})
+               .setattr(coll, PGMETA, "last_update", pickle.dumps(version))
+               .setattr(coll, PGMETA, "log_tail", pickle.dumps(st.log.tail)))
+        if dropped:
+            txn.omap_rmkeys(coll, PGMETA,
+                            [self._meta_key(e.version) for e in dropped])
+        self.store.queue_transaction(txn)
+        return entry
+
+    def _save_pg_meta(self, st: PGState) -> None:
+        """Full rewrite of the persisted log (recovery-time adoption of an
+        authoritative log; NOT on the per-op path)."""
+        coll = _coll(st.pgid)
+        old = list(self.store.omap_get(coll, PGMETA))
+        txn = Transaction()
+        if old:
+            txn.omap_rmkeys(coll, PGMETA, old)
+        txn.omap_set(coll, PGMETA,
+                     {self._meta_key(e.version): pickle.dumps(e)
+                      for e in st.log.entries})
+        txn.setattr(coll, PGMETA, "last_update", pickle.dumps(st.last_update))
+        txn.setattr(coll, PGMETA, "log_tail", pickle.dumps(st.log.tail))
+        self.store.queue_transaction(txn)
+
+    def _load_pg_meta(self, pgid: PGid) -> Tuple[pglog.Eversion, PGLog]:
+        coll = _coll(pgid)
+        lu = self.store.getattr(coll, PGMETA, "last_update")
+        if lu is None:
+            return pglog.ZERO, PGLog()
+        last_update = pickle.loads(lu)
+        tail_blob = self.store.getattr(coll, PGMETA, "log_tail")
+        tail = pickle.loads(tail_blob) if tail_blob else pglog.ZERO
+        entries = [pickle.loads(v) for _, v in
+                   sorted(self.store.omap_get(coll, PGMETA).items())]
+        entries = [e for e in entries if e.version > tail]
+        return last_update, PGLog(tail=tail, entries=entries)
+
+    def _list_pg_objects(self, pgid: PGid) -> List[str]:
+        return [o for o in self.store.list_objects(_coll(pgid))
+                if o != PGMETA]
 
     def _codec(self, pool: PGPool):
         codec = self._codecs.get(pool.pool_id)
@@ -146,6 +263,10 @@ class OSDDaemon(Dispatcher):
         if isinstance(msg, M.MOSDRepOp):
             txn = Transaction.decode(msg.txn_blob)
             self.store.queue_transaction(txn)
+            st = self.pgs.get(msg.pgid)
+            if st is not None and msg.entry is not None:
+                self._log_mutation(st, msg.entry.op, msg.entry.oid,
+                                   msg.entry.version, entry=msg.entry)
             self.perf.inc("osd_rep_ops")
             await conn.send(M.MOSDRepOpReply(reqid=msg.reqid, result=0))
             return True
@@ -172,9 +293,13 @@ class OSDDaemon(Dispatcher):
         if isinstance(msg, MOSDPGQuery):
             objects = {
                 oid: self.store.get_version(_coll(msg.pgid), oid)
-                for oid in self.store.list_objects(_coll(msg.pgid))
+                for oid in self._list_pg_objects(msg.pgid)
             }
-            await conn.send(MOSDPGQueryReply(pgid=msg.pgid, objects=objects))
+            st = self.pgs.get(msg.pgid)
+            await conn.send(MOSDPGQueryReply(
+                pgid=msg.pgid, objects=objects,
+                info=st.info() if st else None,
+                log=st.log if st else None))
             return True
         if isinstance(msg, MOSDPGQueryReply):
             self._ack(("pgq", str(msg.pgid), msg.src.num), 0, msg)
@@ -249,6 +374,7 @@ class OSDDaemon(Dispatcher):
 
     async def _post_map_update(self) -> None:
         newmap = self.osdmap
+        self._save_superblock()
         if not self._stopped and self.osd_id < newmap.max_osd and \
                 not newmap.osd_up[self.osd_id]:
             # the map says we are down but we are alive: re-boot (reference
@@ -264,7 +390,10 @@ class OSDDaemon(Dispatcher):
 
     def _advance_pgs(self) -> bool:
         """Recompute PG membership for this OSD; returns True if the set of
-        primary PGs changed (triggering recovery)."""
+        primary PGs changed (triggering recovery).  PG log/last_update are
+        preserved across map changes (and reloaded from the pgmeta object
+        when the collection already exists on store — the load_pgs resume
+        path, reference OSD.cc:2572)."""
         m = self.osdmap
         changed = False
         for pool_id, pool in m.pools.items():
@@ -273,12 +402,17 @@ class OSDDaemon(Dispatcher):
                 mine = self.osd_id in [o for o in acting if o != CRUSH_ITEM_NONE]
                 old = self.pgs.get(pgid)
                 if mine:
-                    st = PGState(pgid, up, acting, actp)
-                    if old is None or old.acting != acting:
+                    if old is None:
                         changed = True
                         self.store.queue_transaction(
                             Transaction().create_collection(_coll(pgid)))
-                    self.pgs[pgid] = st
+                        st = PGState(pgid, up, acting, actp)
+                        st.last_update, st.log = self._load_pg_meta(pgid)
+                        self.pgs[pgid] = st
+                    else:
+                        if old.acting != acting:
+                            changed = True
+                        old.up, old.acting, old.primary = up, acting, actp
                 elif old is not None:
                     del self.pgs[pgid]
                     changed = True
@@ -332,12 +466,15 @@ class OSDDaemon(Dispatcher):
         self.perf.inc("osd_client_ops")
         for opname, args in msg.ops:
             if opname == "write_full":
-                r = await self._op_write_full(pool, st, msg.oid, args["data"])
+                async with st.lock:
+                    r = await self._op_write_full(
+                        pool, st, msg.oid, args["data"])
                 await conn.send(M.MOSDOpReply(
                     reqid=msg.reqid, result=r, epoch=m.epoch))
             elif opname == "write":
-                r = await self._op_write(pool, st, msg.oid,
-                                         args["offset"], args["data"])
+                async with st.lock:
+                    r = await self._op_write(pool, st, msg.oid,
+                                             args["offset"], args["data"])
                 await conn.send(M.MOSDOpReply(
                     reqid=msg.reqid, result=r, epoch=m.epoch))
             elif opname == "read":
@@ -351,23 +488,21 @@ class OSDDaemon(Dispatcher):
                     await conn.send(M.MOSDOpReply(
                         reqid=msg.reqid, result=-2, epoch=m.epoch))
             elif opname == "delete":
-                r = await self._op_delete(pool, st, msg.oid)
+                async with st.lock:
+                    r = await self._op_delete(pool, st, msg.oid)
                 await conn.send(M.MOSDOpReply(
                     reqid=msg.reqid, result=r, epoch=m.epoch))
             elif opname == "stat":
                 size = self.store.stat(_coll(st.pgid), msg.oid)
-                if size is None and pool.is_erasure():
+                if pool.is_erasure():
                     xs = self.store.getattr(_coll(st.pgid), msg.oid, "size")
-                    size = int(xs) if xs else None
-                elif pool.is_erasure():
-                    xs = self.store.getattr(_coll(st.pgid), msg.oid, "size")
-                    size = int(xs) if xs else size
+                    size = int(xs) if xs else (None if size is None else size)
                 await conn.send(M.MOSDOpReply(
                     reqid=msg.reqid,
                     result=0 if size is not None else -2,
                     data=size, epoch=m.epoch))
             elif opname == "list":
-                names = self.store.list_objects(_coll(st.pgid))
+                names = self._list_pg_objects(st.pgid)
                 await conn.send(M.MOSDOpReply(
                     reqid=msg.reqid, result=0, data=names, epoch=m.epoch))
             else:
@@ -378,12 +513,12 @@ class OSDDaemon(Dispatcher):
                              data: bytes) -> int:
         if pool.is_erasure():
             return await self._ec_write(pool, st, oid, data, offset=None)
-        version = self.store.get_version(_coll(st.pgid), oid) + 1
+        version = self._next_version(st)
         txn = (Transaction()
                .remove(_coll(st.pgid), oid)
                .write(_coll(st.pgid), oid, 0, data)
-               .set_version(_coll(st.pgid), oid, version))
-        return await self._replicate_txn(st, txn)
+               .set_version(_coll(st.pgid), oid, version[1]))
+        return await self._replicate_txn(st, txn, "modify", oid, version)
 
     async def _op_write(self, pool: PGPool, st: PGState, oid: str,
                         offset: int, data: bytes) -> int:
@@ -391,14 +526,20 @@ class OSDDaemon(Dispatcher):
         (reference ECBackend::start_rmw, ECBackend.cc:1785)."""
         if pool.is_erasure():
             return await self._ec_write(pool, st, oid, data, offset=offset)
-        version = self.store.get_version(_coll(st.pgid), oid) + 1
+        version = self._next_version(st)
         txn = (Transaction()
                .write(_coll(st.pgid), oid, offset, data)
-               .set_version(_coll(st.pgid), oid, version))
-        return await self._replicate_txn(st, txn)
+               .set_version(_coll(st.pgid), oid, version[1]))
+        return await self._replicate_txn(st, txn, "modify", oid, version)
 
-    async def _replicate_txn(self, st: PGState, txn: Transaction) -> int:
+    async def _replicate_txn(self, st: PGState, txn: Transaction,
+                             op: str, oid: str,
+                             version: pglog.Eversion) -> int:
+        """Apply locally + fan out with the log entry; commit when all
+        acting replicas ack (reference PrimaryLogPG::issue_repop,
+        PrimaryLogPG.cc:9173)."""
         self.store.queue_transaction(txn)
+        entry = self._log_mutation(st, op, oid, version)
         peers = [o for o in st.acting
                  if o != self.osd_id and o != CRUSH_ITEM_NONE]
         if peers:
@@ -406,6 +547,7 @@ class OSDDaemon(Dispatcher):
             fut = self._make_waiter(reqid, len(peers))
             rep = M.MOSDRepOp(reqid=reqid, pgid=st.pgid,
                               txn_blob=txn.encode(),
+                              entry=entry,
                               epoch=self.osdmap.epoch)
             for o in peers:
                 await self._send_osd(o, rep)
@@ -419,15 +561,11 @@ class OSDDaemon(Dispatcher):
         return 0
 
     async def _op_delete(self, pool: PGPool, st: PGState, oid: str) -> int:
+        """Delete is ack-gated exactly like writes — fire-and-forget
+        MOSDRepOps let a slow replica resurrect the object."""
+        version = self._next_version(st)
         txn = Transaction().remove(_coll(st.pgid), oid)
-        self.store.queue_transaction(txn)
-        peers = [o for o in st.acting
-                 if o != self.osd_id and o != CRUSH_ITEM_NONE]
-        for o in peers:
-            await self._send_osd(o, M.MOSDRepOp(
-                reqid=self._next_reqid(), pgid=st.pgid,
-                txn_blob=txn.encode(), epoch=self.osdmap.epoch))
-        return 0
+        return await self._replicate_txn(st, txn, "delete", oid, version)
 
     async def _op_read(self, pool: PGPool, st: PGState, oid: str,
                        offset: int = 0, length: Optional[int] = None) -> bytes:
@@ -445,39 +583,19 @@ class OSDDaemon(Dispatcher):
 
     async def _ec_write(self, pool: PGPool, st: PGState, oid: str,
                         data: bytes, offset: Optional[int]) -> int:
-        """Per-object write serialization: the EC RMW sequence (read old
-        stripes, merge, re-encode, fan out shard writes) suspends at several
-        awaits; two concurrent partial writes interleaving there would
-        commit a mix of shard versions from both writers — parity
-        inconsistent with data.  The reference serializes overlapping RMWs
-        in the ECBackend pipeline (ECBackend::start_rmw wait queue).
-
-        Locks are refcounted and pruned at zero so the dict doesn't grow
-        with every distinct object ever written; the count is incremented
-        synchronously (no await between lookup and increment), so a pruned
-        entry can never race with a contender holding the old lock.
-        """
-        key = (st.pgid, oid)
-        entry = self._obj_locks.get(key)
-        if entry is None:
-            entry = self._obj_locks[key] = [asyncio.Lock(), 0]
-        entry[1] += 1
-        try:
-            async with entry[0]:
-                return await self._ec_write_locked(pool, st, oid, data, offset)
-        finally:
-            entry[1] -= 1
-            if entry[1] == 0:
-                self._obj_locks.pop(key, None)
-
-    async def _ec_write_locked(self, pool: PGPool, st: PGState, oid: str,
-                               data: bytes, offset: Optional[int]) -> int:
+        """EC write incl. the RMW sequence (read old stripes, merge,
+        re-encode, fan out shard writes).  Serialization: callers hold the
+        PG-wide st.lock across the whole op, so overlapping RMWs to one
+        object can never interleave (the reference serializes them in the
+        ECBackend pipeline, ECBackend::start_rmw wait queue; our domain is
+        the whole PG, like the reference's PG lock)."""
         from ceph_tpu.ec import stripe as stripemod
 
         codec = self._codec(pool)
         sinfo = self._sinfo(pool, codec)
         coll = _coll(st.pgid)
-        version = self.store.get_version(coll, oid) + 1
+        eversion = self._next_version(st)
+        version = eversion[1]
 
         if offset is None:
             # write_full: replace the object
@@ -517,13 +635,14 @@ class OSDDaemon(Dispatcher):
             self._apply_shard(st.pgid, oid, my_shard,
                               shards[my_shard].tobytes(), chunk_off,
                               shard_size, hinfo)
+        entry = self._log_mutation(st, "modify", oid, eversion)
         if peers:
             fut = self._make_waiter(reqid, len(peers))
             for osd, shard in peers:
                 await self._send_osd(osd, M.MOSDECSubOpWrite(
                     reqid=reqid, pgid=st.pgid, oid=oid, shard=shard,
                     data=shards[shard].tobytes(), chunk_off=chunk_off,
-                    shard_size=shard_size, hinfo=hinfo,
+                    shard_size=shard_size, hinfo=hinfo, entry=entry,
                     epoch=self.osdmap.epoch))
             try:
                 await asyncio.wait_for(
@@ -557,6 +676,10 @@ class OSDDaemon(Dispatcher):
             else msg.chunk_off + len(msg.data)
         self._apply_shard(msg.pgid, msg.oid, msg.shard, msg.data,
                           msg.chunk_off, shard_size, msg.hinfo)
+        st = self.pgs.get(msg.pgid)
+        if st is not None and msg.entry is not None:
+            self._log_mutation(st, msg.entry.op, msg.entry.oid,
+                               msg.entry.version, entry=msg.entry)
         self.perf.inc("osd_ec_sub_writes")
         await conn.send(M.MOSDECSubOpWriteReply(reqid=msg.reqid, result=0))
 
@@ -575,9 +698,17 @@ class OSDDaemon(Dispatcher):
             shard_attr = self.store.getattr(_coll(msg.pgid), msg.oid, "shard")
             shard = int(shard_attr) if shard_attr else msg.shard
             size = self.store.getattr(_coll(msg.pgid), msg.oid, "size")
+            hinfo = {"size": int(size) if size else 0}
+            if msg.shard == -1:
+                # whole-object fetch (pull recovery): carry version +
+                # xattrs so the puller stores a faithful copy
+                hinfo["version"] = self.store.get_version(
+                    _coll(msg.pgid), msg.oid)
+                o = self.store._colls.get(_coll(msg.pgid), {}).get(msg.oid)
+                hinfo["xattrs"] = dict(o.xattrs) if o else {}
             await conn.send(M.MOSDECSubOpReadReply(
                 reqid=msg.reqid, result=0, shard=shard, data=data,
-                hinfo={"size": int(size) if size else 0}))
+                hinfo=hinfo))
             self.perf.inc("osd_ec_sub_reads")
         except (FileNotFoundError, IOError):
             await conn.send(M.MOSDECSubOpReadReply(
@@ -692,77 +823,230 @@ class OSDDaemon(Dispatcher):
                     logging.getLogger("ceph_tpu.osd").exception(
                         "osd.%d: recovery of pg %s failed", self.osd_id, pgid)
 
+    async def _query_pg(self, osd: int, pgid: PGid):
+        """GetInfo/GetLog exchange with one member (reference peering
+        Query/Notify, PG.h RecoveryMachine GetInfo)."""
+        key = ("pgq", str(pgid), osd)
+        fut = self._make_waiter(key, 1)
+        try:
+            await self._send_osd(osd, MOSDPGQuery(pgid=pgid))
+            acc = await asyncio.wait_for(fut, timeout=2.0)
+            return acc[0][1]
+        except (asyncio.TimeoutError, ConnectionError):
+            return None
+        finally:
+            self._pending.pop(key, None)
+
     async def _recover_pg(self, st: PGState) -> None:
-        """Primary-driven resync: query members, reconstruct, push."""
+        """Primary-driven peering + recovery (flattened RecoveryMachine,
+        reference src/osd/PG.h:1994-2498):
+
+        1. GetInfo: collect (last_update, log) from every acting member.
+        2. GetLog: the max last_update owns the authoritative log; if that
+           is not us, bring ourselves up first (delta when our
+           last_update is inside the auth log window, backfill otherwise).
+        3. Active/Recovering: push ONLY the log delta to each stale
+           member; full-inventory backfill when a member is behind the
+           log tail.
+
+        Runs under the PG lock: peering mutates st.log/st.last_update, and
+        a client write interleaving with log adoption could regress
+        last_update and reuse an eversion (the reference blocks ops during
+        peering for the same reason)."""
+        async with st.lock:
+            await self._recover_pg_locked(st)
+
+    async def _recover_pg_locked(self, st: PGState) -> None:
         m = self.osdmap
         pool = m.pools[st.pgid.pool]
         members = [o for o in st.acting
                    if o not in (self.osd_id, CRUSH_ITEM_NONE)]
-        # object inventory = union of members' lists + local
-        names: Dict[str, int] = {
-            oid: self.store.get_version(_coll(st.pgid), oid)
-            for oid in self.store.list_objects(_coll(st.pgid))}
+        infos: Dict[int, PGInfo] = {self.osd_id: st.info()}
+        logs: Dict[int, PGLog] = {self.osd_id: st.log}
+        inventories: Dict[int, Dict[str, int]] = {}
         for osd in members:
-            key = ("pgq", str(st.pgid), osd)
-            fut = self._make_waiter(key, 1)
-            try:
-                await self._send_osd(osd, MOSDPGQuery(pgid=st.pgid))
-                acc = await asyncio.wait_for(fut, timeout=2.0)
-                for _, reply in acc:
-                    for oid, ver in reply.objects.items():
-                        names[oid] = max(names.get(oid, 0), ver)
-            except (asyncio.TimeoutError, ConnectionError):
-                pass
-            finally:
-                self._pending.pop(key, None)
-        for oid in names:
-            if pool.is_erasure():
-                await self._recover_ec_object(pool, st, oid)
+            reply = await self._query_pg(osd, st.pgid)
+            if reply is None:
+                continue
+            infos[osd] = reply.info or PGInfo()
+            logs[osd] = reply.log or PGLog()
+            inventories[osd] = reply.objects or {}
+
+        auth = pglog.choose_authoritative(infos)
+        if auth != self.osd_id and \
+                infos[auth].last_update > st.last_update:
+            await self._sync_self_from(
+                pool, st, auth, logs[auth], inventories.get(auth, {}))
+
+        for osd in members:
+            if osd not in infos:
+                continue
+            peer_lu = infos[osd].last_update
+            if peer_lu >= st.last_update:
+                continue
+            to_sync = st.log.objects_to_sync(peer_lu)
+            if to_sync is None:
+                await self._backfill_member(
+                    pool, st, osd, inventories.get(osd, {}))
             else:
-                await self._recover_rep_object(pool, st, oid, names[oid])
+                # replay in VERSION order so the member's log advances
+                # monotonically (out-of-order pushes would hit the
+                # duplicate guard and leave silent log holes)
+                for oid, entry in sorted(to_sync.items(),
+                                         key=lambda kv: kv[1].version):
+                    await self._push_object(pool, st, osd, oid, entry)
         self.perf.inc("osd_pg_recoveries")
 
-    async def _recover_rep_object(self, pool: PGPool, st: PGState,
-                                  oid: str, version: int) -> None:
-        if self.store.stat(_coll(st.pgid), oid) is None:
-            # pull from any member that has it
-            for osd in st.acting:
-                if osd in (self.osd_id, CRUSH_ITEM_NONE):
-                    continue
-                key = ("pgq", str(st.pgid), osd)
-                # reuse EC sub read as a generic object fetch
-                reqid = self._next_reqid()
-                fut = self._make_waiter(reqid, 1)
-                try:
-                    await self._send_osd(osd, M.MOSDECSubOpRead(
-                        reqid=reqid, pgid=st.pgid, oid=oid, shard=-1))
-                    acc = await asyncio.wait_for(fut, timeout=2.0)
-                    result, reply = acc[0]
-                    if result == 0:
-                        self.store.queue_transaction(
-                            Transaction().write(_coll(st.pgid), oid, 0,
-                                                reply.data))
-                        break
-                except (asyncio.TimeoutError, ConnectionError):
-                    continue
-                finally:
-                    self._pending.pop(reqid, None)
-        if self.store.stat(_coll(st.pgid), oid) is None:
+    async def _sync_self_from(self, pool: PGPool, st: PGState, auth: int,
+                              auth_log: PGLog,
+                              auth_inventory: Dict[str, int]) -> None:
+        """Bring the primary up to the authoritative member's state."""
+        coll = _coll(st.pgid)
+        to_sync = auth_log.objects_to_sync(st.last_update)
+        if to_sync is None:
+            # behind the log window: full backfill from auth's inventory
+            mine = {oid: self.store.get_version(coll, oid)
+                    for oid in self._list_pg_objects(st.pgid)}
+            to_pull = [oid for oid, ver in auth_inventory.items()
+                       if mine.get(oid, -1) < ver]
+            # objects we hold that the authoritative member does not =
+            # deletes we missed (possibly trimmed past the log tail);
+            # without this, a rejoining primary resurrects deleted objects
+            for oid in mine:
+                if oid not in auth_inventory:
+                    self.store.queue_transaction(
+                        Transaction().remove(coll, oid))
+        else:
+            to_pull = []
+            for oid, entry in to_sync.items():
+                if entry.op == "delete":
+                    self.store.queue_transaction(
+                        Transaction().remove(coll, oid))
+                else:
+                    to_pull.append(oid)
+        ok = True
+        for oid in to_pull:
+            if pool.is_erasure():
+                ok &= await self._recover_ec_object(
+                    pool, st, oid, targets=[self.osd_id])
+            else:
+                ok &= await self._pull_rep_object(st, auth, oid)
+        if not ok:
+            # a pull failed (auth unreachable mid-recovery): do NOT claim
+            # the authoritative version — stay stale so the next peering
+            # round retries instead of serving/pushing stale bytes as new
+            self.perf.inc("osd_recovery_incomplete")
             return
-        data = self.store.read(_coll(st.pgid), oid)
-        for osd in st.acting:
-            if osd in (self.osd_id, CRUSH_ITEM_NONE):
-                continue
+        # adopt the authoritative log
+        st.log = PGLog(tail=auth_log.tail,
+                       entries=list(auth_log.entries),
+                       max_entries=auth_log.max_entries)
+        st.last_update = auth_log.head if auth_log.entries else \
+            max(st.last_update, auth_log.tail)
+        self._save_pg_meta(st)
+
+    async def _pull_rep_object(self, st: PGState, source: int,
+                               oid: str) -> bool:
+        """Fetch a full replicated object from a member (pull recovery,
+        reference ReplicatedBackend::prepare_pull).  Returns success: the
+        caller must NOT claim the authoritative version for objects it
+        failed to pull."""
+        reqid = self._next_reqid()
+        fut = self._make_waiter(reqid, 1)
+        try:
+            await self._send_osd(source, M.MOSDECSubOpRead(
+                reqid=reqid, pgid=st.pgid, oid=oid, shard=-1))
+            acc = await asyncio.wait_for(fut, timeout=2.0)
+            result, reply = acc[0]
+            if result == 0 and reply is not None:
+                txn = (Transaction()
+                       .remove(_coll(st.pgid), oid)
+                       .write(_coll(st.pgid), oid, 0, reply.data)
+                       .set_version(_coll(st.pgid), oid,
+                                    reply.hinfo.get("version", 0)))
+                for k, v in reply.hinfo.get("xattrs", {}).items():
+                    txn.setattr(_coll(st.pgid), oid, k, v)
+                self.store.queue_transaction(txn)
+                return True
+        except (asyncio.TimeoutError, ConnectionError):
+            pass
+        finally:
+            self._pending.pop(reqid, None)
+        return False
+
+    async def _push_object(self, pool: PGPool, st: PGState, osd: int,
+                           oid: str, entry: LogEntry) -> None:
+        """Replay one log entry onto a stale member (delta recovery)."""
+        if entry.op == "delete":
             try:
                 await self._send_osd(osd, M.MOSDPGPush(
-                    pgid=st.pgid, oid=oid, data=data, version=version))
+                    pgid=st.pgid, oid=oid, op="delete",
+                    version=entry.version[1], entry=entry))
+                self.perf.inc("osd_pushes_sent")
             except ConnectionError:
                 pass
+            return
+        if pool.is_erasure():
+            await self._recover_ec_object(pool, st, oid, targets=[osd],
+                                          entry=entry)
+            return
+        coll = _coll(st.pgid)
+        if self.store.stat(coll, oid) is None:
+            return
+        data = self.store.read(coll, oid)
+        try:
+            await self._send_osd(osd, M.MOSDPGPush(
+                pgid=st.pgid, oid=oid, data=data,
+                version=entry.version[1], entry=entry))
+            self.perf.inc("osd_pushes_sent")
+        except ConnectionError:
+            pass
 
-    async def _recover_ec_object(self, pool: PGPool, st: PGState,
-                                 oid: str) -> None:
-        """Reconstruct and re-distribute shards (batched TPU decode + encode,
-        ECBackend::run_recovery_op analog)."""
+    async def _backfill_member(self, pool: PGPool, st: PGState, osd: int,
+                               inventory: Dict[str, int]) -> None:
+        """Full-inventory resync for a member behind the log tail
+        (reference Backfilling state)."""
+        for oid in self._list_pg_objects(st.pgid):
+            ver = self.store.get_version(_coll(st.pgid), oid)
+            if inventory.get(oid, -1) >= ver:
+                continue
+            if pool.is_erasure():
+                await self._recover_ec_object(pool, st, oid, targets=[osd])
+            else:
+                data = self.store.read(_coll(st.pgid), oid)
+                try:
+                    await self._send_osd(osd, M.MOSDPGPush(
+                        pgid=st.pgid, oid=oid, data=data, version=ver))
+                    self.perf.inc("osd_pushes_sent")
+                except ConnectionError:
+                    pass
+        # stale objects the member has but we (authoritative) don't
+        mine = set(self._list_pg_objects(st.pgid))
+        for oid in inventory:
+            if oid not in mine:
+                try:
+                    await self._send_osd(osd, M.MOSDPGPush(
+                        pgid=st.pgid, oid=oid, op="delete",
+                        version=st.last_update[1]))
+                    self.perf.inc("osd_pushes_sent")
+                except ConnectionError:
+                    pass
+        # hand the member our log state so the next peering round sees it
+        # as current instead of re-backfilling
+        blob = pickle.dumps((st.last_update, st.log))
+        try:
+            await self._send_osd(osd, M.MOSDPGPush(
+                pgid=st.pgid, op="log_sync", data=blob))
+        except ConnectionError:
+            pass
+
+    async def _recover_ec_object(self, pool: PGPool, st: PGState, oid: str,
+                                 targets: Optional[List[int]] = None,
+                                 entry: Optional[LogEntry] = None) -> bool:
+        """Reconstruct shards for the target members (batched TPU decode +
+        encode, ECBackend::run_recovery_op analog).  targets=None rebuilds
+        every acting member's shard.  Returns False when the object is
+        currently unrecoverable (fewer than k shard sources)."""
         from ceph_tpu.ec import stripe as stripemod
         import numpy as np
 
@@ -775,7 +1059,7 @@ class OSDDaemon(Dispatcher):
                  for s, d in shards.items() if len(d) == shard_len}
         if len(avail) < k:
             self.perf.inc("osd_unrecoverable")
-            return
+            return False
         data = await self._compute(
             stripemod.decode_stripes, codec, sinfo, avail, size)
         chunks = await self._compute(
@@ -784,6 +1068,8 @@ class OSDDaemon(Dispatcher):
         hinfo = {"size": size, "version": version}
         for shard, osd in enumerate(st.acting):
             if osd == CRUSH_ITEM_NONE:
+                continue
+            if targets is not None and osd not in targets:
                 continue
             blob = chunks[shard].tobytes()
             if osd == self.osd_id:
@@ -794,23 +1080,43 @@ class OSDDaemon(Dispatcher):
                     await self._send_osd(osd, M.MOSDECSubOpWrite(
                         reqid=self._next_reqid(), pgid=st.pgid, oid=oid,
                         shard=shard, data=blob, chunk_off=0,
-                        shard_size=shard_len, hinfo=hinfo,
+                        shard_size=shard_len, hinfo=hinfo, entry=entry,
                         epoch=self.osdmap.epoch))
+                    self.perf.inc("osd_pushes_sent")
                 except ConnectionError:
                     pass
+        return True
 
     def _handle_push(self, msg: M.MOSDPGPush) -> None:
         coll = _coll(msg.pgid)
-        cur = self.store.get_version(coll, msg.oid)
-        if self.store.stat(coll, msg.oid) is not None and cur >= msg.version:
+        st = self.pgs.get(msg.pgid)
+        if msg.op == "log_sync":
+            if st is not None:
+                st.last_update, st.log = pickle.loads(msg.data)
+                self._save_pg_meta(st)
             return
-        txn = (Transaction()
-               .remove(coll, msg.oid)
-               .write(coll, msg.oid, 0, msg.data)
-               .set_version(coll, msg.oid, msg.version))
-        for k, v in msg.xattrs.items():
-            txn.setattr(coll, msg.oid, k, v)
-        self.store.queue_transaction(txn)
+        if msg.op == "delete":
+            # version-guarded like pushes: a stale delete (old primary's
+            # backfill racing a newer primary's push) must not remove a
+            # newer object
+            cur = self.store.get_version(coll, msg.oid)
+            if cur <= msg.version:
+                self.store.queue_transaction(
+                    Transaction().remove(coll, msg.oid))
+        else:
+            cur = self.store.get_version(coll, msg.oid)
+            exists = self.store.stat(coll, msg.oid) is not None
+            if not (exists and cur >= msg.version):
+                txn = (Transaction()
+                       .remove(coll, msg.oid)
+                       .write(coll, msg.oid, 0, msg.data)
+                       .set_version(coll, msg.oid, msg.version))
+                for k, v in msg.xattrs.items():
+                    txn.setattr(coll, msg.oid, k, v)
+                self.store.queue_transaction(txn)
+        if st is not None and msg.entry is not None:
+            self._log_mutation(st, msg.entry.op, msg.entry.oid,
+                               msg.entry.version, entry=msg.entry)
         self.perf.inc("osd_pushes_applied")
 
     # ------------------------------------------------------------ heartbeat
@@ -822,6 +1128,13 @@ class OSDDaemon(Dispatcher):
             if m is None:
                 continue
             now = time.monotonic()
+            # beacon to the mon (reference MOSDBeacon): lets the mon mark
+            # us down even when no peer reporters survive
+            try:
+                await self.messenger.send_message(
+                    M.MOSDAlive(osd_id=self.osd_id), self.mon_addr)
+            except (ConnectionError, OSError):
+                pass
             for osd, addr in list(m.osd_addrs.items()):
                 if osd == self.osd_id or not m.osd_up[osd]:
                     continue

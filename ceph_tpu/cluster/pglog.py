@@ -1,0 +1,99 @@
+"""PG log: the per-PG ordered mutation record enabling delta resync.
+
+Behavioral mirror of the reference's pg_log_t / PGLog machinery
+(src/osd/osd_types.h pg_log_entry_t; src/osd/PG.h:1994-2498 peering
+statechart GetInfo/GetLog/GetMissing; doc/dev/osd_internals/pg.rst): every
+mutation appends an (eversion, op, oid) entry to a bounded log; on map
+change the primary elects the authoritative log (max last_update across
+the acting set), and stale members resynchronize by LOG DELTA when their
+last_update lies inside the auth log window — pushing only the objects
+named by the missing entries — falling back to full-inventory BACKFILL
+when they have fallen behind the log tail.
+
+eversion = (epoch, seq): the map epoch when the op was performed plus a
+per-PG monotonically increasing sequence (reference eversion_t).  seq
+never resets, so versions totally order all mutations of a PG.
+
+TPU-angle: none — this is pure control-plane state; the data it moves is
+reconstructed by the batched device decode/encode paths in the OSD.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+Eversion = Tuple[int, int]
+ZERO: Eversion = (0, 0)
+
+
+@dataclass
+class LogEntry:
+    """pg_log_entry_t analog."""
+
+    op: str                       # "modify" | "delete"
+    oid: str
+    version: Eversion
+    prior_version: Eversion = ZERO
+
+
+@dataclass
+class PGLog:
+    """Bounded ordered entry list covering versions (tail, head]."""
+
+    tail: Eversion = ZERO
+    entries: List[LogEntry] = field(default_factory=list)
+    max_entries: int = 500
+
+    @property
+    def head(self) -> Eversion:
+        return self.entries[-1].version if self.entries else self.tail
+
+    def append(self, entry: LogEntry) -> None:
+        assert entry.version > self.head, (entry.version, self.head)
+        self.entries.append(entry)
+
+    def trim(self) -> List[LogEntry]:
+        """Drop oldest entries beyond max_entries, advancing the tail;
+        returns the dropped entries (reference PGLog::trim to
+        osd_min/max_pg_log_entries)."""
+        excess = len(self.entries) - self.max_entries
+        if excess <= 0:
+            return []
+        dropped = self.entries[:excess]
+        self.tail = self.entries[excess - 1].version
+        del self.entries[:excess]
+        return dropped
+
+    def since(self, v: Eversion) -> Optional[List[LogEntry]]:
+        """Entries strictly newer than v, or None when v is before the
+        tail (out of the log window -> caller must backfill)."""
+        if v < self.tail:
+            return None
+        return [e for e in self.entries if e.version > v]
+
+    def objects_to_sync(self, v: Eversion) -> Optional[Dict[str, LogEntry]]:
+        """Collapse the delta since v to one final LogEntry per object
+        (the last write wins; a trailing delete means remove)."""
+        delta = self.since(v)
+        if delta is None:
+            return None
+        out: Dict[str, LogEntry] = {}
+        for e in delta:
+            out[e.oid] = e
+        return out
+
+
+@dataclass
+class PGInfo:
+    """pg_info_t analog: what peers exchange during peering."""
+
+    last_update: Eversion = ZERO
+    log_tail: Eversion = ZERO
+
+
+def choose_authoritative(infos: Dict[int, PGInfo]) -> int:
+    """The member with the newest last_update owns the authoritative log
+    (reference PG::choose_acting / find_best_info: max last_update, ties
+    broken by lowest osd id for determinism)."""
+    return min(infos, key=lambda o: (tuple(-x for x in infos[o].last_update), o))

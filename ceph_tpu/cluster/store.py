@@ -57,6 +57,10 @@ class Transaction:
         self.ops.append(("omap_set", coll, oid, dict(kv)))
         return self
 
+    def omap_rmkeys(self, coll: str, oid: str, keys: List[str]):
+        self.ops.append(("omap_rmkeys", coll, oid, list(keys)))
+        return self
+
     def touch(self, coll: str, oid: str):
         self.ops.append(("touch", coll, oid))
         return self
@@ -135,6 +139,12 @@ class MemStore(ObjectStore):
         elif kind == "omap_set":
             _, coll, oid, kv = op
             self._coll(coll).setdefault(oid, Obj()).omap.update(kv)
+        elif kind == "omap_rmkeys":
+            _, coll, oid, keys = op
+            o = self._coll(coll).get(oid)
+            if o is not None:
+                for k in keys:
+                    o.omap.pop(k, None)
         elif kind == "set_version":
             _, coll, oid, version = op
             self._coll(coll).setdefault(oid, Obj()).version = version
@@ -170,6 +180,11 @@ class MemStore(ObjectStore):
         with self._lock:
             o = self._colls.get(coll, {}).get(oid)
             return None if o is None else o.xattrs.get(name)
+
+    def omap_get(self, coll: str, oid: str) -> Dict[str, bytes]:
+        with self._lock:
+            o = self._colls.get(coll, {}).get(oid)
+            return {} if o is None else dict(o.omap)
 
     def list_objects(self, coll: str) -> List[str]:
         with self._lock:

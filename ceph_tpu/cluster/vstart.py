@@ -52,6 +52,19 @@ class Cluster:
         self.osds[osd_id] = osd
         return osd
 
+    async def restart_osd(self, osd_id: int) -> OSDDaemon:
+        """Stop + start an OSD KEEPING its object store (daemon restart:
+        the persisted pg log lets peering delta-resync instead of
+        backfilling, reference OSD.cc:2556 superblock resume)."""
+        old = self.osds.pop(osd_id)
+        store = old.store
+        await old.stop()
+        osd = OSDDaemon(osd_id, self.mon_addr, config=self.config,
+                        store=store)
+        await osd.start()
+        self.osds[osd_id] = osd
+        return osd
+
     async def wait_for_epoch(self, epoch: int, timeout: float = 10.0) -> None:
         deadline = asyncio.get_event_loop().time() + timeout
         while asyncio.get_event_loop().time() < deadline:
@@ -85,14 +98,20 @@ def _fast_config() -> Config:
         mon_tick_interval=0.1,
         mon_osd_down_out_interval=2.0,
         mon_osd_min_down_reporters=1,
+        mon_osd_beacon_grace=1.5,
         osd_recovery_delay_start=0.05,
         osd_client_op_timeout=5.0,
     )
 
 
 async def start_cluster(n_osds: int = 3, osds_per_host: int = 1,
-                        config: Optional[Config] = None) -> Cluster:
-    """Boot mon + OSDs and wait for all of them to appear up in the map."""
+                        config: Optional[Config] = None,
+                        store_factory=None) -> Cluster:
+    """Boot mon + OSDs and wait for all of them to appear up in the map.
+
+    ``store_factory(osd_id) -> ObjectStore`` selects the backing store
+    (default MemStore; pass a FileStore factory for a durable cluster —
+    the vstart.sh --bluestore/--filestore switch analog)."""
     config = config or _fast_config()
     n_hosts = (n_osds + osds_per_host - 1) // osds_per_host
     cmap, _ = build_hierarchy(n_hosts, osds_per_host, numrep=3)
@@ -104,7 +123,8 @@ async def start_cluster(n_osds: int = 3, osds_per_host: int = 1,
     mon_addr = await mon.start()
     cluster = Cluster(mon=mon, osds={}, config=config, mon_addr=mon_addr)
     for o in range(n_osds):
-        osd = OSDDaemon(o, mon_addr, config=config)
+        osd = OSDDaemon(o, mon_addr, config=config,
+                        store=store_factory(o) if store_factory else None)
         await osd.start()
         cluster.osds[o] = osd
     deadline = asyncio.get_event_loop().time() + 10

@@ -36,6 +36,8 @@ OPTIONS: List[Option] = [
     Option("osd_pool_default_pg_num", int, 32, min=1),
     Option("osd_recovery_delay_start", float, 0.0),
     Option("osd_client_op_timeout", float, 10.0),
+    Option("rados_osd_op_timeout", float, 30.0,
+           "client-side total op budget incl. resends"),
     Option("osd_map_cache_size", int, 50),
     Option("osd_map_batch_min_pgs", int, 256,
            "pools with at least this many PGs use batched placement"),
@@ -43,6 +45,9 @@ OPTIONS: List[Option] = [
     Option("mon_osd_down_out_interval", float, 30.0,
            "auto-out after down this long"),
     Option("mon_osd_min_down_reporters", int, 1),
+    Option("mon_osd_beacon_grace", float, 6.0,
+           "mark an osd down when its beacons go stale this long "
+           "(reference osd_beacon_report_interval + mon grace)"),
     Option("mon_tick_interval", float, 0.5),
     # ec
     Option("osd_ec_batch_size", int, 64, "stripes per device dispatch"),

@@ -27,6 +27,10 @@ class PerfCounters:
         with self._lock:
             self._counters[name] = value
 
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
     def tinc(self, name: str, seconds: float) -> None:
         """Time/average counter (avgcount + sum, like PERFCOUNTER_TIME)."""
         with self._lock:

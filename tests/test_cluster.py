@@ -14,6 +14,7 @@ import asyncio
 
 import pytest
 
+from ceph_tpu.cluster.osd import OSDDaemon
 from ceph_tpu.cluster.vstart import start_cluster
 
 
@@ -357,6 +358,137 @@ def test_map_distribution_is_incremental():
             # clients converge on the same epoch as the mon
             await client.objecter._refresh_map()
             assert client.objecter.osdmap.epoch == cluster.mon.osdmap.epoch
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_delta_recovery_counts():
+    async def scenario():
+        from ceph_tpu.cluster.vstart import _fast_config
+
+        cfg = _fast_config()
+        cfg.mon_osd_down_out_interval = 60.0
+        cluster = await start_cluster(4, config=cfg)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("repl", "replicated",
+                                            pg_num=8, size=3)
+            io = client.ioctx(pool)
+            total = 24
+            for i in range(total):
+                await io.write_full(f"obj{i}", f"payload-{i}".encode() * 50)
+
+            target = 1
+            # stop the daemon but KEEP its store for the restart
+            stopped = cluster.osds.pop(target)
+            store = stopped.store
+            await stopped.stop()
+            await cluster.wait_down(target)
+
+            delta = {f"new{i}": f"delta-{i}".encode() * 80 for i in range(3)}
+            for oid, data in delta.items():
+                await io.write_full(oid, data)
+            await io.write_full("obj0", b"obj0-rewritten" * 40)
+
+            before = sum(o.perf.get("osd_pushes_sent") or 0
+                         for o in cluster.osds.values())
+            osd = OSDDaemon(target, cluster.mon_addr, config=cfg, store=store)
+            await osd.start()
+            cluster.osds[target] = osd
+            # wait for the mon to mark it up + peers to recover it
+            deadline = asyncio.get_event_loop().time() + 15
+            while asyncio.get_event_loop().time() < deadline:
+                if cluster.mon.osdmap.osd_up[target]:
+                    break
+                await asyncio.sleep(0.05)
+            await asyncio.sleep(1.5)  # recovery window
+
+            after = sum(o.perf.get("osd_pushes_sent") or 0
+                        for o in cluster.osds.values() if o is not osd)
+            pushes = after - before
+            changed = len(delta) + 1  # new0..2 + obj0 rewrite
+            # delta resync: push count tracks the CHANGED objects, far
+            # below the total object count
+            assert 0 < pushes <= changed * 3, (pushes, changed)
+            assert pushes < total, (pushes, total)
+
+            # and the rejoined member must hold the delta bytes
+            for oid, data in delta.items():
+                pgid = client.objecter.object_pgid(pool, oid)
+                coll = f"pg_{pgid.pool}_{pgid.seed}"
+                _, _, acting, _ = \
+                    client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+                if target in acting:
+                    assert osd.store.read(coll, oid) == data, oid
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_concurrent_writes_during_restart_converge():
+    """Concurrent writers + a member bounce: every acting replica ends
+    byte-identical (per-PG ordering + log-delta resync)."""
+    async def scenario():
+        from ceph_tpu.cluster.vstart import _fast_config
+
+        cfg = _fast_config()
+        cfg.mon_osd_down_out_interval = 60.0
+        cluster = await start_cluster(4, config=cfg)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("repl", "replicated",
+                                            pg_num=8, size=3)
+            io = client.ioctx(pool)
+            stop_evt = asyncio.Event()
+
+            async def writer(tag):
+                i = 0
+                while not stop_evt.is_set():
+                    for oid in ("shared-a", "shared-b"):
+                        try:
+                            await io.write_full(
+                                oid, f"{tag}-{i}-".encode() * 100)
+                        except Exception:
+                            pass
+                    i += 1
+                    await asyncio.sleep(0.01)
+
+            writers = [asyncio.get_event_loop().create_task(writer(t))
+                       for t in ("w1", "w2")]
+            await asyncio.sleep(0.3)
+            target = 2
+            stopped = cluster.osds.pop(target)
+            store = stopped.store
+            await stopped.stop()
+            await cluster.wait_down(target)
+            await asyncio.sleep(0.5)
+            osd = OSDDaemon(target, cluster.mon_addr, config=cfg, store=store)
+            await osd.start()
+            cluster.osds[target] = osd
+            deadline = asyncio.get_event_loop().time() + 15
+            while asyncio.get_event_loop().time() < deadline:
+                if cluster.mon.osdmap.osd_up[target]:
+                    break
+                await asyncio.sleep(0.05)
+            await asyncio.sleep(0.5)
+            stop_evt.set()
+            await asyncio.gather(*writers)
+            await asyncio.sleep(1.5)  # recovery window
+
+            # every acting replica byte-identical for both objects
+            for oid in ("shared-a", "shared-b"):
+                pgid = client.objecter.object_pgid(pool, oid)
+                coll = f"pg_{pgid.pool}_{pgid.seed}"
+                _, _, acting, _ = \
+                    client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+                blobs = {}
+                for o in acting:
+                    blobs[o] = bytes(cluster.osds[o].store.read(coll, oid))
+                vals = set(blobs.values())
+                assert len(vals) == 1, (oid, {k: v[:20] for k, v in blobs.items()})
         finally:
             await cluster.stop()
 
