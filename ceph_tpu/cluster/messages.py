@@ -71,6 +71,37 @@ class MMonCommandReply(Message):
     data: Any = None
 
 
+# -- mon <-> mon (election + paxos) ----------------------------------------
+
+
+@dataclass
+class MMonElection(Message):
+    """Election protocol (reference src/mon/Elector.cc MMonElection):
+    op in {"propose", "ack", "victory"}."""
+
+    op: str = "propose"
+    epoch: int = 0
+    rank: int = -1
+    quorum: List[int] = field(default_factory=list)
+
+
+@dataclass
+class MMonPaxos(Message):
+    """Paxos phases (reference src/mon/Paxos.cc and MMonPaxos):
+    op in {"collect", "last", "begin", "accept", "commit", "lease"}."""
+
+    op: str = "collect"
+    pn: int = 0
+    rank: int = -1
+    last_committed: int = 0
+    version: int = 0           # version being proposed / committed
+    value: bytes = b""         # pickled payload
+    uncommitted_pn: int = 0
+    uncommitted_version: int = 0
+    uncommitted_value: bytes = b""
+    catch_up: List[Tuple[int, bytes]] = field(default_factory=list)
+
+
 # -- client <-> osd ---------------------------------------------------------
 
 

@@ -38,8 +38,9 @@ from ceph_tpu.utils import Config, PerfCounters
 
 class Monitor(Dispatcher):
     def __init__(self, osdmap: OSDMap, config: Optional[Config] = None,
-                 rank: int = 0):
+                 rank: int = 0, n_mons: int = 1):
         self.rank = rank
+        self.n_mons = n_mons
         self.config = config or Config()
         self.osdmap = osdmap
         self.messenger = Messenger(EntityName("mon", rank))
@@ -53,24 +54,125 @@ class Monitor(Dispatcher):
         self.last_beacon: Dict[int, float] = {}
         self.perf = PerfCounters("mon")
         self._tick_task: Optional[asyncio.Task] = None
-        self._log: List[Tuple[str, object]] = []  # proposal log (Paxos seam)
-        self._next_pool_id = max(self.osdmap.pools, default=0) + 1
+        self._log: List[Tuple[str, object]] = []  # committed proposal log
         # recent incrementals by resulting epoch (reference: mon keeps a
         # window of full+inc maps; subscribers behind the window get a full
         # map).  Size mirrors osd_map_cache_size.
         self._inc_log: Dict[int, Incremental] = {}
+        # -- quorum state (multi-mon) --
+        self.mon_addrs: List[Addr] = []
+        self.elector = None
+        self.paxos = None
+        self.is_leader = n_mons == 1
+        self.leader_rank: Optional[int] = 0 if n_mons == 1 else None
+        self._map_mutex = asyncio.Lock()
+        self._lease_task: Optional[asyncio.Task] = None
+        self._last_lease = 0.0
+        self._fwd: Dict[int, Tuple[Connection, int]] = {}
+        self._fwd_tid = 0
+        self.stopped = False
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> Addr:
         addr = await self.messenger.bind(host, port)
-        self._tick_task = asyncio.get_event_loop().create_task(self._tick())
+        if self.n_mons == 1:
+            self._tick_task = asyncio.get_event_loop().create_task(
+                self._tick())
         return addr
 
+    def set_monmap(self, addrs: List[Addr]) -> None:
+        """Install the monmap + consensus machinery (multi-mon vstart
+        calls this once every monitor is bound)."""
+        from ceph_tpu.cluster.paxos import Elector, Paxos
+
+        self.mon_addrs = [tuple(a) for a in addrs]
+        self.is_leader = False
+        self.leader_rank = None
+        self.elector = Elector(
+            self.rank, self.n_mons, self._send_mon, self._on_elected,
+            timeout=self.config.mon_election_timeout)
+        self.paxos = Paxos(
+            self.rank, self.n_mons, self._send_mon, self._apply_committed,
+            timeout=self.config.mon_paxos_timeout)
+
+    async def begin_elections(self) -> None:
+        if self.elector:
+            await self.elector.start_election()
+
     async def stop(self) -> None:
-        if self._tick_task:
-            self._tick_task.cancel()
+        self.is_leader = False
+        self.stopped = True
+        if self.elector:
+            self.elector.stop()
+        if self.paxos:
+            self.paxos.step_down()
+        for t in (self._tick_task, self._lease_task):
+            if t:
+                t.cancel()
         await self.messenger.shutdown()
 
-    # -- proposal log (single-authority; Paxos slots in here) --------------
+    # -- quorum plumbing ---------------------------------------------------
+
+    async def _send_mon(self, rank: int, msg) -> None:
+        await self.messenger.send_message(msg, self.mon_addrs[rank])
+
+    async def _on_elected(self, leader: int, quorum: List[int],
+                          epoch: int) -> None:
+        self.leader_rank = leader
+        was_leader = self.is_leader
+        self.is_leader = leader == self.rank
+        self.perf.inc("mon_elections_won" if self.is_leader
+                      else "mon_elections_lost")
+        if self.is_leader:
+            await self.paxos.leader_init(quorum)
+            if self._tick_task is None or self._tick_task.done():
+                self._tick_task = asyncio.get_event_loop().create_task(
+                    self._tick())
+            if self._lease_task is None or self._lease_task.done():
+                self._lease_task = asyncio.get_event_loop().create_task(
+                    self._lease_loop())
+        else:
+            if self.paxos:
+                self.paxos.step_down()
+            if was_leader and self._tick_task:
+                self._tick_task.cancel()
+                self._tick_task = None
+            self._last_lease = time.monotonic()
+            if self._lease_task is None or self._lease_task.done():
+                self._lease_task = asyncio.get_event_loop().create_task(
+                    self._lease_watch())
+
+    async def _lease_loop(self) -> None:
+        """Leader: extend the quorum lease (reference Paxos lease)."""
+        while self.is_leader:
+            for r in range(self.n_mons):
+                if r != self.rank:
+                    try:
+                        await self._send_mon(r, M.MMonPaxos(
+                            op="lease", rank=self.rank,
+                            last_committed=self.paxos.last_committed))
+                    except (ConnectionError, OSError):
+                        pass
+            await asyncio.sleep(self.config.mon_lease_interval)
+
+    async def _lease_watch(self) -> None:
+        """Peon: call an election when the leader's lease goes stale."""
+        while not self.is_leader and self.elector is not None:
+            await asyncio.sleep(self.config.mon_lease_interval)
+            if self.is_leader:
+                return
+            stale = time.monotonic() - self._last_lease
+            if stale > self.config.mon_lease_ack_timeout:
+                self.perf.inc("mon_lease_timeouts")
+                await self.elector.start_election()
+                self._last_lease = time.monotonic()
+
+    async def _apply_committed(self, version: int, value: bytes) -> None:
+        """Paxos apply callback: every quorum member applies committed
+        map deltas in order (the PaxosService refresh)."""
+        inc = pickle.loads(value)
+        await self._apply_inc_local(inc)
+
+    # -- proposal/commit ---------------------------------------------------
 
     def _propose(self, what: str, payload) -> None:
         self._log.append((what, payload))
@@ -79,8 +181,16 @@ class Monitor(Dispatcher):
     def _new_inc(self) -> Incremental:
         return Incremental(epoch=self.osdmap.epoch + 1)
 
-    async def _commit_inc(self, inc: Incremental) -> None:
-        """Apply a delta to the authoritative map, log it, broadcast it."""
+    async def _commit_inc(self, inc: Incremental) -> bool:
+        """Commit a map delta: direct in single-mon mode, through a Paxos
+        round (begin/accept/commit on the quorum) otherwise."""
+        if self.paxos is None:
+            await self._apply_inc_local(inc)
+            return True
+        return await self.paxos.propose(pickle.dumps(inc))
+
+    async def _apply_inc_local(self, inc: Incremental) -> None:
+        """Apply a delta to the replicated map, log it, broadcast it."""
         self.osdmap.apply_incremental(inc)
         self._inc_log[inc.epoch] = inc
         cutoff = inc.epoch - self.config.osd_map_cache_size
@@ -92,14 +202,32 @@ class Monitor(Dispatcher):
     # -- dispatch ----------------------------------------------------------
 
     async def ms_dispatch(self, conn: Connection, msg) -> bool:
-        if isinstance(msg, M.MOSDBoot):
-            await self._handle_boot(msg)
+        if isinstance(msg, M.MMonElection):
+            if self.elector:
+                await self.elector.handle(msg)
             return True
-        if isinstance(msg, M.MOSDFailure):
-            await self._handle_failure(msg)
+        if isinstance(msg, M.MMonPaxos):
+            if msg.op == "lease":
+                self._last_lease = time.monotonic()
+                self.leader_rank = msg.rank
+            elif self.paxos:
+                await self.paxos.handle(msg)
             return True
-        if isinstance(msg, M.MOSDAlive):
-            if 0 <= msg.osd_id < self.osdmap.max_osd:
+        if isinstance(msg, (M.MOSDBoot, M.MOSDFailure, M.MOSDAlive)):
+            if not self.is_leader:
+                # peon: relay to the leader (reference forward_request)
+                if self.leader_rank is not None and \
+                        self.leader_rank != self.rank:
+                    try:
+                        await self._send_mon(self.leader_rank, msg)
+                    except (ConnectionError, OSError):
+                        pass
+                return True
+            if isinstance(msg, M.MOSDBoot):
+                await self._handle_boot(msg)
+            elif isinstance(msg, M.MOSDFailure):
+                await self._handle_failure(msg)
+            elif 0 <= msg.osd_id < self.osdmap.max_osd:
                 self.last_beacon[msg.osd_id] = time.monotonic()
             return True
         if isinstance(msg, M.MMonSubscribe):
@@ -109,20 +237,31 @@ class Monitor(Dispatcher):
         if isinstance(msg, M.MMonCommand):
             await self._handle_command(conn, msg)
             return True
+        if isinstance(msg, M.MMonCommandReply):
+            # reply for a command we forwarded to the leader: relay it
+            entry = self._fwd.pop(msg.tid, None)
+            if entry is not None:
+                client_conn, client_tid = entry
+                try:
+                    await client_conn.send(M.MMonCommandReply(
+                        tid=client_tid, result=msg.result, data=msg.data))
+                except (ConnectionError, OSError):
+                    pass
+            return True
         return False
 
     async def _handle_boot(self, msg: M.MOSDBoot) -> None:
         self._propose("boot", (msg.osd_id, msg.addr))
-        m = self.osdmap
-        if msg.osd_id >= m.max_osd:
+        if msg.osd_id >= self.osdmap.max_osd:
             return
-        inc = self._new_inc()
-        inc.new_up[msg.osd_id] = tuple(msg.addr)
-        self.down_since.pop(msg.osd_id, None)
-        self.failure_reports.pop(msg.osd_id, None)
-        self.last_beacon[msg.osd_id] = time.monotonic()
-        self.perf.inc("mon_osd_boot")
-        await self._commit_inc(inc)
+        async with self._map_mutex:
+            inc = self._new_inc()
+            inc.new_up[msg.osd_id] = tuple(msg.addr)
+            self.down_since.pop(msg.osd_id, None)
+            self.failure_reports.pop(msg.osd_id, None)
+            self.last_beacon[msg.osd_id] = time.monotonic()
+            self.perf.inc("mon_osd_boot")
+            await self._commit_inc(inc)
 
     async def _handle_failure(self, msg: M.MOSDFailure) -> None:
         m = self.osdmap
@@ -134,29 +273,60 @@ class Monitor(Dispatcher):
         # can_mark_down analog: enough distinct reporters
         if len(reporters) >= self.config.mon_osd_min_down_reporters:
             self._propose("down", osd)
-            inc = self._new_inc()
-            inc.new_down.append(osd)
-            self.down_since[osd] = time.monotonic()
-            self.failure_reports.pop(osd, None)
-            self.perf.inc("mon_osd_marked_down")
-            await self._commit_inc(inc)
+            async with self._map_mutex:
+                if not self.osdmap.osd_up[osd]:
+                    return
+                inc = self._new_inc()
+                inc.new_down.append(osd)
+                self.down_since[osd] = time.monotonic()
+                self.failure_reports.pop(osd, None)
+                self.perf.inc("mon_osd_marked_down")
+                await self._commit_inc(inc)
 
     async def _handle_command(self, conn: Connection, msg: M.MMonCommand) -> None:
         cmd = msg.cmd
         result, data = 0, None
+        prefix = cmd.get("prefix")
+        mutating = prefix in ("osd pool create", "osd out", "osd in")
+        if mutating and not self.is_leader:
+            # forward to the leader, relay its reply (reference
+            # Monitor::forward_request_leader)
+            if self.leader_rank is None or self.leader_rank == self.rank:
+                await conn.send(M.MMonCommandReply(
+                    tid=msg.tid, result=-11, data="no leader"))
+                return
+            self._fwd_tid += 1
+            self._fwd[self._fwd_tid] = (conn, msg.tid)
+            await self._send_mon(self.leader_rank, M.MMonCommand(
+                cmd=cmd, tid=self._fwd_tid))
+            self.perf.inc("mon_commands_forwarded")
+            return
         try:
-            prefix = cmd.get("prefix")
             if prefix == "osd pool create":
-                data, inc = self._create_pool(cmd)
-                await self._commit_inc(inc)
+                # idempotent by name: a retried create (client failed over
+                # mid-commit) returns the existing pool
+                existing = next(
+                    (pid for pid, p in self.osdmap.pools.items()
+                     if p.name == cmd["pool"]), None)
+                if existing is not None:
+                    data = existing
+                else:
+                    async with self._map_mutex:
+                        data, inc = self._create_pool(cmd)
+                        if not await self._commit_inc(inc):
+                            result, data = -11, "quorum lost"
             elif prefix == "osd out":
-                inc = self._new_inc()
-                inc.new_weights[int(cmd["id"])] = 0
-                await self._commit_inc(inc)
+                async with self._map_mutex:
+                    inc = self._new_inc()
+                    inc.new_weights[int(cmd["id"])] = 0
+                    if not await self._commit_inc(inc):
+                        result, data = -11, "quorum lost"
             elif prefix == "osd in":
-                inc = self._new_inc()
-                inc.new_weights[int(cmd["id"])] = 0x10000
-                await self._commit_inc(inc)
+                async with self._map_mutex:
+                    inc = self._new_inc()
+                    inc.new_weights[int(cmd["id"])] = 0x10000
+                    if not await self._commit_inc(inc):
+                        result, data = -11, "quorum lost"
             elif prefix == "status":
                 m = self.osdmap
                 data = {
@@ -220,8 +390,9 @@ class Monitor(Dispatcher):
                 (RULE_CHOOSELEAF_FIRSTN, size, 1),
                 (RULE_EMIT, 0, 0)])
         pg_num = int(cmd.get("pg_num", self.config.osd_pool_default_pg_num))
-        pool_id = self._next_pool_id
-        self._next_pool_id += 1
+        # derive from the REPLICATED map, not local state: a failed-over
+        # leader must never reuse an id committed by its predecessor
+        pool_id = max(self.osdmap.pools, default=0) + 1
         inc = self._new_inc()
         inc.new_rules.append(rule)
         inc.new_pools[pool_id] = PGPool(
@@ -271,18 +442,19 @@ class Monitor(Dispatcher):
         while True:
             await asyncio.sleep(self.config.mon_tick_interval)
             now = time.monotonic()
-            inc = self._new_inc()
-            for osd, since in list(self.down_since.items()):
-                if now - since > self.config.mon_osd_down_out_interval and \
-                        self.osdmap.osd_weight[osd] > 0:
-                    inc.new_weights[osd] = 0
-                    self.down_since.pop(osd)
-            for osd, last in list(self.last_beacon.items()):
-                if self.osdmap.osd_up[osd] and \
-                        now - last > self.config.mon_osd_beacon_grace:
-                    inc.new_down.append(osd)
-                    self.down_since[osd] = now
-                    self.last_beacon.pop(osd)
-                    self.perf.inc("mon_osd_marked_down")
-            if inc.new_weights or inc.new_down:
-                await self._commit_inc(inc)
+            async with self._map_mutex:
+                inc = self._new_inc()
+                for osd, since in list(self.down_since.items()):
+                    if now - since > self.config.mon_osd_down_out_interval \
+                            and self.osdmap.osd_weight[osd] > 0:
+                        inc.new_weights[osd] = 0
+                        self.down_since.pop(osd)
+                for osd, last in list(self.last_beacon.items()):
+                    if self.osdmap.osd_up[osd] and \
+                            now - last > self.config.mon_osd_beacon_grace:
+                        inc.new_down.append(osd)
+                        self.down_since[osd] = now
+                        self.last_beacon.pop(osd)
+                        self.perf.inc("mon_osd_marked_down")
+                if inc.new_weights or inc.new_down:
+                    await self._commit_inc(inc)

@@ -1,0 +1,65 @@
+"""MonClient targeting: monmap normalization + hunting failover.
+
+The single implementation of the reference MonClient's session-hunting
+behavior (src/mon/MonClient.cc _reopen_session: try the next monitor when
+the current one stops answering), shared by the OSD daemon and the
+client-side Objecter so their failover semantics cannot drift: on every
+hunt the new monitor immediately receives a map subscription, keeping the
+caller in its subscriber set.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+from ceph_tpu.cluster import messages as M
+
+Addr = Tuple[str, int]
+
+
+class MonTargeter:
+    def __init__(self, messenger, mon_addr,
+                 subscribe_since: Optional[Callable[[], int]] = None):
+        """``mon_addr``: one (host, port) or a list of them (the monmap).
+        ``subscribe_since``: epoch callback used to re-subscribe on the
+        newly-hunted monitor (None disables re-subscription)."""
+        self.messenger = messenger
+        if mon_addr and isinstance(mon_addr[0], (list, tuple)):
+            self.addrs: List[Addr] = [tuple(a) for a in mon_addr]
+        else:
+            self.addrs = [tuple(mon_addr)]
+        self._i = 0
+        self.subscribe_since = subscribe_since
+
+    @property
+    def current(self) -> Addr:
+        return self.addrs[self._i]
+
+    def hunt(self) -> None:
+        self._i = (self._i + 1) % len(self.addrs)
+
+    async def send(self, msg, raise_on_fail: bool = False) -> bool:
+        """Send to the current monitor, hunting across the monmap on
+        connection failure."""
+        last: Optional[Exception] = None
+        for _ in range(len(self.addrs)):
+            try:
+                await self.messenger.send_message(msg, self.current)
+                return True
+            except (ConnectionError, OSError) as e:
+                last = e
+                self.hunt()
+                if len(self.addrs) > 1 and \
+                        self.subscribe_since is not None:
+                    try:
+                        await self.messenger.send_message(
+                            M.MMonSubscribe(
+                                what="osdmap",
+                                addr=self.messenger.my_addr,
+                                since=self.subscribe_since()),
+                            self.current)
+                    except (ConnectionError, OSError):
+                        continue
+        if raise_on_fail:
+            raise last or ConnectionError("no monitor reachable")
+        return False

@@ -28,13 +28,17 @@ from ceph_tpu.utils import Config
 
 
 class Objecter(Dispatcher):
-    def __init__(self, name: str, mon_addr: Addr,
+    def __init__(self, name: str, mon_addr,
                  config: Optional[Config] = None):
         self.client_name = name
-        self.mon_addr = tuple(mon_addr)
         self.config = config or Config()
         self.messenger = Messenger(EntityName("client", abs(hash(name)) % 10000))
         self.messenger.add_dispatcher(self)
+        from ceph_tpu.cluster.monclient import MonTargeter
+
+        self.monc = MonTargeter(
+            self.messenger, mon_addr,
+            subscribe_since=lambda: self.osdmap.epoch if self.osdmap else 0)
         self.osdmap: Optional[OSDMap] = None
         self._map_event = asyncio.Event()
         self._tid = 0
@@ -42,10 +46,19 @@ class Objecter(Dispatcher):
         self._mon_tid = 0
         self._mon_inflight: Dict[int, asyncio.Future] = {}
 
+    @property
+    def mon_addr(self) -> Addr:
+        return self.monc.current
+
+    def _hunt(self) -> None:
+        self.monc.hunt()
+
+    async def _mon_send(self, msg) -> None:
+        await self.monc.send(msg, raise_on_fail=True)
+
     async def start(self) -> None:
         addr = await self.messenger.bind()
-        await self.messenger.send_message(
-            M.MMonSubscribe(what="osdmap", addr=addr), self.mon_addr)
+        await self._mon_send(M.MMonSubscribe(what="osdmap", addr=addr))
         await asyncio.wait_for(self._map_event.wait(), timeout=10)
 
     async def stop(self) -> None:
@@ -68,11 +81,10 @@ class Objecter(Dispatcher):
                 self._map_event.set()  # already current
             else:
                 # gap: resync from our epoch
-                await self.messenger.send_message(
+                await self._mon_send(
                     M.MMonSubscribe(what="osdmap",
                                     addr=self.messenger.my_addr,
-                                    since=m.epoch if m else 0),
-                    self.mon_addr)
+                                    since=m.epoch if m else 0))
             return True
         if isinstance(msg, M.MOSDOpReply):
             fut = self._inflight.pop(tuple(msg.reqid), None)
@@ -100,11 +112,14 @@ class Objecter(Dispatcher):
 
     async def _refresh_map(self) -> None:
         self._map_event.clear()
-        await self.messenger.send_message(
+        await self._mon_send(
             M.MMonSubscribe(what="osdmap", addr=self.messenger.my_addr,
-                            since=self.osdmap.epoch if self.osdmap else 0),
-            self.mon_addr)
-        await asyncio.wait_for(self._map_event.wait(), timeout=10)
+                            since=self.osdmap.epoch if self.osdmap else 0))
+        try:
+            await asyncio.wait_for(self._map_event.wait(), timeout=10)
+        except asyncio.TimeoutError:
+            self._hunt()
+            raise
 
     # -- op submission with resend-on-map-change ---------------------------
 
@@ -143,16 +158,33 @@ class Objecter(Dispatcher):
                 pass
 
     async def mon_command(self, cmd: Dict[str, Any], timeout: float = 10.0):
-        self._mon_tid += 1
-        tid = self._mon_tid
-        fut = asyncio.get_event_loop().create_future()
-        self._mon_inflight[tid] = fut
-        await self.messenger.send_message(
-            M.MMonCommand(cmd=cmd, tid=tid), self.mon_addr)
-        reply = await asyncio.wait_for(fut, timeout=timeout)
-        if reply.result != 0:
-            raise RuntimeError(f"mon command failed: {reply.data}")
-        return reply.data
+        """Command with failover: retries against the other monitors when
+        the current one dies or has no leader (commands are idempotent at
+        the mon: pool create returns the existing pool on a retry)."""
+        deadline = asyncio.get_event_loop().time() + timeout * 3
+        last_err = None
+        while asyncio.get_event_loop().time() < deadline:
+            self._mon_tid += 1
+            tid = self._mon_tid
+            fut = asyncio.get_event_loop().create_future()
+            self._mon_inflight[tid] = fut
+            try:
+                await self._mon_send(M.MMonCommand(cmd=cmd, tid=tid))
+                reply = await asyncio.wait_for(fut, timeout=timeout)
+            except (asyncio.TimeoutError, ConnectionError, OSError) as e:
+                self._mon_inflight.pop(tid, None)
+                last_err = e
+                self._hunt()
+                await asyncio.sleep(0.2)
+                continue
+            if reply.result == -11:   # no leader yet: retry
+                last_err = RuntimeError(str(reply.data))
+                await asyncio.sleep(0.3)
+                continue
+            if reply.result != 0:
+                raise RuntimeError(f"mon command failed: {reply.data}")
+            return reply.data
+        raise TimeoutError(f"mon command never succeeded: {last_err}")
 
 
 class IoCtx:

@@ -79,15 +79,20 @@ def _coll(pgid: PGid) -> str:
 
 
 class OSDDaemon(Dispatcher):
-    def __init__(self, osd_id: int, mon_addr: Addr,
+    def __init__(self, osd_id: int, mon_addr,
                  config: Optional[Config] = None,
                  store: Optional[ObjectStore] = None):
         self.osd_id = osd_id
-        self.mon_addr = tuple(mon_addr)
         self.config = config or Config()
         self.store = store or MemStore()
         self.messenger = Messenger(EntityName("osd", osd_id))
         self.messenger.add_dispatcher(self)
+        # monmap failover (shared MonClient hunting, cluster/monclient.py)
+        from ceph_tpu.cluster.monclient import MonTargeter
+
+        self.monc = MonTargeter(
+            self.messenger, mon_addr,
+            subscribe_since=lambda: self.osdmap.epoch if self.osdmap else 0)
         self.osdmap: Optional[OSDMap] = None
         self.pgs: Dict[PGid, PGState] = {}
         self.perf = PerfCounters(f"osd.{osd_id}")
@@ -105,11 +110,11 @@ class OSDDaemon(Dispatcher):
         self.store.mount()
         since = self._load_superblock()
         addr = await self.messenger.bind(host, port)
-        await self.messenger.send_message(
-            M.MOSDBoot(osd_id=self.osd_id, addr=addr), self.mon_addr)
-        await self.messenger.send_message(
-            M.MMonSubscribe(what="osdmap", addr=addr, since=since),
-            self.mon_addr)
+        # boot must surface unreachable monitors, not run unregistered
+        await self._mon_send(M.MOSDBoot(osd_id=self.osd_id, addr=addr),
+                             raise_on_fail=True)
+        await self._mon_send(
+            M.MMonSubscribe(what="osdmap", addr=addr, since=since))
         loop = asyncio.get_event_loop()
         self._tasks.append(loop.create_task(self._heartbeat_loop()))
         return addr
@@ -143,6 +148,13 @@ class OSDDaemon(Dispatcher):
     def _next_reqid(self) -> Tuple[str, int]:
         self._tid += 1
         return (f"osd.{self.osd_id}", self._tid)
+
+    @property
+    def mon_addr(self) -> Addr:
+        return self.monc.current
+
+    async def _mon_send(self, msg, raise_on_fail: bool = False) -> bool:
+        return await self.monc.send(msg, raise_on_fail=raise_on_fail)
 
     # --------------------------------------------------------- pg log state
 
@@ -354,9 +366,9 @@ class OSDDaemon(Dispatcher):
         if m is None or msg.prev_epoch != m.epoch:
             if m is not None and msg.epoch <= m.epoch:
                 return  # stale or duplicate
-            await self.messenger.send_message(
+            await self._mon_send(
                 M.MMonSubscribe(what="osdmap", addr=self.messenger.my_addr,
-                                since=m.epoch if m else 0), self.mon_addr)
+                                since=m.epoch if m else 0))
             return
         for blob in msg.inc_blobs:
             m.apply_incremental(pickle.loads(blob))
@@ -380,9 +392,8 @@ class OSDDaemon(Dispatcher):
             # the map says we are down but we are alive: re-boot (reference
             # OSD::start_boot after _committed_osd_maps notices the same)
             self.perf.inc("osd_re_boots")
-            await self.messenger.send_message(
-                M.MOSDBoot(osd_id=self.osd_id,
-                           addr=self.messenger.my_addr), self.mon_addr)
+            await self._mon_send(M.MOSDBoot(osd_id=self.osd_id,
+                                            addr=self.messenger.my_addr))
         changed = self._advance_pgs()
         if changed and not self._stopped:
             self._tasks.append(asyncio.get_event_loop().create_task(
@@ -1130,11 +1141,7 @@ class OSDDaemon(Dispatcher):
             now = time.monotonic()
             # beacon to the mon (reference MOSDBeacon): lets the mon mark
             # us down even when no peer reporters survive
-            try:
-                await self.messenger.send_message(
-                    M.MOSDAlive(osd_id=self.osd_id), self.mon_addr)
-            except (ConnectionError, OSError):
-                pass
+            await self._mon_send(M.MOSDAlive(osd_id=self.osd_id))
             for osd, addr in list(m.osd_addrs.items()):
                 if osd == self.osd_id or not m.osd_up[osd]:
                     continue
@@ -1148,14 +1155,9 @@ class OSDDaemon(Dispatcher):
                         now - last > self.config.osd_heartbeat_grace and \
                         osd not in self._reported:
                     self._reported.add(osd)
-                    try:
-                        await self.messenger.send_message(
-                            M.MOSDFailure(failed_osd=osd,
-                                          reporter=self.osd_id),
-                            self.mon_addr)
+                    if await self._mon_send(M.MOSDFailure(
+                            failed_osd=osd, reporter=self.osd_id)):
                         self.perf.inc("osd_failure_reports")
-                    except (ConnectionError, OSError):
-                        pass
                 elif last is None:
                     self._hb_last[osd] = now
             # once the monitor marks a reported peer down, forget it so a

@@ -25,19 +25,46 @@ from ceph_tpu.utils import Config
 
 @dataclass
 class Cluster:
-    """A running mini cluster: one mon, N OSDs, loopback messengers."""
+    """A running mini cluster: mon quorum, N OSDs, loopback messengers."""
 
-    mon: Monitor
+    mons: List[Monitor]
     osds: Dict[int, OSDDaemon]
     config: Config
-    mon_addr: tuple = None
+    mon_addrs: List[tuple] = field(default_factory=list)
     clients: List[RadosClient] = field(default_factory=list)
+
+    @property
+    def mon(self) -> Monitor:
+        """The authoritative monitor: the quorum leader (or the only one)."""
+        for m in self.mons:
+            if m.is_leader:
+                return m
+        return self.mons[0]
+
+    @property
+    def mon_addr(self):
+        return self.mon_addrs[0] if len(self.mon_addrs) == 1 \
+            else self.mon_addrs
 
     async def client(self, name: str = "admin") -> RadosClient:
         c = RadosClient(self.mon_addr, name=name, config=self.config)
         await c.connect()
         self.clients.append(c)
         return c
+
+    async def kill_mon(self, rank: int) -> None:
+        """Hard-stop a monitor (mon_thrash analog)."""
+        await self.mons[rank].stop()
+
+    async def wait_for_leader(self, timeout: float = 10.0,
+                              exclude: int = -1) -> Monitor:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            for m in self.mons:
+                if m.rank != exclude and m.is_leader:
+                    return m
+            await asyncio.sleep(0.05)
+        raise TimeoutError("no mon leader elected")
 
     async def kill_osd(self, osd_id: int) -> None:
         """Hard-stop an OSD (thrasher kill_osd analog)."""
@@ -87,7 +114,8 @@ class Cluster:
             await c.shutdown()
         for osd in self.osds.values():
             await osd.stop()
-        await self.mon.stop()
+        for m in self.mons:
+            await m.stop()
 
 
 def _fast_config() -> Config:
@@ -106,12 +134,15 @@ def _fast_config() -> Config:
 
 async def start_cluster(n_osds: int = 3, osds_per_host: int = 1,
                         config: Optional[Config] = None,
-                        store_factory=None) -> Cluster:
-    """Boot mon + OSDs and wait for all of them to appear up in the map.
+                        store_factory=None, n_mons: int = 1) -> Cluster:
+    """Boot the mon quorum + OSDs and wait for everything up in the map.
 
     ``store_factory(osd_id) -> ObjectStore`` selects the backing store
     (default MemStore; pass a FileStore factory for a durable cluster —
-    the vstart.sh --bluestore/--filestore switch analog)."""
+    the vstart.sh --bluestore/--filestore switch analog).  ``n_mons`` > 1
+    runs a Paxos quorum with leader election."""
+    import pickle as _pickle
+
     config = config or _fast_config()
     n_hosts = (n_osds + osds_per_host - 1) // osds_per_host
     cmap, _ = build_hierarchy(n_hosts, osds_per_host, numrep=3)
@@ -119,22 +150,34 @@ async def start_cluster(n_osds: int = 3, osds_per_host: int = 1,
     # OSDs boot "down" until they report in (reference: superblock boot flow)
     for o in range(n_osds):
         osdmap.osd_up[o] = False
-    mon = Monitor(osdmap, config=config)
-    mon_addr = await mon.start()
-    cluster = Cluster(mon=mon, osds={}, config=config, mon_addr=mon_addr)
+    map_blob = _pickle.dumps(osdmap)
+    mons: List[Monitor] = []
+    mon_addrs: List[tuple] = []
+    for r in range(n_mons):
+        mon = Monitor(_pickle.loads(map_blob), config=config, rank=r,
+                      n_mons=n_mons)
+        mon_addrs.append(await mon.start())
+        mons.append(mon)
+    cluster = Cluster(mons=mons, osds={}, config=config,
+                      mon_addrs=mon_addrs)
+    if n_mons > 1:
+        for mon in mons:
+            mon.set_monmap(mon_addrs)
+        await mons[0].begin_elections()
+        await cluster.wait_for_leader()
     for o in range(n_osds):
-        osd = OSDDaemon(o, mon_addr, config=config,
+        osd = OSDDaemon(o, cluster.mon_addr, config=config,
                         store=store_factory(o) if store_factory else None)
         await osd.start()
         cluster.osds[o] = osd
     deadline = asyncio.get_event_loop().time() + 10
     while asyncio.get_event_loop().time() < deadline:
-        if all(mon.osdmap.osd_up[o] for o in range(n_osds)):
+        if all(cluster.mon.osdmap.osd_up[o] for o in range(n_osds)):
             break
         await asyncio.sleep(0.02)
     else:
         raise TimeoutError("OSDs never booted")
-    await cluster.wait_for_epoch(mon.osdmap.epoch)
+    await cluster.wait_for_epoch(cluster.mon.osdmap.epoch)
     return cluster
 
 

@@ -49,6 +49,14 @@ OPTIONS: List[Option] = [
            "mark an osd down when its beacons go stale this long "
            "(reference osd_beacon_report_interval + mon grace)"),
     Option("mon_tick_interval", float, 0.5),
+    Option("mon_election_timeout", float, 0.3,
+           "elector victory-check window"),
+    Option("mon_paxos_timeout", float, 1.0,
+           "collect/accept round timeout"),
+    Option("mon_lease_interval", float, 0.25,
+           "leader lease extension period"),
+    Option("mon_lease_ack_timeout", float, 1.2,
+           "peon lease staleness before calling an election"),
     # ec
     Option("osd_ec_batch_size", int, 64, "stripes per device dispatch"),
     Option("osd_ec_stripe_unit", int, 4096),

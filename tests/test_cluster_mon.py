@@ -1,0 +1,130 @@
+"""Multi-monitor quorum: election, Paxos-replicated maps, leader failover.
+
+The tier-3 mon_thrash analog (reference qa/tasks/mon_thrash.py): kill the
+leader mid-workload and require the cluster to elect, converge, and keep
+serving I/O.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.cluster.vstart import _fast_config, start_cluster
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_three_mon_quorum_replicates_maps():
+    async def scenario():
+        cluster = await start_cluster(3, n_mons=3)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("repl", "replicated",
+                                            pg_num=8, size=3)
+            io = client.ioctx(pool)
+            await io.write_full("obj", b"quorum-payload" * 50)
+            assert await io.read("obj") == b"quorum-payload" * 50
+
+            # every monitor converges on the same committed map
+            deadline = asyncio.get_event_loop().time() + 10
+            while asyncio.get_event_loop().time() < deadline:
+                epochs = {m.osdmap.epoch for m in cluster.mons}
+                pools = [sorted(p.name for p in m.osdmap.pools.values())
+                         for m in cluster.mons]
+                if len(epochs) == 1 and all(p == pools[0] for p in pools):
+                    break
+                await asyncio.sleep(0.05)
+            assert len({m.osdmap.epoch for m in cluster.mons}) == 1
+            for m in cluster.mons:
+                assert any(p.name == "repl" for p in m.osdmap.pools.values())
+            # exactly one leader
+            assert sum(1 for m in cluster.mons if m.is_leader) == 1
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_leader_failover_mid_pool_create():
+    """Kill the leader while a pool create is in flight: a new leader is
+    elected, the command succeeds (client failover + idempotent create),
+    maps converge identically on the survivors, and OSDs keep serving."""
+    async def scenario():
+        cluster = await start_cluster(3, n_mons=3)
+        try:
+            client = await cluster.client()
+            p1 = await client.pool_create("before", "replicated",
+                                          pg_num=4, size=3)
+            io1 = client.ioctx(p1)
+            await io1.write_full("pre", b"pre-failover" * 40)
+
+            leader = cluster.mon
+            dead_rank = leader.rank
+
+            async def create():
+                return await client.pool_create("during", "replicated",
+                                                pg_num=4, size=3)
+
+            task = asyncio.get_event_loop().create_task(create())
+            await asyncio.sleep(0.05)   # let the command take off
+            await cluster.kill_mon(dead_rank)
+
+            p2 = await asyncio.wait_for(task, timeout=30)
+            new_leader = await cluster.wait_for_leader(exclude=dead_rank)
+            assert new_leader.rank != dead_rank
+
+            survivors = [m for m in cluster.mons if m.rank != dead_rank]
+            deadline = asyncio.get_event_loop().time() + 10
+            while asyncio.get_event_loop().time() < deadline:
+                epochs = {m.osdmap.epoch for m in survivors}
+                if len(epochs) == 1 and all(
+                        any(p.name == "during"
+                            for p in m.osdmap.pools.values())
+                        for m in survivors):
+                    break
+                await asyncio.sleep(0.05)
+            names = [sorted(p.name for p in m.osdmap.pools.values())
+                     for m in survivors]
+            assert names[0] == names[1], names
+            # the pool exists exactly ONCE despite the client retry
+            assert sum(1 for p in survivors[0].osdmap.pools.values()
+                       if p.name == "during") == 1
+
+            # OSDs keep serving through the new quorum
+            io2 = client.ioctx(p2)
+            await io2.write_full("post", b"post-failover" * 40, timeout=60)
+            assert await io2.read("post", timeout=60) == \
+                b"post-failover" * 40
+            assert await io1.read("pre") == b"pre-failover" * 40
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_peon_forwards_commands():
+    """A command sent to a peon is forwarded to the leader and the reply
+    relayed back (reference Monitor::forward_request_leader)."""
+    async def scenario():
+        cluster = await start_cluster(3, n_mons=3)
+        try:
+            leader = cluster.mon
+            peon = next(m for m in cluster.mons if not m.is_leader)
+            # point a client directly (and only) at the peon
+            from ceph_tpu.cluster.objecter import RadosClient
+
+            c = RadosClient([tuple(cluster.mon_addrs[peon.rank])],
+                            name="peonclient", config=cluster.config)
+            await c.connect()
+            cluster.clients.append(c)
+            pool = await c.pool_create("viapeon", "replicated",
+                                       pg_num=4, size=3)
+            assert any(p.name == "viapeon"
+                       for p in leader.osdmap.pools.values())
+            assert peon.perf.get("mon_commands_forwarded") >= 1
+        finally:
+            await cluster.stop()
+
+    run(scenario())
