@@ -124,6 +124,18 @@ class MOSDOpReply(Message):
     epoch: int = 0
 
 
+@dataclass
+class MWatchNotify(Message):
+    """Watcher callback delivery (reference MWatchNotify): sent by the
+    primary OSD to every registered watcher when a notify op fires."""
+
+    pool: int = -1
+    oid: str = ""
+    notify_id: int = 0
+    cookie: int = 0
+    payload: bytes = b""
+
+
 # -- osd <-> osd (replication / EC / recovery) ------------------------------
 
 
@@ -215,3 +227,22 @@ class MOSDPGPushReply(Message):
     pgid: Optional[PGid] = None
     oid: str = ""
     result: int = 0
+
+
+@dataclass
+class MOSDScrub(Message):
+    """Scrub-map request from the primary (reference MOSDRepScrub)."""
+
+    reqid: Tuple[str, int] = ("", 0)
+    pgid: Optional[PGid] = None
+
+
+@dataclass
+class MOSDScrubMap(Message):
+    """Member's scrub map: oid -> (version, size, computed_crc,
+    stored_crc) (reference ScrubMap exchange)."""
+
+    reqid: Tuple[str, int] = ("", 0)
+    pgid: Optional[PGid] = None
+    objects: Dict[str, Tuple[int, int, int, Optional[int]]] = \
+        field(default_factory=dict)

@@ -1,4 +1,4 @@
-"""Async messenger: Connection / Dispatcher / Policy over asyncio TCP.
+"""Async messenger: Connection / Dispatcher / sessions over asyncio TCP.
 
 Structural mirror of the reference messenger abstraction (src/msg/
 Messenger.h, Dispatcher.h; AsyncMessenger event loops): entity-named
@@ -8,17 +8,30 @@ loopback (the reference's tier-3 standalone tests run the same way:
 N daemons x 1 host over real sockets).  Frames are length-prefixed
 pickles — an internal trust boundary, like the reference's cephx-signed
 native encoding is within a cluster.
+
+Reliability (reference AsyncConnection reconnect/replay semantics):
+outgoing traffic runs over per-peer SESSIONS with monotonically
+increasing sequence numbers; sent frames stay buffered until the peer
+acks them, and a dropped TCP connection is transparently re-opened with
+the unacked tail replayed IN ORDER.  Delivery is therefore ordered
+at-least-once — handlers are idempotent by design (absolute-offset
+writes, versioned log appends), exactly like the reference's lossless
+osd-osd policy replaying out_q after a session reset.
 """
 
 from __future__ import annotations
 
 import asyncio
+import itertools
 import pickle
 import struct
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
 Addr = Tuple[str, int]
+
+_SID = itertools.count(1)
 
 
 @dataclass(frozen=True)
@@ -32,10 +45,47 @@ class EntityName:
 
 @dataclass
 class Message:
-    """Base message; src is stamped by the sending messenger."""
+    """Base message; src/seq/sid are stamped by the sending messenger."""
 
     src: Optional[EntityName] = field(default=None, init=False)
     seq: int = field(default=0, init=False)
+    sid: int = field(default=0, init=False)
+
+
+@dataclass
+class _MsgAck(Message):
+    """Transport-level ack: trims the sender's replay buffer."""
+
+    acked: int = 0
+
+
+class _Session:
+    """Per-peer outgoing session: seq numbering + unacked replay buffer
+    (reference AsyncConnection out_seq/out_q)."""
+
+    MAX_UNACKED = 512
+
+    def __init__(self):
+        self.conn: Optional["Connection"] = None
+        self.seq = 0
+        self.unacked: "OrderedDict[int, bytes]" = OrderedDict()
+        self.overflowed = False
+        self.lock = asyncio.Lock()
+
+    def buffer(self, seq: int, frame: bytes) -> None:
+        self.unacked[seq] = frame
+        while len(self.unacked) > self.MAX_UNACKED:
+            # cannot trim silently and still promise at-least-once: mark
+            # the session broken so the next reconnect FAILS loudly
+            # instead of replaying an incomplete tail
+            self.overflowed = True
+            self.unacked.popitem(last=False)
+
+    def ack(self, seq: int) -> None:
+        for s in [s for s in self.unacked if s <= seq]:
+            del self.unacked[s]
+        if not self.unacked:
+            self.overflowed = False  # fully acked: contract restored
 
 
 class Connection:
@@ -85,9 +135,11 @@ class Dispatcher:
 class Messenger:
     def __init__(self, name: EntityName):
         self.name = name
+        self.sid = next(_SID)
         self.dispatchers: List[Dispatcher] = []
         self._server: Optional[asyncio.base_events.Server] = None
         self._out: Dict[Addr, Connection] = {}
+        self._sessions: Dict[Addr, _Session] = {}
         self._accepted: List[Connection] = []
         self._tasks: List[asyncio.Task] = []
         self._closing = False
@@ -125,6 +177,17 @@ class Messenger:
                 msg = pickle.loads(payload)
                 if conn.peer is None:
                     conn.peer = msg.src
+                if isinstance(msg, _MsgAck):
+                    sess = self._sessions.get(conn.peer_addr)
+                    if sess is not None:
+                        sess.ack(msg.acked)
+                    continue
+                if msg.sid:
+                    # session traffic: ack so the sender can trim replay
+                    try:
+                        await conn.send(_MsgAck(acked=msg.seq))
+                    except (ConnectionError, OSError, RuntimeError):
+                        pass
                 for d in self.dispatchers:
                     if await d.ms_dispatch(conn, msg):
                         break
@@ -149,8 +212,57 @@ class Messenger:
         return conn
 
     async def send_message(self, msg: Message, addr: Addr) -> None:
-        conn = await self.connect(addr)
-        await conn.send(msg)
+        """Session send: ordered at-least-once with reconnect + replay of
+        the unacked tail (reference AsyncConnection replay)."""
+        addr = tuple(addr)
+        sess = self._sessions.get(addr)
+        if sess is None:
+            sess = self._sessions[addr] = _Session()
+        async with sess.lock:
+            sess.seq += 1
+            msg.src = self.name
+            msg.seq = sess.seq
+            msg.sid = self.sid
+            payload = pickle.dumps(msg)
+            frame = struct.pack("<I", len(payload)) + payload
+            sess.buffer(sess.seq, frame)
+            try:
+                conn = await self.connect(addr)
+                conn.writer.write(frame)
+                await conn.writer.drain()
+            except (ConnectionError, OSError, RuntimeError):
+                if self._closing:
+                    raise
+                await self._reconnect_replay(sess, addr)
+
+    async def _reconnect_replay(self, sess: _Session, addr: Addr,
+                                retries: int = 3) -> None:
+        """Re-open the peer connection and replay every unacked frame in
+        order; raises when the peer stays unreachable."""
+        if sess.overflowed:
+            # frames were evicted while unacked: an in-order replay is no
+            # longer possible — fail the send and reset the session so
+            # future traffic starts from a clean (acked-empty) state
+            sess.unacked.clear()
+            sess.overflowed = False
+            raise ConnectionError(
+                f"session to {addr} lost unacked frames (overflow); "
+                "cannot replay")
+        last: Optional[Exception] = None
+        for attempt in range(retries):
+            old = self._out.pop(addr, None)
+            if old is not None:
+                await old.close()
+            try:
+                conn = await self.connect(addr)
+                for f in sess.unacked.values():
+                    conn.writer.write(f)
+                await conn.writer.drain()
+                return
+            except (ConnectionError, OSError, RuntimeError) as e:
+                last = e
+                await asyncio.sleep(0.02 * (attempt + 1))
+        raise last or ConnectionError(f"reconnect to {addr} failed")
 
     async def shutdown(self) -> None:
         self._closing = True

@@ -42,11 +42,14 @@ class MonTargeter:
         """Send to the current monitor, hunting across the monmap on
         connection failure."""
         last: Optional[Exception] = None
+        # RuntimeError included: asyncio raises it for writes on a
+        # closing transport and the messenger re-raises it
+        errs = (ConnectionError, OSError, RuntimeError)
         for _ in range(len(self.addrs)):
             try:
                 await self.messenger.send_message(msg, self.current)
                 return True
-            except (ConnectionError, OSError) as e:
+            except errs as e:
                 last = e
                 self.hunt()
                 if len(self.addrs) > 1 and \
@@ -58,7 +61,7 @@ class MonTargeter:
                                 addr=self.messenger.my_addr,
                                 since=self.subscribe_since()),
                             self.current)
-                    except (ConnectionError, OSError):
+                    except errs:
                         continue
         if raise_on_fail:
             raise last or ConnectionError("no monitor reachable")

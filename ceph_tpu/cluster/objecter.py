@@ -45,6 +45,11 @@ class Objecter(Dispatcher):
         self._inflight: Dict[Tuple[str, int], asyncio.Future] = {}
         self._mon_tid = 0
         self._mon_inflight: Dict[int, asyncio.Future] = {}
+        # linger ops (watches) re-registered on every map change
+        # (reference Objecter::linger_register, Objecter.cc:778)
+        self._cookie = 0
+        self._watches: Dict[Tuple[int, str, int], object] = {}
+        self._relinger_task = None
 
     @property
     def mon_addr(self) -> Addr:
@@ -64,18 +69,30 @@ class Objecter(Dispatcher):
     async def stop(self) -> None:
         await self.messenger.shutdown()
 
+    async def ms_handle_reset(self, conn: Connection) -> None:
+        """A connection died: our watches ride accepted server-side conns
+        that a transparent session reconnect does NOT restore — re-register
+        them (reference: watch reconnect on session reset)."""
+        self._schedule_relinger()
+
     async def ms_dispatch(self, conn: Connection, msg) -> bool:
         if isinstance(msg, M.MOSDMapMsg):
             newmap = pickle.loads(msg.osdmap_blob)
             if self.osdmap is None or newmap.epoch >= self.osdmap.epoch:
                 self.osdmap = newmap
+                self._schedule_relinger()
             self._map_event.set()
+            return True
+        if isinstance(msg, M.MWatchNotify):
+            await self._handle_watch_notify(msg)
             return True
         if isinstance(msg, M.MOSDIncMapMsg):
             m = self.osdmap
             if m is not None and msg.prev_epoch == m.epoch:
                 for blob in msg.inc_blobs:
                     m.apply_incremental(pickle.loads(blob))
+                if msg.inc_blobs:
+                    self._schedule_relinger()
                 self._map_event.set()
             elif m is not None and msg.epoch <= m.epoch:
                 self._map_event.set()  # already current
@@ -157,6 +174,67 @@ class Objecter(Dispatcher):
             except asyncio.TimeoutError:
                 pass
 
+    # -- watch/notify (linger ops) -----------------------------------------
+
+    def _schedule_relinger(self) -> None:
+        """Re-register every watch after a map change: the PG's primary
+        may have moved (reference linger resend on map change)."""
+        if not self._watches:
+            return
+        if self._relinger_task is None or self._relinger_task.done():
+            self._relinger_task = asyncio.get_event_loop().create_task(
+                self._relinger())
+
+    async def _relinger(self) -> None:
+        for (pool_id, oid, cookie) in list(self._watches):
+            try:
+                await self.op_submit(pool_id, oid,
+                                     [("watch", {"cookie": cookie})],
+                                     timeout=10.0)
+            except Exception:
+                pass
+
+    async def _handle_watch_notify(self, msg: M.MWatchNotify) -> None:
+        cb = self._watches.get((msg.pool, msg.oid, msg.cookie))
+        if cb is not None:
+            try:
+                res = cb(msg.payload)
+                if asyncio.iscoroutine(res):
+                    await res
+            except Exception:
+                pass
+        # ack one-way: this runs INSIDE our read loop, so a waiting
+        # op_submit could never see its reply (self-deadlock until timeout)
+        try:
+            pgid = self.object_pgid(msg.pool, msg.oid)
+            primary = self._target_osd(pgid)
+            addr = self.osdmap.osd_addrs.get(primary)
+            if addr is not None:
+                self._tid += 1
+                await self.messenger.send_message(
+                    M.MOSDOp(reqid=(self.client_name, self._tid),
+                             pgid=pgid, oid=msg.oid,
+                             ops=[("notify_ack",
+                                   {"notify_id": msg.notify_id})],
+                             epoch=self.osdmap.epoch), tuple(addr))
+        except Exception:
+            pass
+
+    async def watch(self, pool_id: int, oid: str, callback) -> int:
+        self._cookie += 1
+        cookie = self._cookie
+        self._watches[(pool_id, oid, cookie)] = callback
+        reply = await self.op_submit(pool_id, oid,
+                                     [("watch", {"cookie": cookie})])
+        if reply.result != 0:
+            del self._watches[(pool_id, oid, cookie)]
+            raise IOError(f"watch({oid}) -> {reply.result}")
+        return cookie
+
+    async def unwatch(self, pool_id: int, oid: str, cookie: int) -> None:
+        self._watches.pop((pool_id, oid, cookie), None)
+        await self.op_submit(pool_id, oid, [("unwatch", {"cookie": cookie})])
+
     async def mon_command(self, cmd: Dict[str, Any], timeout: float = 10.0):
         """Command with failover: retries against the other monitors when
         the current one dies or has no leader (commands are idempotent at
@@ -237,6 +315,91 @@ class IoCtx:
         reply = await self.objecter.op_submit(self.pool_id, oid, [("stat", {})])
         if reply.result != 0:
             raise FileNotFoundError(oid)
+        return reply.data
+
+    # -- xattrs (librados rados_getxattr/setxattr family) -------------------
+
+    async def getxattr(self, oid: str, name: str) -> bytes:
+        reply = await self.objecter.op_submit(
+            self.pool_id, oid, [("getxattr", {"name": name})])
+        if reply.result == -61:
+            raise KeyError(name)
+        if reply.result != 0:
+            raise IOError(f"getxattr({oid}, {name}) -> {reply.result}")
+        return reply.data
+
+    async def setxattr(self, oid: str, name: str, value: bytes) -> None:
+        reply = await self.objecter.op_submit(
+            self.pool_id, oid, [("setxattr", {"name": name,
+                                              "value": bytes(value)})])
+        if reply.result != 0:
+            raise IOError(f"setxattr({oid}, {name}) -> {reply.result}")
+
+    async def rmxattr(self, oid: str, name: str) -> None:
+        reply = await self.objecter.op_submit(
+            self.pool_id, oid, [("rmxattr", {"name": name})])
+        if reply.result != 0:
+            raise IOError(f"rmxattr({oid}, {name}) -> {reply.result}")
+
+    async def getxattrs(self, oid: str) -> Dict[str, bytes]:
+        reply = await self.objecter.op_submit(
+            self.pool_id, oid, [("getxattrs", {})])
+        if reply.result != 0:
+            raise IOError(f"getxattrs({oid}) -> {reply.result}")
+        return reply.data
+
+    # -- omap ---------------------------------------------------------------
+
+    async def omap_set(self, oid: str, kv: Dict[str, bytes]) -> None:
+        reply = await self.objecter.op_submit(
+            self.pool_id, oid, [("omap_set", {"kv": dict(kv)})])
+        if reply.result != 0:
+            raise IOError(f"omap_set({oid}) -> {reply.result}")
+
+    async def omap_get(self, oid: str) -> Dict[str, bytes]:
+        reply = await self.objecter.op_submit(
+            self.pool_id, oid, [("omap_get", {})])
+        if reply.result != 0:
+            raise IOError(f"omap_get({oid}) -> {reply.result}")
+        return reply.data
+
+    async def omap_rmkeys(self, oid: str, keys) -> None:
+        reply = await self.objecter.op_submit(
+            self.pool_id, oid, [("omap_rmkeys", {"keys": list(keys)})])
+        if reply.result != 0:
+            raise IOError(f"omap_rmkeys({oid}) -> {reply.result}")
+
+    # -- object classes (rados_exec) ----------------------------------------
+
+    async def execute(self, oid: str, cls: str, method: str,
+                      indata: bytes = b"") -> bytes:
+        reply = await self.objecter.op_submit(
+            self.pool_id, oid, [("exec", {"cls": cls, "method": method,
+                                          "indata": bytes(indata)})])
+        if reply.result != 0:
+            raise IOError(
+                f"exec({oid}, {cls}.{method}) -> {reply.result}: "
+                f"{reply.data}")
+        return reply.data
+
+    # -- watch/notify -------------------------------------------------------
+
+    async def watch(self, oid: str, callback) -> int:
+        """Register a watch; callback(payload) fires on every notify
+        (re-registered across map changes — a linger op)."""
+        return await self.objecter.watch(self.pool_id, oid, callback)
+
+    async def unwatch(self, oid: str, cookie: int) -> None:
+        await self.objecter.unwatch(self.pool_id, oid, cookie)
+
+    async def notify(self, oid: str, payload: bytes = b"",
+                     timeout: float = 5.0):
+        """Notify all watchers; returns the list of ackers."""
+        reply = await self.objecter.op_submit(
+            self.pool_id, oid, [("notify", {"payload": bytes(payload),
+                                            "timeout": timeout})])
+        if reply.result != 0:
+            raise IOError(f"notify({oid}) -> {reply.result}")
         return reply.data
 
 

@@ -102,6 +102,11 @@ class OSDDaemon(Dispatcher):
         self._tasks: List[asyncio.Task] = []
         self._hb_last: Dict[int, float] = {}
         self._reported: Set[int] = set()
+        # watch/notify state: (pgid, oid) -> {(watcher, cookie): conn}
+        # (reference Watch/Notify on PrimaryLogPG)
+        self._watchers: Dict[Tuple, Dict[Tuple[str, int], Connection]] = {}
+        self._notifies: Dict[int, Tuple[asyncio.Future, Set[str]]] = {}
+        self._notify_id = 0
         self._stopped = False
 
     # ------------------------------------------------------------ lifecycle
@@ -117,6 +122,7 @@ class OSDDaemon(Dispatcher):
             M.MMonSubscribe(what="osdmap", addr=addr, since=since))
         loop = asyncio.get_event_loop()
         self._tasks.append(loop.create_task(self._heartbeat_loop()))
+        self._tasks.append(loop.create_task(self._scrub_loop()))
         return addr
 
     def _load_superblock(self) -> int:
@@ -295,6 +301,14 @@ class OSDDaemon(Dispatcher):
         if isinstance(msg, M.MOSDECSubOpReadReply):
             self._ack(msg.reqid, msg.result, msg)
             return True
+        if isinstance(msg, M.MOSDScrub):
+            await conn.send(M.MOSDScrubMap(
+                reqid=msg.reqid, pgid=msg.pgid,
+                objects=self._build_scrub_map(msg.pgid)))
+            return True
+        if isinstance(msg, M.MOSDScrubMap):
+            self._ack(msg.reqid, 0, msg)
+            return True
         if isinstance(msg, M.MOSDPGPush):
             self._handle_push(msg)
             await conn.send(M.MOSDPGPushReply(
@@ -350,6 +364,18 @@ class OSDDaemon(Dispatcher):
         fut.needed = needed  # type: ignore[attr-defined]
         self._pending[key] = (fut, [])
         return fut
+
+    def _waiter_dec(self, key) -> None:
+        """A planned responder became unreachable: lower the threshold AND
+        re-check completion — acks that already arrived must be able to
+        satisfy the waiter, or a durably-committed op reports failure."""
+        entry = self._pending.get(key)
+        if entry is None:
+            return
+        fut, acc = entry
+        fut.needed -= 1  # type: ignore[attr-defined]
+        if len(acc) >= fut.needed and not fut.done():  # type: ignore[attr-defined]
+            fut.set_result(acc)
 
     async def _send_osd(self, osd: int, msg) -> None:
         addr = self.osdmap.osd_addrs.get(osd)
@@ -516,8 +542,159 @@ class OSDDaemon(Dispatcher):
                 names = self._list_pg_objects(st.pgid)
                 await conn.send(M.MOSDOpReply(
                     reqid=msg.reqid, result=0, data=names, epoch=m.epoch))
+            elif opname in ("getxattr", "getxattrs", "omap_get"):
+                r, data = self._op_read_meta(st, msg.oid, opname, args)
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=r, data=data, epoch=m.epoch))
+            elif opname in ("setxattr", "rmxattr", "omap_set",
+                            "omap_rmkeys"):
+                async with st.lock:
+                    r = await self._op_write_meta(st, msg.oid, opname, args)
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=r, epoch=m.epoch))
+            elif opname == "exec":
+                async with st.lock:
+                    r, data = await self._op_exec(st, msg.oid, args)
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=r, data=data, epoch=m.epoch))
+            elif opname == "watch":
+                self._watchers.setdefault((st.pgid, msg.oid), {})[
+                    (str(msg.src), args["cookie"])] = conn
+                self.perf.inc("osd_watches")
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=0, epoch=m.epoch))
+            elif opname == "unwatch":
+                self._watchers.get((st.pgid, msg.oid), {}).pop(
+                    (str(msg.src), args["cookie"]), None)
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=0, epoch=m.epoch))
+            elif opname == "notify":
+                # off the connection's dispatch loop: a notifier that also
+                # watches the object acks over this same connection, which
+                # must keep reading while the notify gathers acks
+                async def _notify_bg(reqid=msg.reqid, oid=msg.oid,
+                                     a=args, epoch=m.epoch):
+                    ackers = await self._op_notify(st, oid, a)
+                    try:
+                        await conn.send(M.MOSDOpReply(
+                            reqid=reqid, result=0, data=ackers,
+                            epoch=epoch))
+                    except (ConnectionError, OSError):
+                        pass
+
+                self._tasks.append(
+                    asyncio.get_event_loop().create_task(_notify_bg()))
+            elif opname == "notify_ack":
+                entry = self._notifies.get(args["notify_id"])
+                if entry is not None:
+                    fut, acked = entry
+                    acked.add(str(msg.src))
+                    if not fut.done() and len(acked) >= fut.needed:  # type: ignore[attr-defined]
+                        fut.set_result(None)
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=0, epoch=m.epoch))
             else:
                 await conn.send(M.MOSDOpReply(reqid=msg.reqid, result=-95))
+
+    # ------------------------------------------------- xattr/omap/exec ops
+    #
+    # User xattrs are stored with a "_" prefix, exactly like the reference
+    # object store's user-attr namespace, so they never collide with the
+    # internal shard/size/hinfo attrs.
+
+    def _op_read_meta(self, st: PGState, oid: str, opname: str, args):
+        coll = _coll(st.pgid)
+        if self.store.stat(coll, oid) is None:
+            return -2, None
+        if opname == "getxattr":
+            v = self.store.getattr(coll, oid, "_" + args["name"])
+            return (0, v) if v is not None else (-61, None)  # ENODATA
+        if opname == "getxattrs":
+            return 0, {k[1:]: v for k, v in
+                       self.store.get_xattrs(coll, oid).items()
+                       if k.startswith("_")}
+        if opname == "omap_get":
+            return 0, self.store.omap_get(coll, oid)
+        return -95, None
+
+    async def _op_write_meta(self, st: PGState, oid: str, opname: str,
+                             args) -> int:
+        """Metadata mutations ride the same logged+replicated transaction
+        path as data writes (reference do_osd_ops xattr/omap cases write
+        into the op's transaction, PrimaryLogPG.cc:4917)."""
+        coll = _coll(st.pgid)
+        txn = Transaction().touch(coll, oid)
+        if opname == "setxattr":
+            txn.setattr(coll, oid, "_" + args["name"], args["value"])
+        elif opname == "rmxattr":
+            txn.rmattr(coll, oid, "_" + args["name"])
+        elif opname == "omap_set":
+            txn.omap_set(coll, oid, args["kv"])
+        elif opname == "omap_rmkeys":
+            txn.omap_rmkeys(coll, oid, list(args["keys"]))
+        version = self._next_version(st)
+        txn.set_version(coll, oid, version[1])
+        return await self._replicate_txn(st, txn, "modify", oid, version)
+
+    async def _op_exec(self, st: PGState, oid: str, args):
+        """Object-class execution (reference do_osd_ops CEPH_OSD_OP_CALL):
+        the method's reads hit the store, its writes collect into a txn
+        that commits + replicates atomically with the op."""
+        from ceph_tpu.cluster.objclass import (
+            ClassRegistry, ClsError, MethodContext,
+        )
+
+        coll = _coll(st.pgid)
+        txn = Transaction().touch(coll, oid)
+        ctx = MethodContext(self.store, coll, oid, txn)
+        try:
+            out = ClassRegistry.instance().call(
+                args["cls"], args["method"], ctx, args.get("indata", b""))
+        except ClsError as e:
+            return e.errno, str(e)
+        self.perf.inc("osd_cls_calls")
+        if len(txn.ops) > 1:  # beyond the touch: mutations to commit
+            version = self._next_version(st)
+            txn.set_version(coll, oid, version[1])
+            r = await self._replicate_txn(st, txn, "modify", oid, version)
+            if r != 0:
+                return r, None
+        return 0, out
+
+    async def _op_notify(self, st: PGState, oid: str, args):
+        """Fan a notify out to every watcher and gather acks within the
+        timeout (reference PrimaryLogPG::do_osd_op_effects + Notify)."""
+        watchers = self._watchers.get((st.pgid, oid), {})
+        live = {k: c for k, c in watchers.items() if not c.closed}
+        self._watchers[(st.pgid, oid)] = live
+        if not live:
+            return []
+        self._notify_id += 1
+        nid = self._notify_id
+        fut = asyncio.get_event_loop().create_future()
+        fut.needed = len(live)  # type: ignore[attr-defined]
+        acked: Set[str] = set()
+        self._notifies[nid] = (fut, acked)
+        for (watcher, cookie), conn in live.items():
+            try:
+                await conn.send(M.MWatchNotify(
+                    pool=st.pgid.pool, oid=oid, notify_id=nid,
+                    cookie=cookie, payload=args.get("payload", b"")))
+            except (ConnectionError, OSError, RuntimeError):
+                fut.needed -= 1  # type: ignore[attr-defined]
+                if len(acked) >= fut.needed and not fut.done():  # type: ignore[attr-defined]
+                    fut.set_result(None)
+        try:
+            if not fut.done() and fut.needed > 0:  # type: ignore[attr-defined]
+                await asyncio.wait_for(
+                    fut, timeout=args.get("timeout",
+                                          self.config.osd_client_op_timeout))
+        except asyncio.TimeoutError:
+            pass
+        finally:
+            self._notifies.pop(nid, None)
+        self.perf.inc("osd_notifies")
+        return sorted(acked)
 
     # replicated write: local txn + MOSDRepOp fan-out (ReplicatedBackend)
     async def _op_write_full(self, pool: PGPool, st: PGState, oid: str,
@@ -561,10 +738,18 @@ class OSDDaemon(Dispatcher):
                               entry=entry,
                               epoch=self.osdmap.epoch)
             for o in peers:
-                await self._send_osd(o, rep)
+                try:
+                    await self._send_osd(o, rep)
+                except (ConnectionError, OSError, RuntimeError):
+                    # peer unreachable (map lag around a failure): the op
+                    # proceeds on the reachable set; the logged entry
+                    # delta-recovers the peer at rejoin (reference: the
+                    # acting set shrinks, missing grows)
+                    self._waiter_dec(reqid)
             try:
-                await asyncio.wait_for(
-                    fut, timeout=self.config.osd_client_op_timeout)
+                if not fut.done():
+                    await asyncio.wait_for(
+                        fut, timeout=self.config.osd_client_op_timeout)
             except asyncio.TimeoutError:
                 return -110
             finally:
@@ -650,14 +835,18 @@ class OSDDaemon(Dispatcher):
         if peers:
             fut = self._make_waiter(reqid, len(peers))
             for osd, shard in peers:
-                await self._send_osd(osd, M.MOSDECSubOpWrite(
-                    reqid=reqid, pgid=st.pgid, oid=oid, shard=shard,
-                    data=shards[shard].tobytes(), chunk_off=chunk_off,
-                    shard_size=shard_size, hinfo=hinfo, entry=entry,
-                    epoch=self.osdmap.epoch))
+                try:
+                    await self._send_osd(osd, M.MOSDECSubOpWrite(
+                        reqid=reqid, pgid=st.pgid, oid=oid, shard=shard,
+                        data=shards[shard].tobytes(), chunk_off=chunk_off,
+                        shard_size=shard_size, hinfo=hinfo, entry=entry,
+                        epoch=self.osdmap.epoch))
+                except (ConnectionError, OSError, RuntimeError):
+                    self._waiter_dec(reqid)
             try:
-                await asyncio.wait_for(
-                    fut, timeout=self.config.osd_client_op_timeout)
+                if not fut.done():
+                    await asyncio.wait_for(
+                        fut, timeout=self.config.osd_client_op_timeout)
             except asyncio.TimeoutError:
                 return -110
             finally:
@@ -728,21 +917,26 @@ class OSDDaemon(Dispatcher):
     async def _gather_shards(
         self, pool: PGPool, st: PGState, oid: str, need_k: int,
         off: int = 0, length: Optional[int] = None,
+        exclude_shards: Optional[Set[int]] = None,
     ) -> Tuple[Dict[int, bytes], int]:
-        """Collect >= k shard (ranges) from the acting set (own shard free)."""
+        """Collect >= k shard (ranges) from the acting set (own shard
+        free).  ``exclude_shards``: shard ids known corrupt — they must
+        never be decode sources (scrub repair would otherwise reconstruct
+        FROM the corruption and bless it)."""
+        exclude_shards = exclude_shards or set()
         shards: Dict[int, bytes] = {}
         size = 0
         my = self.store.stat(_coll(st.pgid), oid)
         if my is not None:
             data = self.store.read(_coll(st.pgid), oid, off, length)
             shard_attr = self.store.getattr(_coll(st.pgid), oid, "shard")
-            if shard_attr is not None:
+            if shard_attr is not None and                     int(shard_attr) not in exclude_shards:
                 shards[int(shard_attr)] = data
             sa = self.store.getattr(_coll(st.pgid), oid, "size")
             size = int(sa) if sa else 0
         peers = [(shard, osd) for shard, osd in enumerate(st.acting)
                  if osd not in (self.osd_id, CRUSH_ITEM_NONE)
-                 and shard not in shards]
+                 and shard not in shards and shard not in exclude_shards]
         if peers and len(shards) < need_k:
             reqid = self._next_reqid()
             fut = self._make_waiter(reqid, len(peers))
@@ -751,11 +945,14 @@ class OSDDaemon(Dispatcher):
                     await self._send_osd(osd, M.MOSDECSubOpRead(
                         reqid=reqid, pgid=st.pgid, oid=oid, shard=shard,
                         off=off, length=length))
-                except ConnectionError:
-                    fut.needed -= 1  # type: ignore[attr-defined]
+                except (ConnectionError, OSError, RuntimeError):
+                    self._waiter_dec(reqid)
             try:
-                acc = await asyncio.wait_for(
-                    fut, timeout=self.config.osd_client_op_timeout)
+                if fut.done():
+                    acc = fut.result()
+                else:
+                    acc = await asyncio.wait_for(
+                        fut, timeout=self.config.osd_client_op_timeout)
             except asyncio.TimeoutError:
                 acc = self._pending[reqid][1]
             finally:
@@ -1053,10 +1250,13 @@ class OSDDaemon(Dispatcher):
 
     async def _recover_ec_object(self, pool: PGPool, st: PGState, oid: str,
                                  targets: Optional[List[int]] = None,
-                                 entry: Optional[LogEntry] = None) -> bool:
+                                 entry: Optional[LogEntry] = None,
+                                 exclude_sources: Optional[Set[int]] = None,
+                                 ) -> bool:
         """Reconstruct shards for the target members (batched TPU decode +
         encode, ECBackend::run_recovery_op analog).  targets=None rebuilds
-        every acting member's shard.  Returns False when the object is
+        every acting member's shard; exclude_sources keeps known-corrupt
+        shard ids out of the decode.  Returns False when the object is
         currently unrecoverable (fewer than k shard sources)."""
         from ceph_tpu.ec import stripe as stripemod
         import numpy as np
@@ -1064,7 +1264,8 @@ class OSDDaemon(Dispatcher):
         codec = self._codec(pool)
         sinfo = self._sinfo(pool, codec)
         k = codec.get_data_chunk_count()
-        shards, size = await self._gather_shards(pool, st, oid, k)
+        shards, size = await self._gather_shards(
+            pool, st, oid, k, exclude_shards=exclude_sources)
         shard_len = sinfo.shard_size(size)
         avail = {s: np.frombuffer(d, dtype=np.uint8)
                  for s, d in shards.items() if len(d) == shard_len}
@@ -1098,6 +1299,141 @@ class OSDDaemon(Dispatcher):
                     pass
         return True
 
+    # --------------------------------------------------------------- scrub
+    #
+    # Background integrity verification (reference PG scrub +
+    # ecbackend.rst:86-99): the primary collects per-member scrub maps
+    # (oid -> computed crc32c over the bytes, batched on the device where
+    # object sizes group), detects divergent replicas / corrupt EC shards
+    # WITHOUT a client read, and repairs through the recovery machinery.
+
+    def _build_scrub_map(self, pgid: PGid) -> Dict[str, Tuple]:
+        """oid -> (version, size, computed_crc, stored_crc).  Equal-size
+        objects CRC in ONE device dispatch (crc32c_batch); odd sizes fall
+        back to the host path."""
+        import numpy as np
+
+        coll = _coll(pgid)
+        oids = self._list_pg_objects(pgid)
+        blobs = {oid: self.store.read(coll, oid) for oid in oids}
+        by_len: Dict[int, List[str]] = {}
+        for oid, b in blobs.items():
+            by_len.setdefault(len(b), []).append(oid)
+        crcs: Dict[str, int] = {}
+        for ln, group in by_len.items():
+            if len(group) >= 2 and ln > 0:
+                arr = np.stack([
+                    np.frombuffer(blobs[o], dtype=np.uint8) for o in group])
+                vals = np.asarray(crcmod.crc32c_batch(arr))
+                for o, v in zip(group, vals):
+                    crcs[o] = int(v)
+            else:
+                for o in group:
+                    crcs[o] = crcmod.crc32c(0xFFFFFFFF, blobs[o])
+        out = {}
+        for oid in oids:
+            stored = self.store.getattr(coll, oid, "hinfo_crc")
+            out[oid] = (self.store.get_version(coll, oid),
+                        len(blobs[oid]), crcs[oid],
+                        int(stored) if stored is not None else None)
+        return out
+
+    async def scrub_pg(self, st: PGState) -> Dict[str, List[str]]:
+        """Primary-driven scrub of one PG; returns
+        {"inconsistent": [...], "repaired": [...]}."""
+        async with st.lock:
+            return await self._scrub_pg_locked(st)
+
+    async def _scrub_pg_locked(self, st: PGState) -> Dict[str, List[str]]:
+        pool = self.osdmap.pools[st.pgid.pool]
+        members = [o for o in st.acting
+                   if o not in (self.osd_id, CRUSH_ITEM_NONE)]
+        maps: Dict[int, Dict[str, Tuple]] = {
+            self.osd_id: self._build_scrub_map(st.pgid)}
+        for osd in members:
+            reqid = self._next_reqid()
+            fut = self._make_waiter(reqid, 1)
+            try:
+                await self._send_osd(osd, M.MOSDScrub(
+                    reqid=reqid, pgid=st.pgid))
+                acc = await asyncio.wait_for(fut, timeout=5.0)
+                _, reply = acc[0]
+                if reply is not None:
+                    maps[osd] = reply.objects
+            except (asyncio.TimeoutError, ConnectionError):
+                pass
+            finally:
+                self._pending.pop(reqid, None)
+        inconsistent: List[str] = []
+        repaired: List[str] = []
+        if pool.is_erasure():
+            # every shard is distinct: a member is corrupt when the crc of
+            # its bytes no longer matches its stored hinfo crc
+            for osd, smap in maps.items():
+                for oid, (_ver, _size, crc, stored) in smap.items():
+                    if stored is not None and crc != stored:
+                        inconsistent.append(oid)
+                        self.perf.inc("osd_scrub_errors")
+                        bad_shard = {i for i, o in enumerate(st.acting)
+                                     if o == osd}
+                        ok = await self._recover_ec_object(
+                            pool, st, oid, targets=[osd],
+                            exclude_sources=bad_shard)
+                        if ok:
+                            repaired.append(oid)
+        else:
+            # replicated: majority crc wins, divergent members get the
+            # authoritative copy re-pushed
+            all_oids = set()
+            for smap in maps.values():
+                all_oids.update(smap)
+            for oid in sorted(all_oids):
+                votes: Dict[Tuple[int, int], List[int]] = {}
+                for osd, smap in maps.items():
+                    if oid in smap:
+                        ver, size, crc, _ = smap[oid]
+                        votes.setdefault((size, crc), []).append(osd)
+                if len(votes) <= 1 and all(oid in m for m in maps.values()):
+                    continue
+                inconsistent.append(oid)
+                self.perf.inc("osd_scrub_errors")
+                winner = max(votes.values(), key=len)
+                if self.osd_id not in winner:
+                    if not await self._pull_rep_object(st, winner[0], oid):
+                        continue
+                data = self.store.read(_coll(st.pgid), oid)
+                ver = self.store.get_version(_coll(st.pgid), oid)
+                fixed = True
+                for osd in members:
+                    if osd in winner:
+                        continue
+                    try:
+                        await self._send_osd(osd, M.MOSDPGPush(
+                            pgid=st.pgid, oid=oid, op="repair",
+                            data=data, version=ver))
+                        self.perf.inc("osd_pushes_sent")
+                    except ConnectionError:
+                        fixed = False
+                if fixed:
+                    repaired.append(oid)
+        self.perf.inc("osd_scrubs")
+        return {"inconsistent": inconsistent, "repaired": repaired}
+
+    async def _scrub_loop(self) -> None:
+        """Periodic background scrub of primary PGs (reference scrub
+        scheduling; interval 0 disables)."""
+        interval = self.config.osd_scrub_interval
+        if not interval:
+            return
+        while not self._stopped:
+            await asyncio.sleep(interval)
+            for st in list(self.pgs.values()):
+                if st.primary == self.osd_id and not self._stopped:
+                    try:
+                        await self.scrub_pg(st)
+                    except Exception:
+                        self.perf.inc("osd_scrub_errors")
+
     def _handle_push(self, msg: M.MOSDPGPush) -> None:
         coll = _coll(msg.pgid)
         st = self.pgs.get(msg.pgid)
@@ -1117,7 +1453,9 @@ class OSDDaemon(Dispatcher):
         else:
             cur = self.store.get_version(coll, msg.oid)
             exists = self.store.stat(coll, msg.oid) is not None
-            if not (exists and cur >= msg.version):
+            # op == "repair": scrub found silent corruption (same version,
+            # wrong bytes) — apply unconditionally
+            if msg.op == "repair" or not (exists and cur >= msg.version):
                 txn = (Transaction()
                        .remove(coll, msg.oid)
                        .write(coll, msg.oid, 0, msg.data)
@@ -1140,8 +1478,12 @@ class OSDDaemon(Dispatcher):
                 continue
             now = time.monotonic()
             # beacon to the mon (reference MOSDBeacon): lets the mon mark
-            # us down even when no peer reporters survive
-            await self._mon_send(M.MOSDAlive(osd_id=self.osd_id))
+            # us down even when no peer reporters survive; never let a
+            # transport hiccup kill the heartbeat task
+            try:
+                await self._mon_send(M.MOSDAlive(osd_id=self.osd_id))
+            except Exception:
+                pass
             for osd, addr in list(m.osd_addrs.items()):
                 if osd == self.osd_id or not m.osd_up[osd]:
                     continue

@@ -53,6 +53,10 @@ class Transaction:
         self.ops.append(("setattr", coll, oid, name, bytes(value)))
         return self
 
+    def rmattr(self, coll: str, oid: str, name: str):
+        self.ops.append(("rmattr", coll, oid, name))
+        return self
+
     def omap_set(self, coll: str, oid: str, kv: Dict[str, bytes]):
         self.ops.append(("omap_set", coll, oid, dict(kv)))
         return self
@@ -136,6 +140,11 @@ class MemStore(ObjectStore):
         elif kind == "setattr":
             _, coll, oid, name, value = op
             self._coll(coll).setdefault(oid, Obj()).xattrs[name] = value
+        elif kind == "rmattr":
+            _, coll, oid, name = op
+            o = self._coll(coll).get(oid)
+            if o is not None:
+                o.xattrs.pop(name, None)
         elif kind == "omap_set":
             _, coll, oid, kv = op
             self._coll(coll).setdefault(oid, Obj()).omap.update(kv)
@@ -185,6 +194,11 @@ class MemStore(ObjectStore):
         with self._lock:
             o = self._colls.get(coll, {}).get(oid)
             return {} if o is None else dict(o.omap)
+
+    def get_xattrs(self, coll: str, oid: str) -> Dict[str, bytes]:
+        with self._lock:
+            o = self._colls.get(coll, {}).get(oid)
+            return {} if o is None else dict(o.xattrs)
 
     def list_objects(self, coll: str) -> List[str]:
         with self._lock:

@@ -129,6 +129,9 @@ def _fast_config() -> Config:
         mon_osd_beacon_grace=1.5,
         osd_recovery_delay_start=0.05,
         osd_client_op_timeout=5.0,
+        # XLA first-compiles of codec shapes can take tens of seconds on a
+        # loaded CPU; client retries must outlast them
+        rados_osd_op_timeout=90.0,
     )
 
 

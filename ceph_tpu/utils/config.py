@@ -41,6 +41,8 @@ OPTIONS: List[Option] = [
     Option("osd_map_cache_size", int, 50),
     Option("osd_map_batch_min_pgs", int, 256,
            "pools with at least this many PGs use batched placement"),
+    Option("osd_scrub_interval", float, 0.0,
+           "background scrub period per primary PG (0 disables)"),
     # mon
     Option("mon_osd_down_out_interval", float, 30.0,
            "auto-out after down this long"),

@@ -1,0 +1,167 @@
+"""Messenger reliability: sessions, reconnect, ordered replay.
+
+Reference semantics: AsyncConnection out_seq/out_q replay after a session
+reset (src/msg/async/AsyncConnection.cc) — ordered at-least-once delivery
+toward idempotent handlers.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.cluster.messenger import (
+    Connection,
+    Dispatcher,
+    EntityName,
+    Message,
+    Messenger,
+)
+from dataclasses import dataclass, field
+from typing import List
+
+
+@dataclass
+class Num(Message):
+    n: int = 0
+
+
+class Collector(Dispatcher):
+    def __init__(self):
+        self.got: List[int] = []
+
+    async def ms_dispatch(self, conn: Connection, msg) -> bool:
+        if isinstance(msg, Num):
+            self.got.append(msg.n)
+            return True
+        return False
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_reconnect_replays_unacked_in_order():
+    """Kill the TCP connection mid-stream: every message still arrives,
+    in order (duplicates allowed — at-least-once), nothing lost."""
+    async def scenario():
+        rx = Messenger(EntityName("osd", 1))
+        coll = Collector()
+        rx.add_dispatcher(coll)
+        addr = await rx.bind()
+        tx = Messenger(EntityName("osd", 2))
+        try:
+            total = 60
+            for i in range(total):
+                if i in (20, 40):
+                    # hard-drop the transport under the sender's feet
+                    conn = tx._out.get(tuple(addr))
+                    if conn:
+                        conn.writer.close()
+                await tx.send_message(Num(n=i), addr)
+            await asyncio.sleep(0.3)
+            # completeness: every n delivered at least once
+            assert set(coll.got) == set(range(total)), \
+                sorted(set(range(total)) - set(coll.got))
+            # order: the dedup'ed sequence is exactly 0..N-1
+            dedup = []
+            for n in coll.got:
+                if not dedup or n > dedup[-1]:
+                    dedup.append(n)
+            assert dedup == list(range(total))
+        finally:
+            await tx.shutdown()
+            await rx.shutdown()
+
+    run(scenario())
+
+
+def test_reconnect_survives_receiver_restart():
+    """The receiving endpoint dies completely and comes back on the same
+    port: the unacked tail replays to the new incarnation."""
+    async def scenario():
+        rx = Messenger(EntityName("osd", 1))
+        coll = Collector()
+        rx.add_dispatcher(coll)
+        addr = await rx.bind()
+        tx = Messenger(EntityName("osd", 2))
+        try:
+            for i in range(10):
+                await tx.send_message(Num(n=i), addr)
+            await asyncio.sleep(0.1)
+            await rx.shutdown()
+
+            rx2 = Messenger(EntityName("osd", 1))
+            coll2 = Collector()
+            rx2.add_dispatcher(coll2)
+            await rx2.bind(host=addr[0], port=addr[1])
+            try:
+                for i in range(10, 20):
+                    await tx.send_message(Num(n=i), addr)
+                await asyncio.sleep(0.3)
+                got = set(coll2.got)
+                # the new incarnation received at least the new tail; any
+                # unacked old frames replayed too (at-least-once)
+                assert set(range(10, 20)) <= got, sorted(got)
+            finally:
+                await rx2.shutdown()
+        finally:
+            await tx.shutdown()
+
+    run(scenario())
+
+
+def test_unreachable_peer_raises_after_retries():
+    async def scenario():
+        tx = Messenger(EntityName("client", 9))
+        try:
+            with pytest.raises((ConnectionError, OSError)):
+                await tx.send_message(Num(n=1), ("127.0.0.1", 1))
+        finally:
+            await tx.shutdown()
+
+    run(scenario())
+
+
+def test_ec_write_survives_connection_drops():
+    """Cluster-level: EC writes while the primary's osd-osd connections
+    are repeatedly hard-dropped — no silent shard divergence: every
+    object remains readable and every acting shard holder converges."""
+    async def scenario():
+        from ceph_tpu.cluster.vstart import start_cluster
+
+        cluster = await start_cluster(4)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create(
+                "ecdrop", "erasure", pg_num=4,
+                ec_profile={"plugin": "jerasure",
+                            "technique": "reed_sol_van",
+                            "k": "2", "m": "1"})
+            io = client.ioctx(pool)
+            payloads = {}
+            for i in range(12):
+                oid = f"obj{i}"
+                payloads[oid] = f"drop-{i}-".encode() * 120
+                if i % 3 == 1:
+                    # sever every osd-to-osd connection in the cluster
+                    for osd in cluster.osds.values():
+                        for conn in list(osd.messenger._out.values()):
+                            conn.writer.close()
+                await io.write_full(oid, payloads[oid], timeout=60)
+            await asyncio.sleep(0.5)
+            for oid, data in payloads.items():
+                assert await io.read(oid, timeout=60) == data, oid
+            # shard-level convergence: every acting member holds its shard
+            for oid in payloads:
+                pgid = client.objecter.object_pgid(pool, oid)
+                _, _, acting, _ = \
+                    client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+                for o in acting:
+                    if o >= 0 and o in cluster.osds:
+                        assert cluster.osds[o].store.stat(
+                            f"pg_{pgid.pool}_{pgid.seed}", oid) is not None, \
+                            (oid, o)
+        finally:
+            await cluster.stop()
+
+    run(scenario())
