@@ -1,13 +1,17 @@
-"""Monitor: the cluster-map authority.
+"""Monitor: the cluster-map authority (single mon or Paxos quorum).
 
 Mirrors the reference monitor's OSD-map service (src/mon/OSDMonitor.cc):
 boot/failure handling with reporter thresholds (can_mark_down,
-OSDMonitor.cc:1761), down-out ticks, map-epoch broadcast to subscribers
-(MonClient subscription model, src/mon/MonClient.cc:354), and pool-create
-commands that build CRUSH rules through the EC-profile seam
-(ErasureCode::create_rule analog).  Map mutations go through a
-single-authority proposal log (the Paxos seam — multi-mon quorum is the
-next stage; the propose/commit structure is kept so Paxos slots in).
+OSDMonitor.cc:1761), beacon-staleness + down-out ticks, map-epoch
+broadcast to subscribers (MonClient subscription model,
+src/mon/MonClient.cc:354), and pool-create commands that build CRUSH
+rules through the EC-profile seam (ErasureCode::create_rule analog).
+
+Multi-mon mode replicates every map delta through the Paxos machinery in
+cluster/paxos.py (reference src/mon/Paxos.cc + Elector.cc): the elected
+leader proposes, peons accept/commit and forward client commands to the
+leader, leases detect leader death, and any quorum member serves map
+subscriptions from its replicated state.
 """
 
 from __future__ import annotations

@@ -87,6 +87,129 @@ class Bucket:
     def weight(self) -> int:
         return sum(self.weights)
 
+    # -- derived per-alg data (reference builder.c constructions) ----------
+
+    @property
+    def sum_weights(self) -> List[int]:
+        """List bucket prefix sums (crush_make_list_bucket,
+        builder.c:259-272)."""
+        out, w = [], 0
+        for wi in self.weights:
+            w += wi
+            out.append(w)
+        return out
+
+    @property
+    def tree_data(self):
+        """(num_nodes, node_weights) for a tree bucket
+        (crush_make_tree_bucket, builder.c:352-392): item i lives at node
+        (i+1)*2-1; internal nodes sum their subtree weights."""
+        size = self.size
+        if size == 0:
+            return 0, []
+        depth = 1
+        t = size - 1
+        while t:
+            t >>= 1
+            depth += 1
+        num_nodes = 1 << depth
+        nw = [0] * num_nodes
+        for i, wi in enumerate(self.weights):
+            node = ((i + 1) << 1) - 1
+            nw[node] = wi
+            for _ in range(1, depth):
+                node = _tree_parent(node)
+                nw[node] += wi
+        return num_nodes, nw
+
+    def straws(self, straw_calc_version: int = 1) -> List[int]:
+        """Classic straw scaling factors (crush_calc_straw,
+        builder.c:427-540, both calc versions)."""
+        size = self.size
+        weights = self.weights
+        # reverse sort by weight, stable insertion (builder.c:436-454)
+        reverse = [0] if size else []
+        for i in range(1, size):
+            for j in range(i):
+                if weights[i] < weights[reverse[j]]:
+                    reverse.insert(j, i)
+                    break
+            else:
+                reverse.append(i)
+        straws = [0] * size
+        numleft = size
+        straw = 1.0
+        wbelow = 0.0
+        lastw = 0.0
+        i = 0
+        while i < size:
+            if straw_calc_version == 0:
+                if weights[reverse[i]] == 0:
+                    straws[reverse[i]] = 0
+                    i += 1
+                    continue
+                straws[reverse[i]] = int(straw * 0x10000)
+                i += 1
+                if i == size:
+                    break
+                if weights[reverse[i]] == weights[reverse[i - 1]]:
+                    continue
+                wbelow += (weights[reverse[i - 1]] - lastw) * numleft
+                j = i
+                while j < size:
+                    if weights[reverse[j]] == weights[reverse[i]]:
+                        numleft -= 1
+                    else:
+                        break
+                    j += 1
+                wnext = numleft * (weights[reverse[i]] -
+                                   weights[reverse[i - 1]])
+                pbelow = wbelow / (wbelow + wnext)
+                straw *= (1.0 / pbelow) ** (1.0 / numleft)
+                lastw = weights[reverse[i - 1]]
+            else:
+                if weights[reverse[i]] == 0:
+                    straws[reverse[i]] = 0
+                    i += 1
+                    numleft -= 1
+                    continue
+                straws[reverse[i]] = int(straw * 0x10000)
+                i += 1
+                if i == size:
+                    break
+                wbelow += (weights[reverse[i - 1]] - lastw) * numleft
+                numleft -= 1
+                wnext = numleft * (weights[reverse[i]] -
+                                   weights[reverse[i - 1]])
+                pbelow = wbelow / (wbelow + wnext)
+                straw *= (1.0 / pbelow) ** (1.0 / numleft)
+                lastw = weights[reverse[i - 1]]
+        return straws
+
+
+def _tree_height(n: int) -> int:
+    h = 0
+    while (n & 1) == 0:
+        h += 1
+        n >>= 1
+    return h
+
+
+def _tree_parent(n: int) -> int:
+    h = _tree_height(n)
+    if n & (1 << (h + 1)):
+        return n - (1 << h)
+    return n + (1 << h)
+
+
+@dataclass
+class ChooseArg:
+    """Per-bucket straw2 overrides (reference crush_choose_arg,
+    crush.h:273-278): pg-upmap/balancer-era weight sets + id remaps."""
+
+    ids: Optional[List[int]] = None
+    weight_set: Optional[List[List[int]]] = None  # per-position weights
+
 
 @dataclass
 class Rule:
@@ -105,6 +228,13 @@ class CrushMap:
         self.tunables = tunables or Tunables()
         self.type_names: Dict[int, str] = {0: "osd", 1: "host", 2: "rack", 3: "root"}
         self.item_names: Dict[int, str] = {}
+        self.straw_calc_version = 1
+        # named choose_args sets: name -> {bucket_id: ChooseArg}
+        # (reference crush_choose_arg_map, CrushWrapper choose_args)
+        self.choose_args: Dict[str, Dict[int, "ChooseArg"]] = {}
+        # device classes (reference CrushWrapper class_map + shadow trees)
+        self.device_class: Dict[int, str] = {}
+        self._class_shadow: Dict[Tuple[int, str], int] = {}
 
     # -- builder (reference builder.c semantics) ---------------------------
 
@@ -131,6 +261,53 @@ class CrushMap:
                    weights=list(weights)),
             name,
         )
+
+    # -- device classes (reference CrushWrapper device classes: shadow
+    #    per-class hierarchies so rules can take "root~class") -------------
+
+    def set_device_class(self, dev: int, cls: str) -> None:
+        self.device_class[dev] = cls
+        # class changes invalidate every shadow tree (reference rebuilds
+        # them on map mutation); stale shadows would place data on the
+        # wrong class silently.  Old shadow buckets stay in the map
+        # (ids must remain dense) but are no longer reachable.
+        self._class_shadow.clear()
+
+    def class_root(self, root_id: int, cls: str) -> int:
+        """Shadow bucket id for ``root~cls``: a copy of the subtree keeping
+        only devices of the class, weights recomputed bottom-up (the
+        reference's class shadow trees, CrushWrapper::populate_classes)."""
+        key = (root_id, cls)
+        cached = self._class_shadow.get(key)
+        if cached is not None:
+            return cached
+        shadow = self._build_class_shadow(root_id, cls)
+        if shadow is None:
+            raise ValueError(f"no devices of class {cls!r} under {root_id}")
+        self._class_shadow[key] = shadow
+        return shadow
+
+    def _build_class_shadow(self, bid: int, cls: str) -> Optional[int]:
+        b = self.buckets[bid]
+        items: List[int] = []
+        weights: List[int] = []
+        for item, w in zip(b.items, b.weights):
+            if item >= 0:
+                if self.device_class.get(item) == cls:
+                    items.append(item)
+                    weights.append(w)
+            else:
+                sub = self._build_class_shadow(item, cls)
+                if sub is not None:
+                    items.append(sub)
+                    weights.append(self.buckets[sub].weight)
+        if not items:
+            return None
+        name = self.item_names.get(bid)
+        return self.add_bucket(
+            Bucket(id=0, type=b.type, alg=b.alg, hash=b.hash,
+                   items=items, weights=weights),
+            name=f"{name}~{cls}" if name else None)
 
     def add_rule(self, rule: Rule) -> int:
         self.rules.append(rule)

@@ -22,37 +22,72 @@ static struct crush_map *new_map(int total, int local, int fallback,
   return m;
 }
 
-static int add_straw2(struct crush_map *m, int type, int n, int *items, int *weights) {
-  struct crush_bucket *b = crush_make_bucket(m, CRUSH_BUCKET_STRAW2,
+static int add_alg(struct crush_map *m, int alg, int type, int n, int *items, int *weights) {
+  struct crush_bucket *b = crush_make_bucket(m, alg,
                                              CRUSH_HASH_RJENKINS1, type, n, items, weights);
   int id;
   crush_add_bucket(m, 0, b, &id);
   return id;
 }
 
+static int add_straw2(struct crush_map *m, int type, int n, int *items, int *weights) {
+  return add_alg(m, CRUSH_BUCKET_STRAW2, type, n, items, weights);
+}
+
+static const char *alg_name(int alg) {
+  switch (alg) {
+  case CRUSH_BUCKET_UNIFORM: return "uniform";
+  case CRUSH_BUCKET_LIST: return "list";
+  case CRUSH_BUCKET_TREE: return "tree";
+  case CRUSH_BUCKET_STRAW: return "straw";
+  default: return "straw2";
+  }
+}
+
 static void print_bucket(struct crush_map *m, int id, int first) {
   struct crush_bucket *b = m->buckets[-1-id];
   int i;
   if (!first) printf(",");
-  printf("{\"id\":%d,\"type\":%d,\"weight\":%u,\"items\":[", id, b->type, b->weight);
+  printf("{\"id\":%d,\"type\":%d,\"alg\":\"%s\",\"weight\":%u,\"items\":[",
+         id, b->type, alg_name(b->alg), b->weight);
   for (i = 0; i < b->size; i++) printf("%s%d", i?",":"", b->items[i]);
   printf("],\"weights\":[");
   for (i = 0; i < b->size; i++) printf("%s%u", i?",":"", crush_get_bucket_item_weight(b, i));
-  printf("]}");
+  printf("]");
+  /* derived builder data: lets the python side verify ITS builder math */
+  if (b->alg == CRUSH_BUCKET_LIST) {
+    struct crush_bucket_list *lb = (struct crush_bucket_list *)b;
+    printf(",\"sum_weights\":[");
+    for (i = 0; i < b->size; i++) printf("%s%u", i?",":"", lb->sum_weights[i]);
+    printf("]");
+  } else if (b->alg == CRUSH_BUCKET_TREE) {
+    struct crush_bucket_tree *tb = (struct crush_bucket_tree *)b;
+    printf(",\"num_nodes\":%u,\"node_weights\":[", tb->num_nodes);
+    for (i = 0; i < (int)tb->num_nodes; i++) printf("%s%u", i?",":"", tb->node_weights[i]);
+    printf("]");
+  } else if (b->alg == CRUSH_BUCKET_STRAW) {
+    struct crush_bucket_straw *sb = (struct crush_bucket_straw *)b;
+    printf(",\"straws\":[");
+    for (i = 0; i < b->size; i++) printf("%s%u", i?",":"", sb->straws[i]);
+    printf("]");
+  }
+  printf("}");
 }
 
-static void run_scenario(const char *name, struct crush_map *m, int root,
-                         struct crush_rule *rule, __u32 *reweight, int nw,
-                         int result_max) {
+static void run_scenario_args(const char *name, struct crush_map *m, int root,
+                              struct crush_rule *rule, __u32 *reweight, int nw,
+                              int result_max,
+                              struct crush_choose_arg *cargs, int carg_bucket) {
   int ruleno = crush_add_rule(m, rule, -1);
   crush_finalize(m);
   void *cw = malloc(m->working_size + 3 * result_max * sizeof(int));
   int result[16];
   int x, i, b, nb = 0;
   printf("{\"scenario\":\"%s\",\"root\":%d,\"result_max\":%d,", name, root, result_max);
-  printf("\"tunables\":{\"total\":%d,\"local\":%d,\"fallback\":%d,\"descend_once\":%d,\"vary_r\":%d,\"stable\":%d},",
+  printf("\"tunables\":{\"total\":%d,\"local\":%d,\"fallback\":%d,\"descend_once\":%d,\"vary_r\":%d,\"stable\":%d,\"straw_calc\":%d},",
          m->choose_total_tries, m->choose_local_tries, m->choose_local_fallback_tries,
-         m->chooseleaf_descend_once, m->chooseleaf_vary_r, m->chooseleaf_stable);
+         m->chooseleaf_descend_once, m->chooseleaf_vary_r, m->chooseleaf_stable,
+         m->straw_calc_version);
   printf("\"steps\":[");
   for (i = 0; i < rule->len; i++)
     printf("%s[%d,%d,%d]", i?",":"", rule->steps[i].op, rule->steps[i].arg1, rule->steps[i].arg2);
@@ -61,16 +96,40 @@ static void run_scenario(const char *name, struct crush_map *m, int root,
   printf("],\"buckets\":[");
   for (b = 0; b < m->max_buckets; b++)
     if (m->buckets[b]) { print_bucket(m, -1-b, nb==0); nb++; }
-  printf("],\"results\":[");
+  printf("]");
+  if (cargs) {
+    struct crush_choose_arg *a = &cargs[-1-carg_bucket];
+    printf(",\"choose_args\":{\"%d\":{", carg_bucket);
+    if (a->ids) {
+      printf("\"ids\":[");
+      for (i = 0; i < (int)a->ids_size; i++) printf("%s%d", i?",":"", a->ids[i]);
+      printf("],");
+    }
+    printf("\"weight_set\":[");
+    for (b = 0; b < (int)a->weight_set_size; b++) {
+      printf("%s[", b?",":"");
+      for (i = 0; i < (int)a->weight_set[b].size; i++)
+        printf("%s%u", i?",":"", a->weight_set[b].weights[i]);
+      printf("]");
+    }
+    printf("]}}");
+  }
+  printf(",\"results\":[");
   for (x = 0; x < NX; x++) {
     crush_init_workspace(m, cw);
-    int len = crush_do_rule(m, ruleno, x, result, result_max, reweight, nw, cw, NULL);
+    int len = crush_do_rule(m, ruleno, x, result, result_max, reweight, nw, cw, cargs);
     printf("%s[", x?",":"");
     for (i = 0; i < len; i++) printf("%s%d", i?",":"", result[i]);
     printf("]");
   }
   printf("]}\n");
   free(cw);
+}
+
+static void run_scenario(const char *name, struct crush_map *m, int root,
+                         struct crush_rule *rule, __u32 *reweight, int nw,
+                         int result_max) {
+  run_scenario_args(name, m, root, rule, reweight, nw, result_max, NULL, 0);
 }
 
 static struct crush_rule *mk_rule(int type, int op1, int n1, int t1,
@@ -173,6 +232,113 @@ int main(void) {
     struct crush_rule *r = mk_rule(3, CRUSH_RULE_CHOOSE_INDEP, 3, 0, -1, 0, 0);
     r->steps[0].arg1 = root;
     run_scenario("flat_indep", m, root, r, rw, 32, 3);
+    crush_destroy(m);
+  }
+
+  /* ---- scenarios 7-9: flat list / tree / straw buckets ---- */
+  {
+    int algs[3] = { CRUSH_BUCKET_LIST, CRUSH_BUCKET_TREE, CRUSH_BUCKET_STRAW };
+    const char *names[3] = { "flat_list_firstn", "flat_tree_firstn",
+                             "flat_straw_firstn" };
+    int a;
+    for (a = 0; a < 3; a++) {
+      struct crush_map *m = new_map(50, 0, 0, 1, 1, 1);
+      m->straw_calc_version = 1;
+      int items[16], weights[16];
+      __u32 rw[16];
+      for (i = 0; i < 16; i++) { items[i] = i; weights[i] = 0x10000 * (1 + i % 4); }
+      weights[5] = 0;
+      int root = add_alg(m, algs[a], 3, 16, items, weights);
+      for (i = 0; i < 16; i++) rw[i] = 0x10000;
+      rw[2] = 0x8000; rw[9] = 0;
+      struct crush_rule *r = mk_rule(1, CRUSH_RULE_CHOOSE_FIRSTN, 3, 0, -1, 0, 0);
+      r->steps[0].arg1 = root;
+      run_scenario(names[a], m, root, r, rw, 16, 3);
+      crush_destroy(m);
+    }
+  }
+
+  /* ---- scenario 10: straw2 root over list-bucket hosts, chooseleaf ---- */
+  {
+    struct crush_map *m = new_map(50, 0, 0, 1, 1, 1);
+    int hostid[4];
+    for (h = 0; h < 4; h++) {
+      int items[4], weights[4];
+      for (i = 0; i < 4; i++) { items[i] = h * 4 + i; weights[i] = 0x10000 * (1 + ((h + i) % 3)); }
+      hostid[h] = add_alg(m, CRUSH_BUCKET_LIST, 1, 4, items, weights);
+    }
+    int ritems[4], rweights[4];
+    for (h = 0; h < 4; h++) { ritems[h] = hostid[h]; rweights[h] = m->buckets[-1-hostid[h]]->weight; }
+    int root = add_straw2(m, 3, 4, ritems, rweights);
+    __u32 rw[16];
+    for (i = 0; i < 16; i++) rw[i] = 0x10000;
+    rw[6] = 0;
+    struct crush_rule *r = mk_rule(1, CRUSH_RULE_CHOOSELEAF_FIRSTN, 3, 1, -1, 0, 0);
+    r->steps[0].arg1 = root;
+    run_scenario("list_hosts_chooseleaf", m, root, r, rw, 16, 3);
+    crush_destroy(m);
+  }
+
+  /* ---- scenario 10b: classic straw with calc version 0 ---- */
+  {
+    struct crush_map *m = new_map(50, 0, 0, 1, 1, 1);
+    m->straw_calc_version = 0;
+    int items[10], weights[10];
+    __u32 rw[10];
+    for (i = 0; i < 10; i++) { items[i] = i; weights[i] = 0x10000 * (1 + i % 3); }
+    weights[3] = 0;
+    int root = add_alg(m, CRUSH_BUCKET_STRAW, 3, 10, items, weights);
+    for (i = 0; i < 10; i++) rw[i] = 0x10000;
+    struct crush_rule *r = mk_rule(1, CRUSH_RULE_CHOOSE_FIRSTN, 3, 0, -1, 0, 0);
+    r->steps[0].arg1 = root;
+    run_scenario("flat_straw_v0_firstn", m, root, r, rw, 10, 3);
+    crush_destroy(m);
+  }
+
+  /* ---- scenario 11: tree indep ---- */
+  {
+    struct crush_map *m = new_map(50, 0, 0, 1, 1, 1);
+    int items[12], weights[12];
+    __u32 rw[12];
+    for (i = 0; i < 12; i++) { items[i] = i; weights[i] = 0x10000 * (1 + i % 2); }
+    int root = add_alg(m, CRUSH_BUCKET_TREE, 3, 12, items, weights);
+    for (i = 0; i < 12; i++) rw[i] = 0x10000;
+    rw[4] = 0;
+    struct crush_rule *r = mk_rule(3, CRUSH_RULE_CHOOSE_INDEP, 3, 0, -1, 0, 0);
+    r->steps[0].arg1 = root;
+    run_scenario("flat_tree_indep", m, root, r, rw, 12, 3);
+    crush_destroy(m);
+  }
+
+  /* ---- scenario 12: straw2 with choose_args (weight_set + ids) ---- */
+  {
+    struct crush_map *m = new_map(50, 0, 0, 1, 1, 1);
+    int items[16], weights[16];
+    __u32 rw[16];
+    for (i = 0; i < 16; i++) { items[i] = i; weights[i] = 0x10000 * (1 + i % 3); }
+    int root = add_straw2(m, 3, 16, items, weights);
+    for (i = 0; i < 16; i++) rw[i] = 0x10000;
+    crush_finalize(m);
+    /* choose_args indexed by -1-id over max_buckets */
+    struct crush_choose_arg *cargs = calloc(m->max_buckets, sizeof(*cargs));
+    static __u32 ws0[16], ws1[16];
+    static __s32 aids[16];
+    static struct crush_weight_set wsets[2];
+    for (i = 0; i < 16; i++) {
+      ws0[i] = 0x8000 * (1 + (i % 5));      /* balancer-style reweights */
+      ws1[i] = 0x10000 * (1 + ((i + 2) % 4));
+      aids[i] = i * 7 + 1;                  /* id remap perturbs the hash */
+    }
+    wsets[0].weights = ws0; wsets[0].size = 16;
+    wsets[1].weights = ws1; wsets[1].size = 16;
+    cargs[-1-root].ids = aids;
+    cargs[-1-root].ids_size = 16;
+    cargs[-1-root].weight_set = wsets;
+    cargs[-1-root].weight_set_size = 2;
+    struct crush_rule *r = mk_rule(1, CRUSH_RULE_CHOOSE_FIRSTN, 3, 0, -1, 0, 0);
+    r->steps[0].arg1 = root;
+    run_scenario_args("straw2_choose_args", m, root, r, rw, 16, 3, cargs, root);
+    free(cargs);
     crush_destroy(m);
   }
 

@@ -1,0 +1,100 @@
+"""CrushTester batch verifier + device classes + non-straw2 bucket algs
+through the full rule VM.
+
+Reference: CrushTester::test distribution checks (CrushTester.cc:472),
+CrushWrapper device classes (shadow per-class trees).
+"""
+
+import pytest
+
+from ceph_tpu.crush import CrushMap, Rule, ScalarMapper, Tunables, Bucket
+from ceph_tpu.crush.tester import CrushTester
+from ceph_tpu.crush.types import (
+    CRUSH_ITEM_NONE,
+    ChooseArg,
+    RULE_CHOOSE_FIRSTN,
+    RULE_CHOOSELEAF_FIRSTN,
+    RULE_EMIT,
+    RULE_TAKE,
+    build_hierarchy,
+)
+
+
+def _flat_map(alg: str, n: int = 12, numrep: int = 3):
+    cmap = CrushMap(Tunables())
+    root = cmap.add_bucket(Bucket(
+        id=0, type=3, alg=alg, items=list(range(n)),
+        weights=[0x10000 * (1 + i % 3) for i in range(n)]), name="root")
+    cmap.add_rule(Rule(steps=[
+        (RULE_TAKE, root, 0),
+        (RULE_CHOOSE_FIRSTN, numrep, 0),
+        (RULE_EMIT, 0, 0)]))
+    return cmap
+
+
+@pytest.mark.parametrize("alg", ["straw2", "list", "tree", "straw"])
+def test_tester_distribution_tracks_weights(alg):
+    cmap = _flat_map(alg)
+    report = CrushTester(cmap).test(0, 3, 0, 2047)
+    assert report.n_inputs == 2048
+    assert not report.bad_mappings
+    assert report.total_placements == 2048 * 3
+    # distribution follows the 1:2:3 weight pattern within tolerance
+    assert report.max_deviation < 0.03, report.summary()
+    heavy = report.device_counts[2]   # weight 3
+    light = report.device_counts[0]   # weight 1
+    assert heavy > light * 1.8, report.summary()
+
+
+def test_tester_reports_bad_mappings():
+    # 3 devices, numrep 4: every mapping is short
+    cmap = _flat_map("straw2", n=3, numrep=4)
+    report = CrushTester(cmap).test(0, 4, 0, 63)
+    assert len(report.bad_mappings) == 64
+
+
+def test_tester_respects_reweight():
+    cmap = _flat_map("straw2")
+    w = [0x10000] * 12
+    w[0] = 0  # fully reweighted out
+    report = CrushTester(cmap).test(0, 3, 0, 1023, weights=w)
+    assert report.device_counts.get(0, 0) == 0
+
+
+def test_choose_args_shift_distribution():
+    cmap = _flat_map("straw2")
+    # flatten every weight to equal via choose_args: distribution evens out
+    cmap.choose_args["balanced"] = {
+        -1: ChooseArg(weight_set=[[0x10000] * 12])}
+    base = CrushTester(cmap).test(0, 3, 0, 2047)
+    bal = CrushTester(cmap).test(0, 3, 0, 2047, choose_args="balanced")
+    spread_base = max(base.device_counts.values()) / \
+        min(base.device_counts.values())
+    spread_bal = max(bal.device_counts.values()) / \
+        min(bal.device_counts.values())
+    assert spread_bal < spread_base
+    assert spread_bal < 1.25
+
+
+def test_device_classes_shadow_tree():
+    cmap, _ = build_hierarchy(4, 2, numrep=3)
+    # tag half the devices ssd, half hdd
+    for dev in range(8):
+        cmap.set_device_class(dev, "ssd" if dev % 2 == 0 else "hdd")
+    root = max(cmap.buckets,
+               key=lambda b: cmap.buckets[b].type)
+    shadow = cmap.class_root(root, "ssd")
+    rule = cmap.add_rule(Rule(steps=[
+        (RULE_TAKE, shadow, 0),
+        (RULE_CHOOSELEAF_FIRSTN, 2, 1),
+        (RULE_EMIT, 0, 0)]))
+    sm = ScalarMapper(cmap)
+    for x in range(128):
+        out = sm.do_rule(rule, x, 2, [0x10000] * 8)
+        for d in out:
+            if d != CRUSH_ITEM_NONE:
+                assert cmap.device_class[d] == "ssd", (x, out)
+    # shadow weight = exactly the 4 ssd devices at 0x10000 each
+    assert cmap.buckets[shadow].weight == 4 * 0x10000
+    with pytest.raises(ValueError):
+        cmap.class_root(root, "nvme")
