@@ -125,6 +125,39 @@ class MOSDOpReply(Message):
 
 
 @dataclass
+class MCommand(Message):
+    """Daemon-directed admin command (reference MCommand / the admin
+    socket surface: 'ceph tell osd.N <cmd>')."""
+
+    tid: int = 0
+    cmd: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class MCommandReply(Message):
+    tid: int = 0
+    result: int = 0
+    data: Any = None
+
+
+@dataclass
+class MMgrReport(Message):
+    """Perf-counter stream to the mgr (reference MMgrReport,
+    MgrClient::send_report, src/mgr/MgrClient.cc:232)."""
+
+    daemon: str = ""
+    counters: Dict[str, Any] = field(default_factory=dict)
+    stamp: float = 0.0
+
+
+@dataclass
+class MMgrBeacon(Message):
+    """Mgr announces itself to the mon (reference MMgrBeacon)."""
+
+    addr: Optional[Addr] = None
+
+
+@dataclass
 class MWatchNotify(Message):
     """Watcher callback delivery (reference MWatchNotify): sent by the
     primary OSD to every registered watcher when a notify op fires."""

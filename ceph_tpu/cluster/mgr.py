@@ -1,0 +1,77 @@
+"""Mgr: the metrics/management daemon.
+
+Behavioral mirror of the reference ceph-mgr core loop (src/mgr/): daemons
+stream their perf counters as MMgrReport (MgrClient::send_report,
+src/mgr/MgrClient.cc:232), the mgr keeps per-daemon state
+(DaemonState/DaemonPerfCounters, src/mgr/DaemonState.h:65) and serves
+aggregated views over admin commands — the substrate the reference's
+dashboard/restful python modules sit on.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, Optional
+
+from ceph_tpu.cluster import messages as M
+from ceph_tpu.cluster.messenger import Addr, Connection, Dispatcher, EntityName, Messenger
+from ceph_tpu.cluster.monclient import MonTargeter
+from ceph_tpu.utils import Config, PerfCounters
+
+
+class MgrDaemon(Dispatcher):
+    def __init__(self, mon_addr, config: Optional[Config] = None,
+                 rank: int = 0):
+        self.rank = rank
+        # per-daemon config copy: injectargs on one daemon must never
+        # leak into another (each reference daemon owns its md_config_t)
+        self.config = Config(**config.show()) if config else Config()
+        self.messenger = Messenger(EntityName("mgr", rank))
+        self.messenger.add_dispatcher(self)
+        self.monc = MonTargeter(self.messenger, mon_addr)
+        self.perf = PerfCounters(f"mgr.{rank}")
+        # daemon -> {counters, last_report} (DaemonStateIndex analog)
+        self.daemons: Dict[str, Dict] = {}
+        self._stopped = False
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> Addr:
+        addr = await self.messenger.bind(host, port)
+        # announce to the mon; the mon publishes us through the osdmap
+        # (MgrMap analog) so daemons learn where to report
+        await self.monc.send(M.MMgrBeacon(addr=addr), raise_on_fail=True)
+        return addr
+
+    async def stop(self) -> None:
+        self._stopped = True
+        await self.messenger.shutdown()
+
+    async def ms_dispatch(self, conn: Connection, msg) -> bool:
+        if isinstance(msg, M.MMgrReport):
+            self.daemons[msg.daemon] = {
+                "counters": msg.counters,
+                "last_report": time.monotonic(),
+            }
+            self.perf.inc("mgr_reports")
+            return True
+        if isinstance(msg, M.MCommand):
+            result, data = 0, None
+            prefix = msg.cmd.get("prefix")
+            if prefix == "mgr status":
+                data = {
+                    "daemons": sorted(self.daemons),
+                    "reports": self.perf.get("mgr_reports"),
+                }
+            elif prefix == "counter dump":
+                data = {d: s["counters"] for d, s in self.daemons.items()}
+            elif prefix == "counter sum":
+                # aggregate one counter across daemons
+                name = msg.cmd.get("counter", "")
+                data = sum(s["counters"].get(name, 0)
+                           for s in self.daemons.values())
+            else:
+                result = -22
+            await conn.send(M.MCommandReply(tid=msg.tid, result=result,
+                                            data=data))
+            return True
+        return False

@@ -45,7 +45,9 @@ class Monitor(Dispatcher):
                  rank: int = 0, n_mons: int = 1):
         self.rank = rank
         self.n_mons = n_mons
-        self.config = config or Config()
+        # per-daemon config copy: injectargs on one daemon must never
+        # leak into another (each reference daemon owns its md_config_t)
+        self.config = Config(**config.show()) if config else Config()
         self.osdmap = osdmap
         self.messenger = Messenger(EntityName("mon", rank))
         self.messenger.add_dispatcher(self)
@@ -234,6 +236,22 @@ class Monitor(Dispatcher):
             elif 0 <= msg.osd_id < self.osdmap.max_osd:
                 self.last_beacon[msg.osd_id] = time.monotonic()
             return True
+        if isinstance(msg, M.MMgrBeacon):
+            if not self.is_leader:
+                if self.leader_rank is not None and \
+                        self.leader_rank != self.rank:
+                    try:
+                        await self._send_mon(self.leader_rank, msg)
+                    except (ConnectionError, OSError):
+                        pass
+                return True
+            async with self._map_mutex:
+                if self.osdmap.mgr_addr != tuple(msg.addr):
+                    inc = self._new_inc()
+                    inc.new_mgr_addr = tuple(msg.addr)
+                    self.perf.inc("mon_mgr_beacons")
+                    await self._commit_inc(inc)
+            return True
         if isinstance(msg, M.MMonSubscribe):
             self.subscribers.add(tuple(msg.addr))
             await self._send_map(tuple(msg.addr), since=msg.since)
@@ -331,6 +349,25 @@ class Monitor(Dispatcher):
                     inc.new_weights[int(cmd["id"])] = 0x10000
                     if not await self._commit_inc(inc):
                         result, data = -11, "quorum lost"
+            elif prefix == "injectargs":
+                # fan the config mutation out to the targeted daemons
+                # (reference injectargs via mon 'ceph tell')
+                who = cmd.get("who", "osd.*")
+                args = cmd.get("args", {})
+                sent = 0
+                for o, addr in list(self.osdmap.osd_addrs.items()):
+                    if who not in ("osd.*", f"osd.{o}"):
+                        continue
+                    if not self.osdmap.osd_up[o]:
+                        continue
+                    try:
+                        await self.messenger.send_message(M.MCommand(
+                            cmd={"prefix": "injectargs", "args": args}),
+                            tuple(addr))
+                        sent += 1
+                    except (ConnectionError, OSError):
+                        pass
+                data = {"notified": sent}
             elif prefix == "status":
                 m = self.osdmap
                 data = {

@@ -45,6 +45,7 @@ class Objecter(Dispatcher):
         self._inflight: Dict[Tuple[str, int], asyncio.Future] = {}
         self._mon_tid = 0
         self._mon_inflight: Dict[int, asyncio.Future] = {}
+        self._cmd_inflight: Dict[int, asyncio.Future] = {}
         # linger ops (watches) re-registered on every map change
         # (reference Objecter::linger_register, Objecter.cc:778)
         self._cookie = 0
@@ -113,6 +114,11 @@ class Objecter(Dispatcher):
             if fut and not fut.done():
                 fut.set_result(msg)
             return True
+        if isinstance(msg, M.MCommandReply):
+            fut = self._cmd_inflight.pop(msg.tid, None)
+            if fut and not fut.done():
+                fut.set_result(msg)
+            return True
         return False
 
     # -- targeting (reference _calc_target) --------------------------------
@@ -142,13 +148,15 @@ class Objecter(Dispatcher):
 
     async def op_submit(self, pool_id: int, oid: str,
                         ops: List[Tuple[str, Dict[str, Any]]],
-                        timeout: Optional[float] = None) -> M.MOSDOpReply:
+                        timeout: Optional[float] = None,
+                        pgid=None) -> M.MOSDOpReply:
         if timeout is None:
             timeout = self.config.rados_osd_op_timeout
         deadline = asyncio.get_event_loop().time() + timeout
         backoff = 0.05
+        explicit_pgid = pgid
         while True:
-            pgid = self.object_pgid(pool_id, oid)
+            pgid = explicit_pgid if explicit_pgid is not None                 else self.object_pgid(pool_id, oid)
             primary = self._target_osd(pgid)
             addr = self.osdmap.osd_addrs.get(primary) if primary >= 0 else None
             if addr is not None:
@@ -235,6 +243,24 @@ class Objecter(Dispatcher):
         self._watches.pop((pool_id, oid, cookie), None)
         await self.op_submit(pool_id, oid, [("unwatch", {"cookie": cookie})])
 
+    async def daemon_command(self, addr, cmd: Dict[str, Any],
+                             timeout: float = 10.0):
+        """Admin command straight to a daemon ('ceph tell' / admin-socket
+        analog): osd perf dump, dump_historic_ops, mgr status, ..."""
+        self._mon_tid += 1
+        tid = self._mon_tid
+        fut = asyncio.get_event_loop().create_future()
+        self._cmd_inflight[tid] = fut
+        try:
+            await self.messenger.send_message(
+                M.MCommand(cmd=cmd, tid=tid), tuple(addr))
+            reply = await asyncio.wait_for(fut, timeout=timeout)
+        finally:
+            self._cmd_inflight.pop(tid, None)
+        if reply.result != 0:
+            raise RuntimeError(f"daemon command failed: {reply.data}")
+        return reply.data
+
     async def mon_command(self, cmd: Dict[str, Any], timeout: float = 10.0):
         """Command with failover: retries against the other monitors when
         the current one dies or has no leader (commands are idempotent at
@@ -316,6 +342,21 @@ class IoCtx:
         if reply.result != 0:
             raise FileNotFoundError(oid)
         return reply.data
+
+    async def list_objects(self) -> List[str]:
+        """Pool-wide object listing: one list op per PG against its
+        primary (librados NObjectIterator analog)."""
+        from ceph_tpu.osdmap.osdmap import PGid
+
+        pool = self.objecter.osdmap.pools[self.pool_id]
+        replies = await asyncio.gather(*[
+            self.objecter.op_submit(self.pool_id, "", [("list", {})],
+                                    pgid=PGid(self.pool_id, seed))
+            for seed in range(pool.pg_num)])
+        names: List[str] = []
+        for reply in replies:
+            names.extend(reply.data or [])
+        return sorted(names)
 
     # -- xattrs (librados rados_getxattr/setxattr family) -------------------
 

@@ -1,0 +1,84 @@
+"""OpTracker: in-flight + historic op tracing.
+
+Behavioral mirror of the reference's TrackedOp machinery
+(src/common/TrackedOp.cc, src/osd/OpRequest.cc): every tracked op records
+timestamped events from arrival to completion; the tracker keeps the
+in-flight set plus ring buffers of the most recent and the slowest
+completed ops, served by the admin commands dump_ops_in_flight /
+dump_historic_ops / dump_historic_slow_ops.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from typing import Deque, Dict, List, Optional
+
+
+class TrackedOp:
+    def __init__(self, tracker: "OpTracker", desc: str):
+        self._tracker = tracker
+        self.seq = next(tracker._seq)
+        self.desc = desc
+        self.start = time.monotonic()
+        self.events: List[tuple] = [(0.0, "initiated")]
+        self.duration: Optional[float] = None
+
+    def mark(self, event: str) -> None:
+        self.events.append((time.monotonic() - self.start, event))
+
+    def finish(self) -> None:
+        if self.duration is None:
+            self.mark("done")
+            self.duration = time.monotonic() - self.start
+            self._tracker._finished(self)
+
+    def dump(self) -> Dict:
+        return {
+            "seq": self.seq,
+            "description": self.desc,
+            "age": time.monotonic() - self.start,
+            "duration": self.duration,
+            "type_data": {"events": [
+                {"time": round(t, 6), "event": e} for t, e in self.events]},
+        }
+
+
+class OpTracker:
+    def __init__(self, history_size: int = 20, slow_size: int = 20,
+                 slow_threshold: float = 0.0):
+        self._seq = itertools.count(1)
+        self._in_flight: Dict[int, TrackedOp] = {}
+        self._history: Deque[TrackedOp] = deque(maxlen=history_size)
+        self._slowest: List[TrackedOp] = []
+        self._slow_size = slow_size
+        self.slow_threshold = slow_threshold
+
+    def create(self, desc: str) -> TrackedOp:
+        op = TrackedOp(self, desc)
+        self._in_flight[op.seq] = op
+        return op
+
+    def _finished(self, op: TrackedOp) -> None:
+        self._in_flight.pop(op.seq, None)
+        self._history.append(op)
+        if op.duration is not None and \
+                op.duration >= self.slow_threshold:
+            self._slowest.append(op)
+            self._slowest.sort(key=lambda o: -(o.duration or 0))
+            del self._slowest[self._slow_size:]
+
+    # -- admin-command surfaces (reference dump_historic_ops et al.) --------
+
+    def dump_ops_in_flight(self) -> Dict:
+        ops = sorted(self._in_flight.values(), key=lambda o: o.seq)
+        return {"num_ops": len(ops), "ops": [o.dump() for o in ops]}
+
+    def dump_historic_ops(self) -> Dict:
+        return {"num_ops": len(self._history),
+                "ops": [o.dump() for o in self._history]}
+
+    def dump_historic_slow_ops(self) -> Dict:
+        return {"num_ops": len(self._slowest),
+                "ops": [o.dump() for o in self._slowest]}

@@ -83,7 +83,9 @@ class OSDDaemon(Dispatcher):
                  config: Optional[Config] = None,
                  store: Optional[ObjectStore] = None):
         self.osd_id = osd_id
-        self.config = config or Config()
+        # per-daemon config copy: injectargs on one daemon must never
+        # leak into another (each reference daemon owns its md_config_t)
+        self.config = Config(**config.show()) if config else Config()
         self.store = store or MemStore()
         self.messenger = Messenger(EntityName("osd", osd_id))
         self.messenger.add_dispatcher(self)
@@ -96,6 +98,9 @@ class OSDDaemon(Dispatcher):
         self.osdmap: Optional[OSDMap] = None
         self.pgs: Dict[PGid, PGState] = {}
         self.perf = PerfCounters(f"osd.{osd_id}")
+        from ceph_tpu.cluster.optracker import OpTracker
+
+        self.tracker = OpTracker()
         self._codecs: Dict[int, object] = {}
         self._pending: Dict[Tuple, Tuple[asyncio.Future, List]] = {}
         self._tid = 0
@@ -330,6 +335,9 @@ class OSDDaemon(Dispatcher):
         if isinstance(msg, MOSDPGQueryReply):
             self._ack(("pgq", str(msg.pgid), msg.src.num), 0, msg)
             return True
+        if isinstance(msg, M.MCommand):
+            await self._handle_admin_command(conn, msg)
+            return True
         if isinstance(msg, M.MPing):
             if msg.reply:
                 if msg.src is not None:
@@ -338,6 +346,44 @@ class OSDDaemon(Dispatcher):
                 await conn.send(M.MPing(stamp=msg.stamp, reply=True))
             return True
         return False
+
+    async def _handle_admin_command(self, conn: Connection,
+                                    msg: M.MCommand) -> None:
+        """Admin-socket surface (reference AdminSocket commands: perf
+        dump, dump_historic_ops, config show, injectargs, scrub)."""
+        cmd = msg.cmd
+        prefix = cmd.get("prefix")
+        result, data = 0, None
+        try:
+            if prefix == "perf dump":
+                data = self.perf.dump()
+            elif prefix == "dump_ops_in_flight":
+                data = self.tracker.dump_ops_in_flight()
+            elif prefix == "dump_historic_ops":
+                data = self.tracker.dump_historic_ops()
+            elif prefix == "dump_historic_slow_ops":
+                data = self.tracker.dump_historic_slow_ops()
+            elif prefix == "config show":
+                data = self.config.show()
+            elif prefix == "injectargs":
+                self.config.injectargs(cmd.get("args", {}))
+                self.perf.inc("osd_injectargs")
+            elif prefix == "scrub":
+                reports = {}
+                for pgid, st in list(self.pgs.items()):
+                    if st.primary == self.osd_id:
+                        reports[str(pgid)] = await self.scrub_pg(st)
+                data = reports
+            else:
+                result = -22
+        except Exception as e:
+            result, data = -22, repr(e)
+        if msg.tid or prefix != "injectargs":
+            try:
+                await conn.send(M.MCommandReply(
+                    tid=msg.tid, result=result, data=data))
+            except (ConnectionError, OSError):
+                pass
 
     # -------------------------------------------------------------- helpers
 
@@ -501,6 +547,16 @@ class OSDDaemon(Dispatcher):
             self.perf.inc("osd_misdirected_ops")
             return
         self.perf.inc("osd_client_ops")
+        top = self.tracker.create(
+            f"osd_op({msg.reqid[0]}:{msg.reqid[1]} {msg.oid} "
+            f"{[o[0] for o in msg.ops]})")
+        top.mark("dispatched")
+        try:
+            await self._execute_client_ops(conn, msg, m, pool, st, top)
+        finally:
+            top.finish()
+
+    async def _execute_client_ops(self, conn, msg, m, pool, st, top):
         for opname, args in msg.ops:
             if opname == "write_full":
                 async with st.lock:
@@ -1484,6 +1540,16 @@ class OSDDaemon(Dispatcher):
                 await self._mon_send(M.MOSDAlive(osd_id=self.osd_id))
             except Exception:
                 pass
+            # perf-counter stream to the active mgr (MgrClient::send_report)
+            mgr_addr = getattr(m, "mgr_addr", None)
+            if mgr_addr:
+                try:
+                    await self.messenger.send_message(M.MMgrReport(
+                        daemon=f"osd.{self.osd_id}",
+                        counters=self.perf.dump()[f"osd.{self.osd_id}"],
+                        stamp=now), tuple(mgr_addr))
+                except (ConnectionError, OSError, RuntimeError):
+                    pass
             for osd, addr in list(m.osd_addrs.items()):
                 if osd == self.osd_id or not m.osd_up[osd]:
                     continue

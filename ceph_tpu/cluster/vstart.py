@@ -15,6 +15,7 @@ import asyncio
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ceph_tpu.cluster.mgr import MgrDaemon
 from ceph_tpu.cluster.mon import Monitor
 from ceph_tpu.cluster.objecter import RadosClient
 from ceph_tpu.cluster.osd import OSDDaemon
@@ -32,6 +33,8 @@ class Cluster:
     config: Config
     mon_addrs: List[tuple] = field(default_factory=list)
     clients: List[RadosClient] = field(default_factory=list)
+    mgr: Optional[MgrDaemon] = None
+    mgr_addr: Optional[tuple] = None
 
     @property
     def mon(self) -> Monitor:
@@ -112,6 +115,8 @@ class Cluster:
     async def stop(self) -> None:
         for c in self.clients:
             await c.shutdown()
+        if self.mgr is not None:
+            await self.mgr.stop()
         for osd in self.osds.values():
             await osd.stop()
         for m in self.mons:
@@ -137,7 +142,8 @@ def _fast_config() -> Config:
 
 async def start_cluster(n_osds: int = 3, osds_per_host: int = 1,
                         config: Optional[Config] = None,
-                        store_factory=None, n_mons: int = 1) -> Cluster:
+                        store_factory=None, n_mons: int = 1,
+                        with_mgr: bool = False) -> Cluster:
     """Boot the mon quorum + OSDs and wait for everything up in the map.
 
     ``store_factory(osd_id) -> ObjectStore`` selects the backing store
@@ -168,6 +174,9 @@ async def start_cluster(n_osds: int = 3, osds_per_host: int = 1,
             mon.set_monmap(mon_addrs)
         await mons[0].begin_elections()
         await cluster.wait_for_leader()
+    if with_mgr:
+        cluster.mgr = MgrDaemon(cluster.mon_addr, config=config)
+        cluster.mgr_addr = await cluster.mgr.start()
     for o in range(n_osds):
         osd = OSDDaemon(o, cluster.mon_addr, config=config,
                         store=store_factory(o) if store_factory else None)

@@ -119,6 +119,7 @@ class Incremental:
     new_pg_temp: Dict["PGid", List[int]] = field(default_factory=dict)
     new_primary_temp: Dict["PGid", int] = field(default_factory=dict)
     new_primary_affinity: Dict[int, int] = field(default_factory=dict)
+    new_mgr_addr: object = None  # mgr registration (reference MgrMap)
 
 
 class OSDMap:
@@ -129,6 +130,7 @@ class OSDMap:
         self.osd_exists = [True] * self.max_osd
         self.osd_up = [True] * self.max_osd
         self.osd_weight = [0x10000] * self.max_osd  # in/out weight
+        self.mgr_addr = None  # active mgr (reference MgrMap active addr)
         self.osd_primary_affinity: Optional[List[int]] = None
         self.pools: Dict[int, PGPool] = {}
         self.pg_upmap: Dict[PGid, List[int]] = {}
@@ -216,6 +218,8 @@ class OSDMap:
                 self.osd_weight[osd] = w
         for osd, aff in inc.new_primary_affinity.items():
             self.set_primary_affinity(osd, aff)
+        if inc.new_mgr_addr is not None:
+            self.mgr_addr = tuple(inc.new_mgr_addr)
         for pg, temp in inc.new_pg_temp.items():
             if temp:
                 self.pg_temp[pg] = list(temp)

@@ -1,0 +1,2 @@
+"""Operator tools (reference src/tools/): crushtool, osdmaptool, rados,
+objectstore-tool analogs, runnable as ``python -m ceph_tpu.tools.<name>``."""

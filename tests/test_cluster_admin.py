@@ -1,0 +1,134 @@
+"""Admin/observability surfaces: OpTracker, admin commands, mgr perf
+streams, injectargs.
+
+Reference: src/common/TrackedOp.cc (dump_historic_ops), AdminSocket
+commands, MgrClient::send_report (src/mgr/MgrClient.cc:232), injectargs.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.cluster.optracker import OpTracker
+from ceph_tpu.cluster.vstart import _fast_config, start_cluster
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_optracker_unit():
+    t = OpTracker(history_size=3)
+    ops = []
+    for i in range(5):
+        op = t.create(f"op{i}")
+        op.mark("queued")
+        op.finish()
+        ops.append(op)
+    live = t.create("inflight")
+    inflight = t.dump_ops_in_flight()
+    assert inflight["num_ops"] == 1
+    assert inflight["ops"][0]["description"] == "inflight"
+    hist = t.dump_historic_ops()
+    assert hist["num_ops"] == 3  # ring buffer keeps the newest 3
+    assert [o["description"] for o in hist["ops"]] == ["op2", "op3", "op4"]
+    assert all(o["duration"] is not None for o in hist["ops"])
+    events = hist["ops"][0]["type_data"]["events"]
+    assert [e["event"] for e in events] == ["initiated", "queued", "done"]
+    live.finish()
+    slow = t.dump_historic_slow_ops()
+    assert slow["num_ops"] >= 1
+
+
+def test_admin_commands_and_historic_ops():
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("ap", "replicated",
+                                            pg_num=8, size=2)
+            io = client.ioctx(pool)
+            for i in range(5):
+                await io.write_full(f"o{i}", b"x" * 100)
+                await io.read(f"o{i}")
+
+            pgid = client.objecter.object_pgid(pool, "o0")
+            _, _, _, primary = \
+                client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+            addr = client.objecter.osdmap.osd_addrs[primary]
+
+            # historic op dump shows real ops with event timelines
+            hist = await client.objecter.daemon_command(
+                addr, {"prefix": "dump_historic_ops"})
+            assert hist["num_ops"] >= 1
+            assert any("osd_op" in o["description"] for o in hist["ops"])
+            # perf dump over the same channel
+            perf = await client.objecter.daemon_command(
+                addr, {"prefix": "perf dump"})
+            assert perf[f"osd.{primary}"]["osd_client_ops"] >= 1
+            # config show
+            cfg = await client.objecter.daemon_command(
+                addr, {"prefix": "config show"})
+            assert "osd_heartbeat_interval" in cfg
+            # remote scrub trigger
+            rep = await client.objecter.daemon_command(
+                addr, {"prefix": "scrub"}, timeout=30)
+            assert isinstance(rep, dict)
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_injectargs_via_mon():
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            before = cluster.osds[1].config.osd_recovery_delay_start
+            await client.objecter.mon_command({
+                "prefix": "injectargs", "who": "osd.1",
+                "args": {"osd_recovery_delay_start": 7.5}})
+            deadline = asyncio.get_event_loop().time() + 5
+            while asyncio.get_event_loop().time() < deadline:
+                if cluster.osds[1].config.osd_recovery_delay_start == 7.5:
+                    break
+                await asyncio.sleep(0.05)
+            assert cluster.osds[1].config.osd_recovery_delay_start == 7.5
+            # other osds untouched
+            assert cluster.osds[0].config.osd_recovery_delay_start == before
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_mgr_receives_perf_streams():
+    async def scenario():
+        cfg = _fast_config()
+        cluster = await start_cluster(3, config=cfg, with_mgr=True)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("mp", "replicated",
+                                            pg_num=8, size=2)
+            io = client.ioctx(pool)
+            await io.write_full("obj", b"mgr" * 100)
+            # wait for reports to stream in (every heartbeat tick)
+            deadline = asyncio.get_event_loop().time() + 10
+            while asyncio.get_event_loop().time() < deadline:
+                if len(cluster.mgr.daemons) >= 3:
+                    break
+                await asyncio.sleep(0.1)
+            assert len(cluster.mgr.daemons) >= 3
+
+            status = await client.objecter.daemon_command(
+                cluster.mgr_addr, {"prefix": "mgr status"})
+            assert set(status["daemons"]) >= {"osd.0", "osd.1", "osd.2"}
+            total_ops = await client.objecter.daemon_command(
+                cluster.mgr_addr,
+                {"prefix": "counter sum", "counter": "osd_client_ops"})
+            assert total_ops >= 1
+        finally:
+            await cluster.stop()
+
+    run(scenario())
