@@ -38,12 +38,23 @@ class MgrDaemon(Dispatcher):
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> Addr:
         addr = await self.messenger.bind(host, port)
         # announce to the mon; the mon publishes us through the osdmap
-        # (MgrMap analog) so daemons learn where to report
+        # (MgrMap analog) so daemons learn where to report.  Beacons
+        # REPEAT: a single one can land on a leaderless mon mid-election
+        # and be dropped silently (the mon only commits from its leader)
         await self.monc.send(M.MMgrBeacon(addr=addr), raise_on_fail=True)
+        self._beacon_task = asyncio.get_event_loop().create_task(
+            self._beacon_loop(addr))
         return addr
+
+    async def _beacon_loop(self, addr: Addr) -> None:
+        while not self._stopped:
+            await asyncio.sleep(max(1.0, self.config.mon_lease_interval * 4))
+            await self.monc.send(M.MMgrBeacon(addr=addr))
 
     async def stop(self) -> None:
         self._stopped = True
+        if getattr(self, "_beacon_task", None):
+            self._beacon_task.cancel()
         await self.messenger.shutdown()
 
     async def ms_dispatch(self, conn: Connection, msg) -> bool:

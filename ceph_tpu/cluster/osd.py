@@ -911,20 +911,42 @@ class OSDDaemon(Dispatcher):
 
     def _apply_shard(self, pgid: PGid, oid: str, shard: int, data: bytes,
                      chunk_off: int, shard_size: int, hinfo: Dict) -> None:
-        """Apply a shard sub-range write + refresh the shard crc
-        (ECUtil::HashInfo analog; crc covers the whole shard)."""
+        """Apply a shard sub-range write with its crc in ONE atomic
+        transaction (ECUtil::HashInfo analog, reference ECUtil.h:105-163:
+        the crc is CUMULATIVE for appends/full rewrites — no whole-shard
+        re-read on the hot path — and data+crc can never disagree)."""
         coll = _coll(pgid)
+        old_size = self.store.stat(coll, oid)
+        if chunk_off == 0 and len(data) >= shard_size:
+            # full-shard rewrite: one pass over the payload
+            crc = crcmod.crc32c(0xFFFFFFFF, data[:shard_size])
+        elif old_size is not None and chunk_off == old_size and \
+                shard_size == chunk_off + len(data):
+            # append: combine the stored cumulative crc with the new
+            # bytes' crc (GF(2) zero-extension, reference HashInfo append)
+            stored = self.store.getattr(coll, oid, "hinfo_crc")
+            if stored is not None:
+                crc = crcmod.crc32c_combine(
+                    int(stored), crcmod.crc32c(0, data), len(data))
+            else:
+                crc = crcmod.crc32c(0xFFFFFFFF,
+                                    self.store.read(coll, oid) + data)
+        else:
+            # true mid-shard RMW: recompute over the merged bytes
+            old = bytearray(self.store.read(coll, oid)) \
+                if old_size is not None else bytearray()
+            if len(old) < shard_size:
+                old.extend(b"\0" * (shard_size - len(old)))
+            old[chunk_off:chunk_off + len(data)] = data
+            crc = crcmod.crc32c(0xFFFFFFFF, bytes(old[:shard_size]))
         txn = (Transaction()
                .write(coll, oid, chunk_off, data)
                .truncate(coll, oid, shard_size)
                .setattr(coll, oid, "shard", str(shard).encode())
                .setattr(coll, oid, "size", str(hinfo["size"]).encode())
+               .setattr(coll, oid, "hinfo_crc", str(crc).encode())
                .set_version(coll, oid, hinfo["version"]))
         self.store.queue_transaction(txn)
-        crc = crcmod.crc32c(0xFFFFFFFF, self.store.read(coll, oid))
-        self.store.queue_transaction(
-            Transaction().setattr(coll, oid, "hinfo_crc", str(crc).encode())
-            .set_version(coll, oid, hinfo["version"]))
 
     async def _handle_ec_write(self, conn: Connection,
                                msg: M.MOSDECSubOpWrite) -> None:

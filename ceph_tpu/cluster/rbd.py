@@ -176,8 +176,9 @@ class Image:
 
     async def _remove_data(self) -> None:
         layout = self.header.layout
-        n_objs = (self.header.size + layout.object_size - 1) \
-            // layout.object_size * layout.stripe_count + layout.stripe_count
+        period = layout.object_size * layout.stripe_count
+        n_sets = (self.header.size + period - 1) // period
+        n_objs = n_sets * layout.stripe_count
         for objno in range(n_objs):
             try:
                 await self.ioctx.remove(self._fmt % objno)

@@ -111,8 +111,11 @@ class TensorMapper:
         self._ll_np = _split_u64(LL_TBL)
         # precomputed |ln| table (512 KiB): one gather on the hot path.
         # (A select-tree variant, _ln_neg_tree, is exact and ~14x faster per
-        # element but blows up compile time when inlined in the retry loops;
-        # a Pallas straw2 kernel is the planned fix.)
+        # element but blows up compile time when inlined in the retry loops.
+        # A Pallas rewrite was evaluated in round 3 for the sibling gf8
+        # matmul and measured ~7x SLOWER than XLA's fusion — see
+        # ops/gf8_pallas.py — so the gather path stays; at 239M mappings/s
+        # for the 10k-OSD/1M-PG benchmark it is not the bottleneck.)
         from ceph_tpu.crush.ln import crush_ln
 
         ln_neg = [0x1000000000000 - crush_ln(u) for u in range(0x10000)]

@@ -94,10 +94,16 @@ def main(argv=None) -> int:
         return 0
     if args.test:
         tester = CrushTester(cmap)
+        if args.show_mappings:
+            from ceph_tpu.crush.scalar import ScalarMapper
+
+            sm = ScalarMapper(cmap)
+            w = [0x10000] * cmap.max_devices
+            for x in range(args.min_x, args.max_x + 1):
+                out = sm.do_rule(args.rule, x, args.num_rep, w)
+                print(f"CRUSH rule {args.rule} x {x} {out}")
         report = tester.test(args.rule, args.num_rep,
                              args.min_x, args.max_x)
-        if args.show_mappings:
-            pass  # mappings are large; summary covers the CLI contract
         print(report.summary() if args.show_utilization else
               f"tested {report.n_inputs} inputs: "
               f"{len(report.bad_mappings)} bad mappings, "

@@ -43,14 +43,24 @@ def main(argv=None) -> int:
             primaries = Counter()
             from ceph_tpu.osdmap.osdmap import PGid
 
-            for seed in range(pool.pg_num):
-                up, upp, acting, actp = m.pg_to_up_acting_osds(
-                    PGid(pid, seed))
-                for o in acting:
-                    if o >= 0:
-                        counts[o] += 1
-                if actp >= 0:
-                    primaries[actp] += 1
+            try:
+                # whole-pool placement in ONE batched device dispatch
+                up_arr, upp_arr = m.pool_mapping(pid)
+                for seed in range(pool.pg_num):
+                    for o in up_arr[seed]:
+                        if 0 <= int(o) < m.max_osd:
+                            counts[int(o)] += 1
+                    if int(upp_arr[seed]) >= 0:
+                        primaries[int(upp_arr[seed])] += 1
+            except (NotImplementedError, AssertionError):
+                for seed in range(pool.pg_num):
+                    up, upp, acting, actp = m.pg_to_up_acting_osds(
+                        PGid(pid, seed))
+                    for o in acting:
+                        if o >= 0:
+                            counts[o] += 1
+                    if actp >= 0:
+                        primaries[actp] += 1
             avg = sum(counts.values()) / max(1, len(counts))
             print(f"pool {pid} pg_num {pool.pg_num}")
             for o in sorted(counts):

@@ -49,7 +49,6 @@ def test_sloppy_crc_detects_rot():
     assert len(bad) == 1 and bad[0][0] == 1  # block 1 flagged
     # partial overwrite invalidates that block's crc, so no false alarm
     m.write(65, b"zz")
-    assert m.read(0, bytes(rotted)) == [(1, bad[0][1], bad[0][2])] or True
     assert all(b != 1 for b, _, _ in m.read(0, bytes(rotted)))
 
 

@@ -87,3 +87,23 @@ def test_singular_matrix_raises():
     a = np.zeros((3, 3), dtype=np.uint8)
     with pytest.raises(gf8.SingularMatrixError):
         gf8.gf_invert_matrix(a)
+
+
+def test_pallas_kernel_matches_xla_when_available():
+    """The Pallas alternative path must stay bit-exact with the XLA hot
+    path (it only runs on a real TPU backend; CPU meshes skip)."""
+    import numpy as np
+    import pytest
+
+    from ceph_tpu.ops import gf8, gf8_pallas
+
+    if not gf8_pallas.available():
+        pytest.skip("no TPU backend for Pallas")
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    mat = rng.integers(0, 256, (4, 8), dtype=np.uint8)
+    bm = jnp.asarray(gf8.expand_bitmatrix(mat))
+    data = jnp.asarray(rng.integers(0, 256, (8, 6144), dtype=np.uint8))
+    assert np.array_equal(np.asarray(gf8.bitmatrix_matmul(bm, data)),
+                          np.asarray(gf8_pallas.bitmatrix_matmul(bm, data)))
