@@ -42,9 +42,14 @@ from ceph_tpu.utils import Config, PerfCounters
 
 class Monitor(Dispatcher):
     def __init__(self, osdmap: OSDMap, config: Optional[Config] = None,
-                 rank: int = 0, n_mons: int = 1):
+                 rank: int = 0, n_mons: int = 1, store=None):
+        """``store``: an ObjectStore backing the MonitorDBStore analog
+        (reference src/mon/MonitorDBStore.h: mon state as a kv database);
+        committed map state persists and start() resumes from it."""
         self.rank = rank
         self.n_mons = n_mons
+        self.store = store
+        self.db = None
         # per-daemon config copy: injectargs on one daemon must never
         # leak into another (each reference daemon owns its md_config_t)
         self.config = Config(**config.show()) if config else Config()
@@ -79,6 +84,16 @@ class Monitor(Dispatcher):
         self.stopped = False
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> Addr:
+        if self.store is not None:
+            from ceph_tpu.cluster.kv import StoreDB
+
+            self.store.mount()
+            self.db = StoreDB(self.store)
+            blob = self.db.get("osdmap", "latest")
+            if blob is not None:
+                # resume the committed map (MonitorDBStore refresh)
+                self.osdmap = pickle.loads(blob)
+                self.perf.inc("mon_store_resumes")
         addr = await self.messenger.bind(host, port)
         if self.n_mons == 1:
             self._tick_task = asyncio.get_event_loop().create_task(
@@ -115,6 +130,11 @@ class Monitor(Dispatcher):
             if t:
                 t.cancel()
         await self.messenger.shutdown()
+        # umount LAST: an in-flight commit draining above must still be
+        # able to persist its delta
+        if self.store is not None:
+            self.db = None
+            self.store.umount()
 
     # -- quorum plumbing ---------------------------------------------------
 
@@ -174,9 +194,30 @@ class Monitor(Dispatcher):
 
     async def _apply_committed(self, version: int, value: bytes) -> None:
         """Paxos apply callback: every quorum member applies committed
-        map deltas in order (the PaxosService refresh)."""
+        map deltas in order (the PaxosService refresh).  Restart skew is
+        tolerated: deltas already covered by a store-resumed map are
+        skipped, and a map GAP (this mon's persisted map older than the
+        quorum's) triggers a full-map sync from the leader instead of
+        wedging on apply_incremental's contiguity check."""
         inc = pickle.loads(value)
+        if inc.epoch <= self.osdmap.epoch:
+            return  # resumed store already contains this delta
+        if inc.epoch > self.osdmap.epoch + 1:
+            await self._request_map_sync()
+            return
         await self._apply_inc_local(inc)
+
+    async def _request_map_sync(self) -> None:
+        """Ask the leader's map service for our missing epochs (mon-to-mon
+        subscription; the reply lands in ms_dispatch below)."""
+        if self.leader_rank is None or self.leader_rank == self.rank:
+            return
+        try:
+            await self._send_mon(self.leader_rank, M.MMonSubscribe(
+                what="osdmap", addr=self.messenger.my_addr,
+                since=self.osdmap.epoch))
+        except (ConnectionError, OSError):
+            pass
 
     # -- proposal/commit ---------------------------------------------------
 
@@ -203,7 +244,23 @@ class Monitor(Dispatcher):
         for e in [e for e in self._inc_log if e <= cutoff]:
             del self._inc_log[e]
         self.perf.inc("mon_map_epochs")
+        if self.db is not None:
+            from ceph_tpu.cluster.kv import KVTransaction
+
+            txn = (KVTransaction()
+                   .set("osdmap", f"inc_{inc.epoch:010d}", pickle.dumps(inc))
+                   .set("osdmap", "latest", pickle.dumps(self.osdmap)))
+            # trim the persisted inc window like the in-memory one
+            txn.rmkey("osdmap", f"inc_{cutoff:010d}")
+            self.db.submit_transaction(txn)
         await self._broadcast_map()
+
+    async def _persist_latest(self) -> None:
+        if self.db is not None:
+            from ceph_tpu.cluster.kv import KVTransaction
+
+            self.db.submit_transaction(KVTransaction().set(
+                "osdmap", "latest", pickle.dumps(self.osdmap)))
 
     # -- dispatch ----------------------------------------------------------
 
@@ -235,6 +292,20 @@ class Monitor(Dispatcher):
                 await self._handle_failure(msg)
             elif 0 <= msg.osd_id < self.osdmap.max_osd:
                 self.last_beacon[msg.osd_id] = time.monotonic()
+            return True
+        if isinstance(msg, M.MOSDMapMsg):
+            newmap = pickle.loads(msg.osdmap_blob)
+            if newmap.epoch > self.osdmap.epoch:
+                self.osdmap = newmap
+                self.perf.inc("mon_map_syncs")
+                await self._persist_latest()
+            return True
+        if isinstance(msg, M.MOSDIncMapMsg):
+            if msg.prev_epoch == self.osdmap.epoch:
+                for blob in msg.inc_blobs:
+                    await self._apply_inc_local(pickle.loads(blob))
+            elif msg.epoch > self.osdmap.epoch:
+                await self._request_map_sync()
             return True
         if isinstance(msg, M.MMgrBeacon):
             if not self.is_leader:

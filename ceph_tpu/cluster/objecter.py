@@ -168,7 +168,11 @@ class Objecter(Dispatcher):
                                epoch=self.osdmap.epoch)
                 try:
                     await self.messenger.send_message(msg, tuple(addr))
-                    reply = await asyncio.wait_for(fut, timeout=5.0)
+                    # outwait the OSD's own replica-ack timeout: abandoning
+                    # in parallel just queues a duplicate op behind the PG
+                    # lock and compounds load
+                    attempt = self.config.osd_client_op_timeout + 2.0
+                    reply = await asyncio.wait_for(fut, timeout=attempt)
                     if reply.result != -11:  # not misdirected
                         return reply
                 except (ConnectionError, OSError, asyncio.TimeoutError):

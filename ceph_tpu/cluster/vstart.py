@@ -143,7 +143,8 @@ def _fast_config() -> Config:
 async def start_cluster(n_osds: int = 3, osds_per_host: int = 1,
                         config: Optional[Config] = None,
                         store_factory=None, n_mons: int = 1,
-                        with_mgr: bool = False) -> Cluster:
+                        with_mgr: bool = False,
+                        mon_store_factory=None) -> Cluster:
     """Boot the mon quorum + OSDs and wait for everything up in the map.
 
     ``store_factory(osd_id) -> ObjectStore`` selects the backing store
@@ -164,7 +165,9 @@ async def start_cluster(n_osds: int = 3, osds_per_host: int = 1,
     mon_addrs: List[tuple] = []
     for r in range(n_mons):
         mon = Monitor(_pickle.loads(map_blob), config=config, rank=r,
-                      n_mons=n_mons)
+                      n_mons=n_mons,
+                      store=mon_store_factory(r) if mon_store_factory
+                      else None)
         mon_addrs.append(await mon.start())
         mons.append(mon)
     cluster = Cluster(mons=mons, osds={}, config=config,
