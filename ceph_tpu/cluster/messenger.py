@@ -9,6 +9,13 @@ N daemons x 1 host over real sockets).  Frames are length-prefixed
 pickles — an internal trust boundary, like the reference's cephx-signed
 native encoding is within a cluster.
 
+Integrity (reference cephx message signing, src/auth/cephx/): when the
+messenger holds a cluster secret, every frame carries a truncated
+HMAC-SHA256 over the payload; receivers verify before unpickling and
+reset the connection on mismatch, so a byte-flipped or forged frame can
+never reach a dispatcher.  auth "none" (no secret) stays the default,
+like the reference's auth_supported=none dev mode.
+
 Reliability (reference AsyncConnection reconnect/replay semantics):
 outgoing traffic runs over per-peer SESSIONS with monotonically
 increasing sequence numbers; sent frames stay buffered until the peer
@@ -25,6 +32,9 @@ import asyncio
 import itertools
 import pickle
 import struct
+import hmac as _hmac
+import hashlib
+
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
@@ -107,6 +117,9 @@ class Connection:
             self._seq += 1
             msg.seq = self._seq
             payload = pickle.dumps(msg)
+            secret = self.messenger.secret
+            if secret is not None:
+                payload += _sign(secret, payload)
             try:
                 self.writer.write(struct.pack("<I", len(payload)) + payload)
                 await self.writer.drain()
@@ -132,9 +145,17 @@ class Dispatcher:
         ...
 
 
+SIG_LEN = 16
+
+
+def _sign(secret: bytes, payload: bytes) -> bytes:
+    return _hmac.new(secret, payload, hashlib.sha256).digest()[:SIG_LEN]
+
+
 class Messenger:
-    def __init__(self, name: EntityName):
+    def __init__(self, name: EntityName, secret: bytes = None):
         self.name = name
+        self.secret = secret
         self.sid = next(_SID)
         self.dispatchers: List[Dispatcher] = []
         self._server: Optional[asyncio.base_events.Server] = None
@@ -174,6 +195,14 @@ class Messenger:
                 hdr = await conn.reader.readexactly(4)
                 (n,) = struct.unpack("<I", hdr)
                 payload = await conn.reader.readexactly(n)
+                if self.secret is not None:
+                    # verify BEFORE unpickling: unauthenticated bytes
+                    # must never reach the deserializer
+                    if n < SIG_LEN or not _hmac.compare_digest(
+                            _sign(self.secret, payload[:-SIG_LEN]),
+                            payload[-SIG_LEN:]):
+                        raise ConnectionError("bad message signature")
+                    payload = payload[:-SIG_LEN]
                 msg = pickle.loads(payload)
                 if conn.peer is None:
                     conn.peer = msg.src
@@ -193,7 +222,11 @@ class Messenger:
                         break
         except (asyncio.IncompleteReadError, ConnectionError,
                 asyncio.CancelledError):
-            conn.closed = True
+            # actually CLOSE the socket (not just flag it): a signature
+            # mismatch must tear the TCP stream down so the peer's session
+            # sees the failure and reconnect+replay engages, instead of
+            # writing into a blackholed socket until overflow
+            await conn.close()
             for d in self.dispatchers:
                 try:
                     await d.ms_handle_reset(conn)
@@ -224,6 +257,8 @@ class Messenger:
             msg.seq = sess.seq
             msg.sid = self.sid
             payload = pickle.dumps(msg)
+            if self.secret is not None:
+                payload += _sign(self.secret, payload)
             frame = struct.pack("<I", len(payload)) + payload
             sess.buffer(sess.seq, frame)
             try:

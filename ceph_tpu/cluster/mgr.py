@@ -27,7 +27,9 @@ class MgrDaemon(Dispatcher):
         # per-daemon config copy: injectargs on one daemon must never
         # leak into another (each reference daemon owns its md_config_t)
         self.config = Config(**config.show()) if config else Config()
-        self.messenger = Messenger(EntityName("mgr", rank))
+        self.messenger = Messenger(
+            EntityName("mgr", rank),
+            secret=self.config.auth_secret())
         self.messenger.add_dispatcher(self)
         self.monc = MonTargeter(self.messenger, mon_addr)
         self.perf = PerfCounters(f"mgr.{rank}")

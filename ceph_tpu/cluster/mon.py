@@ -54,7 +54,9 @@ class Monitor(Dispatcher):
         # leak into another (each reference daemon owns its md_config_t)
         self.config = Config(**config.show()) if config else Config()
         self.osdmap = osdmap
-        self.messenger = Messenger(EntityName("mon", rank))
+        self.messenger = Messenger(
+            EntityName("mon", rank),
+            secret=self.config.auth_secret())
         self.messenger.add_dispatcher(self)
         self.subscribers: Set[Addr] = set()
         self.failure_reports: Dict[int, Set[int]] = {}

@@ -156,3 +156,13 @@ def _ref_put(ctx: MethodContext, indata: bytes) -> bytes:
     refs.discard(indata.decode())
     ctx.setxattr("refcount", pickle.dumps(refs))
     return pickle.dumps(len(refs))
+
+
+@register("inotable", "alloc")
+def _ino_alloc(ctx: MethodContext, indata: bytes) -> bytes:
+    """Atomic inode-number allocation (reference InoTable): the
+    read-increment-write runs under the OSD's PG serialization."""
+    cur = ctx.omap_get().get("next", b"2")
+    ino = int(cur)
+    ctx.omap_set({"next": str(ino + 1).encode()})
+    return str(ino).encode()

@@ -32,7 +32,9 @@ class Objecter(Dispatcher):
                  config: Optional[Config] = None):
         self.client_name = name
         self.config = config or Config()
-        self.messenger = Messenger(EntityName("client", abs(hash(name)) % 10000))
+        self.messenger = Messenger(
+            EntityName("client", abs(hash(name)) % 10000),
+            secret=self.config.auth_secret())
         self.messenger.add_dispatcher(self)
         from ceph_tpu.cluster.monclient import MonTargeter
 

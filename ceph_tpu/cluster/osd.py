@@ -87,7 +87,9 @@ class OSDDaemon(Dispatcher):
         # leak into another (each reference daemon owns its md_config_t)
         self.config = Config(**config.show()) if config else Config()
         self.store = store or MemStore()
-        self.messenger = Messenger(EntityName("osd", osd_id))
+        self.messenger = Messenger(
+            EntityName("osd", osd_id),
+            secret=self.config.auth_secret())
         self.messenger.add_dispatcher(self)
         # monmap failover (shared MonClient hunting, cluster/monclient.py)
         from ceph_tpu.cluster.monclient import MonTargeter

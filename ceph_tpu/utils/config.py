@@ -59,6 +59,9 @@ OPTIONS: List[Option] = [
            "leader lease extension period"),
     Option("mon_lease_ack_timeout", float, 1.2,
            "peon lease staleness before calling an election"),
+    # auth (reference auth_supported / cephx)
+    Option("auth_shared_secret", str, "",
+           "cluster HMAC signing key; empty = auth none"),
     # ec
     Option("osd_ec_batch_size", int, 64, "stripes per device dispatch"),
     Option("osd_ec_stripe_unit", int, 4096),
@@ -90,6 +93,15 @@ class Config:
             return values[name]
         raise AttributeError(name)
 
+    def __setattr__(self, name: str, value) -> None:
+        # route option assignment through set(): a shadowing instance
+        # attribute would be read back by __getattr__ but silently lost
+        # by show()-based per-daemon copies
+        if name.startswith("_"):
+            object.__setattr__(self, name, value)
+        else:
+            self.set(name, value)
+
     def set(self, name: str, value) -> None:
         opt = _BY_NAME.get(name)
         if opt is None:
@@ -110,6 +122,11 @@ class Config:
 
     def add_observer(self, fn: Callable[[str, Any], None]) -> None:
         self._observers.append(fn)
+
+    def auth_secret(self):
+        """Messenger signing key, or None for auth 'none'."""
+        s = self._values.get("auth_shared_secret", "")
+        return s.encode() if s else None
 
     def show(self) -> Dict[str, Any]:
         return dict(self._values)

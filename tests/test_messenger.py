@@ -165,3 +165,67 @@ def test_ec_write_survives_connection_drops():
             await cluster.stop()
 
     run(scenario())
+
+
+def test_signed_cluster_end_to_end_and_rejects_unsigned():
+    """cephx-lite: a secret-keyed cluster serves I/O normally; unsigned
+    or tampered frames never reach a dispatcher."""
+    async def scenario():
+        from ceph_tpu.cluster.vstart import _fast_config, start_cluster
+
+        cfg = _fast_config()
+        cfg.auth_shared_secret = "sekrit"
+        cluster = await start_cluster(3, config=cfg)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("authp", "replicated",
+                                            pg_num=8, size=2)
+            io = client.ioctx(pool)
+            await io.write_full("obj", b"signed-payload" * 50)
+            assert await io.read("obj") == b"signed-payload" * 50
+
+            # an UNSIGNED client cannot talk to the signed cluster
+            from ceph_tpu.cluster.objecter import RadosClient
+            from ceph_tpu.utils import Config
+
+            rogue = RadosClient(cluster.mon_addr, name="rogue",
+                                config=Config())
+            with pytest.raises((asyncio.TimeoutError, ConnectionError,
+                                OSError, TimeoutError)):
+                await asyncio.wait_for(rogue.connect(), timeout=3)
+            await rogue.shutdown()
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_tampered_frame_rejected():
+    async def scenario():
+        rx = Messenger(EntityName("osd", 1), secret=b"k")
+        coll = Collector()
+        rx.add_dispatcher(coll)
+        addr = await rx.bind()
+        tx = Messenger(EntityName("osd", 2), secret=b"k")
+        try:
+            await tx.send_message(Num(n=1), addr)
+            await asyncio.sleep(0.1)
+            # flip a byte inside the next frame by writing raw garbage on
+            # a fresh socket (wrong signature)
+            import pickle as p
+            import struct
+
+            reader, writer = await asyncio.open_connection(*addr)
+            m = Num(n=666)
+            m.src = EntityName("osd", 3)
+            payload = p.dumps(m) + b"\x00" * 16
+            writer.write(struct.pack("<I", len(payload)) + payload)
+            await writer.drain()
+            await asyncio.sleep(0.2)
+            writer.close()
+            assert coll.got == [1]      # forged 666 never dispatched
+        finally:
+            await tx.shutdown()
+            await rx.shutdown()
+
+    run(scenario())
