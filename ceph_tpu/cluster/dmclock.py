@@ -1,0 +1,120 @@
+"""dmClock: reservation/weight/limit QoS scheduling.
+
+Behavioral analog of the reference's dmClock op scheduling
+(src/dmclock/ vendored library + mClockOpClassQueue / mClockClientQueue,
+src/osd/mClockOpClassQueue.h): each client class gets a QoS spec
+(reservation = guaranteed ops/s, weight = proportional share of spare
+capacity, limit = ops/s cap); every request is stamped with reservation/
+proportion/limit tags derived from the previous tag (the dmClock paper's
+tag arithmetic), and dequeue serves reservation-eligible requests by
+R-tag first, then spare capacity by P-tag, never past the L-tag.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class QoSSpec:
+    """Client-class service parameters (dmclock ClientInfo)."""
+
+    reservation: float = 0.0   # guaranteed ops/s (0 = none)
+    weight: float = 1.0        # share of spare capacity
+    limit: float = 0.0         # ops/s cap (0 = unlimited)
+
+
+@dataclass
+class _Tags:
+    r: float
+    p: float
+    l: float
+
+
+class _ClientRec:
+    def __init__(self, spec: QoSSpec):
+        self.spec = spec
+        self.prev: Optional[_Tags] = None
+        self.queue: List[Tuple[int, object]] = []
+
+
+class DmClockQueue:
+    """Single-queue dmClock scheduler (the per-shard queue the reference
+    plugs into ShardedOpWQ)."""
+
+    def __init__(self, now=time.monotonic):
+        self._clients: Dict[str, _ClientRec] = {}
+        self._now = now
+        self._seq = itertools.count()
+
+    def set_client(self, client: str, spec: QoSSpec) -> None:
+        """Install/update a client's QoS spec; queued requests and tag
+        history survive a spec change (injectargs-style live update)."""
+        rec = self._clients.get(client)
+        if rec is None:
+            self._clients[client] = _ClientRec(spec)
+        else:
+            rec.spec = spec
+
+    def enqueue(self, client: str, item) -> None:
+        rec = self._clients.setdefault(client, _ClientRec(QoSSpec()))
+        now = self._now()
+        s = rec.spec
+        prev = rec.prev
+        # dmClock tag arithmetic: advance from the previous tag at the
+        # class's configured rate, but never fall behind real time
+        if prev is None:
+            tags = _Tags(r=now, p=now, l=now)
+        else:
+            tags = _Tags(
+                r=max(now, prev.r + (1.0 / s.reservation
+                                     if s.reservation else 0.0)),
+                p=max(now, prev.p + 1.0 / max(s.weight, 1e-9)),
+                l=max(now, prev.l + (1.0 / s.limit if s.limit else 0.0)),
+            )
+        rec.prev = tags
+        rec.queue.append((next(self._seq), item, tags))
+
+    def _head(self, rec: _ClientRec):
+        return rec.queue[0] if rec.queue else None
+
+    def dequeue(self) -> Optional[object]:
+        """One scheduling decision (dmclock PullPriorityQueue::pull):
+        1. any reservation-eligible request (R-tag <= now) — smallest R;
+        2. else the smallest P-tag whose limit allows service (L <= now);
+        3. else nothing is currently eligible."""
+        now = self._now()
+        best_r = None
+        best_p = None
+        for name, rec in self._clients.items():
+            head = self._head(rec)
+            if head is None:
+                continue
+            _, _, tags = head
+            if rec.spec.reservation and tags.r <= now:
+                if best_r is None or tags.r < best_r[0]:
+                    best_r = (tags.r, name)
+            if tags.l <= now:
+                if best_p is None or tags.p < best_p[0]:
+                    best_p = (tags.p, name)
+        pick = best_r or best_p
+        if pick is None:
+            return None
+        rec = self._clients[pick[1]]
+        _, item, _ = rec.queue.pop(0)
+        return item
+
+    def drain_eligible(self, max_items: int = 1 << 30) -> List[object]:
+        out = []
+        while len(out) < max_items:
+            item = self.dequeue()
+            if item is None:
+                break
+            out.append(item)
+        return out
+
+    def __len__(self) -> int:
+        return sum(len(r.queue) for r in self._clients.values())

@@ -19,8 +19,8 @@ from __future__ import annotations
 import asyncio
 import pickle
 import time
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Tuple
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
 
 from ceph_tpu.cluster.objecter import IoCtx
 from ceph_tpu.cluster.striper import (
@@ -114,27 +114,36 @@ class FileSystem:
 
     # -- namespace ops ------------------------------------------------------
 
+    async def _link_dentry(self, parent: int, leaf: str,
+                           inode: Inode, path: str) -> None:
+        """Create-exclusive dentry insert through the object-class seam:
+        the check-then-set runs INSIDE the OSD under PG serialization, so
+        concurrent creates of one path cannot both succeed."""
+        try:
+            await self.meta.execute(
+                self._dir_oid(parent), "dirfrag", "link",
+                pickle.dumps({"name": leaf,
+                              "value": pickle.dumps(inode)}))
+        except IOError as e:
+            if "-17" in str(e):  # EEXIST from the class method
+                raise FileExistsError(path) from None
+            raise
+
     async def mkdir(self, path: str) -> int:
         parent, leaf = await self._lookup_dir(path)
-        entries = await self.meta.omap_get(self._dir_oid(parent))
-        if leaf in entries:
-            raise FileExistsError(path)
         ino = await self._alloc_ino()
         await self.meta.write_full(self._dir_oid(ino),
                                    pickle.dumps(Inode(ino, "dir")))
-        await self._set_dentry(parent, leaf, Inode(ino, "dir"))
+        await self._link_dentry(parent, leaf, Inode(ino, "dir"), path)
         return ino
 
     async def create(self, path: str,
                      layout: Optional[FileLayout] = None) -> int:
         parent, leaf = await self._lookup_dir(path)
-        entries = await self.meta.omap_get(self._dir_oid(parent))
-        if leaf in entries:
-            raise FileExistsError(path)
         ino = await self._alloc_ino()
         inode = Inode(ino, "file", size=0,
                       layout=layout or self.layout, mtime=time.time())
-        await self._set_dentry(parent, leaf, inode)
+        await self._link_dentry(parent, leaf, inode, path)
         return ino
 
     async def listdir(self, path: str = "/") -> List[str]:
@@ -159,6 +168,8 @@ class FileSystem:
     async def rename(self, src: str, dst: str) -> None:
         sparent, sleaf, inode = await self._resolve(src)
         dparent, dleaf = await self._lookup_dir(dst)
+        if (sparent, sleaf) == (dparent, dleaf):
+            return  # POSIX: rename onto itself is a no-op
         existing = (await self.meta.omap_get(
             self._dir_oid(dparent))).get(dleaf)
         if existing is not None:

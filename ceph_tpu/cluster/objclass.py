@@ -166,3 +166,14 @@ def _ino_alloc(ctx: MethodContext, indata: bytes) -> bytes:
     ino = int(cur)
     ctx.omap_set({"next": str(ino + 1).encode()})
     return str(ino).encode()
+
+
+@register("dirfrag", "link")
+def _dirfrag_link(ctx: MethodContext, indata: bytes) -> bytes:
+    """Create-exclusive dentry insert (reference MDS dirfrag link):
+    EEXIST when the name is already present — atomic under PG order."""
+    req = pickle.loads(indata)
+    if req["name"] in ctx.omap_get():
+        raise ClsError(-17, "dentry exists")  # EEXIST
+    ctx.omap_set({req["name"]: req["value"]})
+    return b""

@@ -1,0 +1,144 @@
+"""RGW-lite: an S3-shaped object gateway over RADOS.
+
+Behavioral analog of the reference radosgw core data model (src/rgw/):
+buckets are omap-backed index objects (one entry per key, exactly how
+cls_rgw maintains bucket indexes), object payloads live in the data pool
+via the librados surface, and the API mirrors the S3 verbs the reference
+gateway serves — create/delete bucket, put/get/head/delete object,
+prefix+marker listing with truncation, and basic user metadata.  The
+HTTP frontend (civetweb/Beast in the reference) is out of scope; this is
+the gateway's storage core as a library.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pickle
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ceph_tpu.cluster.objecter import IoCtx
+
+
+@dataclass
+class ObjectMeta:
+    """Bucket-index entry (cls_rgw rgw_bucket_dir_entry analog)."""
+
+    key: str
+    size: int
+    etag: str
+    mtime: float
+    content_type: str = "application/octet-stream"
+    user_meta: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class ListResult:
+    keys: List[ObjectMeta]
+    is_truncated: bool
+    next_marker: Optional[str]
+
+
+class RGW:
+    """Gateway handle (the radosgw storage core as a library)."""
+
+    def __init__(self, ioctx: IoCtx):
+        self.ioctx = ioctx
+
+    BUCKETS_OID = ".buckets.list"   # registry of buckets (omap)
+
+    @staticmethod
+    def _index_oid(bucket: str) -> str:
+        return f".bucket.index.{bucket}"
+
+    @staticmethod
+    def _data_oid(bucket: str, key: str) -> str:
+        # length-prefixed: unambiguous for ANY bucket/key bytes (S3 keys
+        # may contain any separator we could pick)
+        return f"{len(bucket)}:{bucket}:{key}"
+
+    # -- buckets ------------------------------------------------------------
+
+    async def create_bucket(self, bucket: str) -> None:
+        try:
+            await self.ioctx.stat(self._index_oid(bucket))
+            raise FileExistsError(bucket)
+        except FileNotFoundError:
+            pass
+        await self.ioctx.write_full(self._index_oid(bucket),
+                                    pickle.dumps({"created": time.time()}))
+        await self.ioctx.omap_set(self.BUCKETS_OID, {bucket: b"1"})
+
+    async def delete_bucket(self, bucket: str) -> None:
+        idx = await self._index(bucket)
+        if idx:
+            raise OSError(39, "bucket not empty", bucket)
+        await self.ioctx.remove(self._index_oid(bucket))
+        await self.ioctx.omap_rmkeys(self.BUCKETS_OID, [bucket])
+
+    async def list_buckets(self) -> List[str]:
+        # O(buckets) via the registry omap, not O(pool objects)
+        try:
+            return sorted(await self.ioctx.omap_get(self.BUCKETS_OID))
+        except FileNotFoundError:
+            return []
+
+    async def _index(self, bucket: str) -> Dict[str, bytes]:
+        try:
+            await self.ioctx.stat(self._index_oid(bucket))
+        except FileNotFoundError:
+            raise FileNotFoundError(f"bucket {bucket}")
+        return await self.ioctx.omap_get(self._index_oid(bucket))
+
+    # -- objects ------------------------------------------------------------
+
+    async def put_object(self, bucket: str, key: str, data: bytes,
+                         content_type: str = "application/octet-stream",
+                         user_meta: Optional[Dict[str, str]] = None) -> str:
+        try:
+            await self.ioctx.stat(self._index_oid(bucket))  # must exist
+        except FileNotFoundError:
+            raise FileNotFoundError(f"bucket {bucket}")
+        etag = hashlib.md5(data).hexdigest()
+        meta = ObjectMeta(key=key, size=len(data), etag=etag,
+                          mtime=time.time(), content_type=content_type,
+                          user_meta=dict(user_meta or {}))
+        await self.ioctx.write_full(self._data_oid(bucket, key), data)
+        # index update AFTER the payload lands (cls_rgw prepares/completes
+        # around the data write for the same reason)
+        await self.ioctx.omap_set(self._index_oid(bucket),
+                                  {key: pickle.dumps(meta)})
+        return etag
+
+    async def head_object(self, bucket: str, key: str) -> ObjectMeta:
+        idx = await self._index(bucket)
+        blob = idx.get(key)
+        if blob is None:
+            raise FileNotFoundError(f"{bucket}/{key}")
+        return pickle.loads(blob)
+
+    async def get_object(self, bucket: str,
+                         key: str) -> Tuple[ObjectMeta, bytes]:
+        meta = await self.head_object(bucket, key)
+        data = await self.ioctx.read(self._data_oid(bucket, key))
+        return meta, data
+
+    async def delete_object(self, bucket: str, key: str) -> None:
+        await self.head_object(bucket, key)  # 404 when absent
+        await self.ioctx.remove(self._data_oid(bucket, key))
+        await self.ioctx.omap_rmkeys(self._index_oid(bucket), [key])
+
+    async def list_objects(self, bucket: str, prefix: str = "",
+                           marker: str = "",
+                           max_keys: int = 1000) -> ListResult:
+        """S3 ListObjects semantics: lexicographic, after ``marker``,
+        filtered by ``prefix``, truncated at ``max_keys``."""
+        idx = await self._index(bucket)
+        keys = sorted(k for k in idx
+                      if k.startswith(prefix) and k > marker)
+        page = keys[:max_keys]
+        return ListResult(
+            keys=[pickle.loads(idx[k]) for k in page],
+            is_truncated=len(keys) > max_keys,
+            next_marker=page[-1] if len(keys) > max_keys else None)
