@@ -50,6 +50,11 @@ class DmClockQueue:
         self._now = now
         self._seq = itertools.count()
 
+    def ensure_client(self, client: str, default: QoSSpec) -> None:
+        """Install ``default`` only on first sight of the client."""
+        if client not in self._clients:
+            self._clients[client] = _ClientRec(default)
+
     def set_client(self, client: str, spec: QoSSpec) -> None:
         """Install/update a client's QoS spec; queued requests and tag
         history survive a spec change (injectargs-style live update)."""

@@ -109,6 +109,15 @@ class OSDDaemon(Dispatcher):
         self._tasks: List[asyncio.Task] = []
         self._hb_last: Dict[int, float] = {}
         self._reported: Set[int] = set()
+        # dmClock op scheduling (reference mClockClientQueue plugged into
+        # ShardedOpWQ): enabled by osd_op_queue=mclock; ops enqueue per
+        # client and a drain task serves them by reservation/weight/limit
+        self._opq = None
+        self._opq_event = asyncio.Event()
+        if self.config.osd_op_queue == "mclock":
+            from ceph_tpu.cluster.dmclock import DmClockQueue
+
+            self._opq = DmClockQueue()
         # watch/notify state: (pgid, oid) -> {(watcher, cookie): conn}
         # (reference Watch/Notify on PrimaryLogPG)
         self._watchers: Dict[Tuple, Dict[Tuple[str, int], Connection]] = {}
@@ -130,6 +139,8 @@ class OSDDaemon(Dispatcher):
         loop = asyncio.get_event_loop()
         self._tasks.append(loop.create_task(self._heartbeat_loop()))
         self._tasks.append(loop.create_task(self._scrub_loop()))
+        if self._opq is not None:
+            self._tasks.append(loop.create_task(self._opq_drain()))
         return addr
 
     def _load_superblock(self) -> int:
@@ -532,22 +543,97 @@ class OSDDaemon(Dispatcher):
 
     # -------------------------------------------------------- client ops
 
-    async def _handle_client_op(self, conn: Connection, msg: M.MOSDOp) -> None:
+    async def _resolve_client_op(self, conn: Connection, msg: M.MOSDOp):
+        """Map/pool/PG/primary checks for a client op; replies and
+        returns None when the op cannot be served here."""
         m = self.osdmap
         if m is None:
             await conn.send(M.MOSDOpReply(reqid=msg.reqid, result=-11))
-            return
+            return None
         pool = m.pools.get(msg.pgid.pool)
         if pool is None:
             await conn.send(M.MOSDOpReply(reqid=msg.reqid, result=-2))
-            return
+            return None
         st = self.pgs.get(msg.pgid)
         if st is None or st.primary != self.osd_id:
             # not primary (anymore): tell client to refresh its map
             await conn.send(M.MOSDOpReply(
                 reqid=msg.reqid, result=-11, epoch=m.epoch))
             self.perf.inc("osd_misdirected_ops")
+            return None
+        return m, pool, st
+
+    async def _handle_client_op(self, conn: Connection, msg: M.MOSDOp) -> None:
+        resolved = await self._resolve_client_op(conn, msg)
+        if resolved is None:
             return
+        m, pool, st = resolved
+        if self._opq is not None:
+            from ceph_tpu.cluster.dmclock import QoSSpec
+
+            self._opq.ensure_client(msg.reqid[0], QoSSpec(
+                reservation=self.config.osd_mclock_default_reservation,
+                weight=self.config.osd_mclock_default_weight,
+                limit=self.config.osd_mclock_default_limit))
+            # queue ONLY (conn, msg): the map/pool/PG/primary state is
+            # re-resolved at dequeue time — a queued op must not execute
+            # against a stale acting set after a map change
+            self._opq.enqueue(msg.reqid[0], (conn, msg))
+            self.perf.inc("osd_ops_queued_mclock")
+            self._opq_event.set()
+            return
+        await self._dispatch_client_op(conn, msg, m, pool, st)
+
+    async def _opq_drain(self) -> None:
+        """Serve the dmClock queue (the ShardedOpWQ dequeue loop): QoS
+        decides WHEN an op starts; execution runs as its own task so one
+        slow write never head-of-line blocks other clients/PGs."""
+        running: Set[asyncio.Task] = set()
+        while not self._stopped:
+            item = self._opq.dequeue()
+            if item is None:
+                if len(self._opq):
+                    await asyncio.sleep(0.005)  # throttled: next L-tag soon
+                else:
+                    self._opq_event.clear()
+                    try:
+                        await asyncio.wait_for(self._opq_event.wait(), 5.0)
+                    except asyncio.TimeoutError:
+                        pass
+                continue
+            conn, msg = item
+            t = asyncio.get_event_loop().create_task(
+                self._serve_queued_op(conn, msg))
+            running.add(t)
+            t.add_done_callback(running.discard)
+
+    async def _serve_queued_op(self, conn, msg) -> None:
+        try:
+            resolved = await self._resolve_client_op(conn, msg)
+            if resolved is None:
+                return
+            m, pool, st = resolved
+            await self._dispatch_client_op(conn, msg, m, pool, st)
+        except Exception as e:
+            # mirror ms_dispatch's error contract: the client gets a
+            # prompt EIO instead of a timeout
+            self.perf.inc("osd_dispatch_errors")
+            try:
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=-5, data=repr(e)))
+            except (ConnectionError, OSError, RuntimeError):
+                pass
+
+    def set_qos(self, client: str, reservation: float = 0.0,
+                weight: float = 1.0, limit: float = 0.0) -> None:
+        """Live per-client QoS update (mclock profile analog)."""
+        from ceph_tpu.cluster.dmclock import QoSSpec
+
+        if self._opq is not None:
+            self._opq.set_client(client, QoSSpec(
+                reservation=reservation, weight=weight, limit=limit))
+
+    async def _dispatch_client_op(self, conn, msg, m, pool, st) -> None:
         self.perf.inc("osd_client_ops")
         top = self.tracker.create(
             f"osd_op({msg.reqid[0]}:{msg.reqid[1]} {msg.oid} "

@@ -43,6 +43,11 @@ OPTIONS: List[Option] = [
            "pools with at least this many PGs use batched placement"),
     Option("osd_scrub_interval", float, 0.0,
            "background scrub period per primary PG (0 disables)"),
+    Option("osd_op_queue", str, "fifo",
+           "client op scheduling: fifo | mclock (dmClock QoS)"),
+    Option("osd_mclock_default_reservation", float, 0.0),
+    Option("osd_mclock_default_weight", float, 1.0),
+    Option("osd_mclock_default_limit", float, 0.0),
     # mon
     Option("mon_osd_down_out_interval", float, 30.0,
            "auto-out after down this long"),

@@ -121,3 +121,50 @@ def test_dmclock_weight_proportionality():
     heavy = sum(1 for s in served if s[0] == "h")
     light = sum(1 for s in served if s[0] == "l")
     assert heavy > light * 1.8, (heavy, light)
+
+
+def test_mclock_op_queue_in_osd():
+    """osd_op_queue=mclock: client ops flow through the dmClock queue;
+    a limited client is throttled while an unlimited one proceeds."""
+    async def scenario():
+        from ceph_tpu.cluster.vstart import _fast_config, start_cluster
+
+        cfg = _fast_config()
+        cfg.osd_op_queue = "mclock"
+        cluster = await start_cluster(3, config=cfg)
+        try:
+            fast = await cluster.client("fast")
+            slow = await cluster.client("slow")
+            pool = await fast.pool_create("qosp", "replicated",
+                                          pg_num=1, size=2)
+            fio = fast.ioctx(pool)
+            sio = slow.ioctx(pool)
+            # warm the path (and identify the single PG's primary)
+            await fio.write_full("warm", b"w")
+            pgid = fast.objecter.object_pgid(pool, "warm")
+            _, _, _, primary = \
+                fast.objecter.osdmap.pg_to_up_acting_osds(pgid)
+            # throttle the slow client to 5 ops/s on the primary
+            cluster.osds[primary].set_qos("slow", limit=5.0)
+
+            async def hammer(io, n):
+                done = 0
+                for i in range(n):
+                    await io.write_full(f"{id(io)}-{i}", b"x")
+                    done += 1
+                return done
+
+            t0 = asyncio.get_event_loop().time()
+            fast_done, slow_done = await asyncio.gather(
+                hammer(fio, 40), hammer(sio, 40))
+            dt = asyncio.get_event_loop().time() - t0
+            assert fast_done == 40 and slow_done == 40
+            # the slow client's 40 ops at 5/s force dt >= ~7s while the
+            # fast client alone would finish far sooner
+            assert dt >= 5.0, dt
+            q = cluster.osds[primary].perf.get("osd_ops_queued_mclock")
+            assert q >= 80
+        finally:
+            await cluster.stop()
+
+    run(scenario())
