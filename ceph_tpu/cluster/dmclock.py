@@ -112,6 +112,21 @@ class DmClockQueue:
         _, item, _ = rec.queue.pop(0)
         return item
 
+    def next_eligible_in(self, now: Optional[float] = None) -> Optional[float]:
+        """Seconds until the earliest queued head becomes limit-eligible
+        (None when the queue is empty; 0 when something is ready)."""
+        if now is None:
+            now = self._now()
+        best = None
+        for rec in self._clients.values():
+            head = self._head(rec)
+            if head is None:
+                continue
+            wait = max(0.0, head[2].l - now)
+            if best is None or wait < best:
+                best = wait
+        return best
+
     def drain_eligible(self, max_items: int = 1 << 30) -> List[object]:
         out = []
         while len(out) < max_items:

@@ -22,6 +22,7 @@ class MPing(Message):
 class MOSDBoot(Message):
     osd_id: int = -1
     addr: Optional[Addr] = None
+    instance: int = 0   # per-daemon-start nonce (addr-reuse fencing)
 
 
 @dataclass

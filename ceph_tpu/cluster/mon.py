@@ -83,6 +83,7 @@ class Monitor(Dispatcher):
         self._last_lease = 0.0
         self._fwd: Dict[int, Tuple[Connection, int]] = {}
         self._fwd_tid = 0
+        self._boot_instances: Dict[int, int] = {}
         self.stopped = False
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> Addr:
@@ -350,6 +351,25 @@ class Monitor(Dispatcher):
         if msg.osd_id >= self.osdmap.max_osd:
             return
         async with self._map_mutex:
+            cur_addr = self.osdmap.osd_addrs.get(msg.osd_id)
+            prev_instance = self._boot_instances.get(msg.osd_id)
+            new_incarnation = (
+                (cur_addr is not None and
+                 tuple(cur_addr) != tuple(msg.addr)) or
+                (prev_instance is not None and msg.instance and
+                 prev_instance != msg.instance))
+            self._boot_instances[msg.osd_id] = msg.instance
+            if self.osdmap.osd_up[msg.osd_id] and new_incarnation:
+                # a NEW incarnation of an osd we still think is up (it
+                # bounced faster than failure detection): mark it down
+                # first so the acting sets change and primaries run a
+                # peering pass — otherwise the rejoiner silently keeps
+                # whatever writes it missed (reference preprocess_boot
+                # marks a booting-but-up osd down before the new up)
+                down = self._new_inc()
+                down.new_down.append(msg.osd_id)
+                self.perf.inc("mon_osd_boot_fenced")
+                await self._commit_inc(down)
             inc = self._new_inc()
             inc.new_up[msg.osd_id] = tuple(msg.addr)
             self.down_since.pop(msg.osd_id, None)

@@ -114,10 +114,21 @@ class OSDDaemon(Dispatcher):
         # client and a drain task serves them by reservation/weight/limit
         self._opq = None
         self._opq_event = asyncio.Event()
+        self._opq_running: Set[asyncio.Task] = set()
         if self.config.osd_op_queue == "mclock":
-            from ceph_tpu.cluster.dmclock import DmClockQueue
+            from ceph_tpu.cluster.dmclock import DmClockQueue, QoSSpec
 
             self._opq = DmClockQueue()
+            self._opq_default = QoSSpec(
+                reservation=self.config.osd_mclock_default_reservation,
+                weight=self.config.osd_mclock_default_weight,
+                limit=self.config.osd_mclock_default_limit)
+        # boot instance nonce: lets the mon fence a fast rebounce even if
+        # the new daemon lands on the identical address
+        import itertools as _it
+        import secrets as _secrets
+
+        self.boot_instance = _secrets.randbits(63)
         # watch/notify state: (pgid, oid) -> {(watcher, cookie): conn}
         # (reference Watch/Notify on PrimaryLogPG)
         self._watchers: Dict[Tuple, Dict[Tuple[str, int], Connection]] = {}
@@ -132,7 +143,8 @@ class OSDDaemon(Dispatcher):
         since = self._load_superblock()
         addr = await self.messenger.bind(host, port)
         # boot must surface unreachable monitors, not run unregistered
-        await self._mon_send(M.MOSDBoot(osd_id=self.osd_id, addr=addr),
+        await self._mon_send(M.MOSDBoot(osd_id=self.osd_id, addr=addr,
+                                        instance=self.boot_instance),
                              raise_on_fail=True)
         await self._mon_send(
             M.MMonSubscribe(what="osdmap", addr=addr, since=since))
@@ -164,8 +176,11 @@ class OSDDaemon(Dispatcher):
 
     async def stop(self) -> None:
         self._stopped = True
-        for t in self._tasks:
+        for t in list(self._tasks) + list(self._opq_running):
             t.cancel()
+        if self._opq_running:
+            await asyncio.gather(*self._opq_running,
+                                 return_exceptions=True)
         await self.messenger.shutdown()
         self.store.umount()
 
@@ -478,7 +493,8 @@ class OSDDaemon(Dispatcher):
             # OSD::start_boot after _committed_osd_maps notices the same)
             self.perf.inc("osd_re_boots")
             await self._mon_send(M.MOSDBoot(osd_id=self.osd_id,
-                                            addr=self.messenger.my_addr))
+                                            addr=self.messenger.my_addr,
+                                            instance=self.boot_instance))
         changed = self._advance_pgs()
         if changed and not self._stopped:
             self._tasks.append(asyncio.get_event_loop().create_task(
@@ -569,16 +585,13 @@ class OSDDaemon(Dispatcher):
             return
         m, pool, st = resolved
         if self._opq is not None:
-            from ceph_tpu.cluster.dmclock import QoSSpec
-
-            self._opq.ensure_client(msg.reqid[0], QoSSpec(
-                reservation=self.config.osd_mclock_default_reservation,
-                weight=self.config.osd_mclock_default_weight,
-                limit=self.config.osd_mclock_default_limit))
-            # queue ONLY (conn, msg): the map/pool/PG/primary state is
-            # re-resolved at dequeue time — a queued op must not execute
-            # against a stale acting set after a map change
-            self._opq.enqueue(msg.reqid[0], (conn, msg))
+            self._opq.ensure_client(msg.reqid[0], self._opq_default)
+            # queue ONLY (conn, msg, stamp): map/pool/PG/primary state is
+            # re-resolved at dequeue time, and ops that outlived the
+            # client's attempt window are dropped (the client has already
+            # resent; executing the stale copy would double-apply)
+            self._opq.enqueue(msg.reqid[0],
+                              (conn, msg, time.monotonic()))
             self.perf.inc("osd_ops_queued_mclock")
             self._opq_event.set()
             return
@@ -588,12 +601,13 @@ class OSDDaemon(Dispatcher):
         """Serve the dmClock queue (the ShardedOpWQ dequeue loop): QoS
         decides WHEN an op starts; execution runs as its own task so one
         slow write never head-of-line blocks other clients/PGs."""
-        running: Set[asyncio.Task] = set()
         while not self._stopped:
             item = self._opq.dequeue()
             if item is None:
-                if len(self._opq):
-                    await asyncio.sleep(0.005)  # throttled: next L-tag soon
+                wait = self._opq.next_eligible_in()
+                if wait is not None:
+                    # throttled: sleep until the earliest L-tag matures
+                    await asyncio.sleep(min(max(wait, 0.002), 0.25))
                 else:
                     self._opq_event.clear()
                     try:
@@ -601,11 +615,16 @@ class OSDDaemon(Dispatcher):
                     except asyncio.TimeoutError:
                         pass
                 continue
-            conn, msg = item
+            conn, msg, stamp = item
+            if time.monotonic() - stamp > self.config.osd_client_op_timeout:
+                # the client abandoned this attempt and resent: executing
+                # the stale copy would double-apply the op
+                self.perf.inc("osd_ops_dropped_stale")
+                continue
             t = asyncio.get_event_loop().create_task(
                 self._serve_queued_op(conn, msg))
-            running.add(t)
-            t.add_done_callback(running.discard)
+            self._opq_running.add(t)
+            t.add_done_callback(self._opq_running.discard)
 
     async def _serve_queued_op(self, conn, msg) -> None:
         try:
