@@ -1,0 +1,99 @@
+/* Measured CPU baseline for CRUSH placement throughput.
+ *
+ * Links the reference's pure-C CRUSH core out-of-tree (same pattern as
+ * ../gen_crush_golden/harness.c — no reference code enters the repo) and
+ * times crush_do_rule over the bench topology bench.py uses
+ * (ceph_tpu/crush/__init__.py bench_map): 40 racks x 16 hosts x 16 osds,
+ * straw2 everywhere, jewel/optimal tunables, chooseleaf_firstn 3 (host),
+ * 1M placements.  Output: one JSON line with mappings/s.
+ *
+ * Build: gcc -O3 -I$REF/src/crush -I. -o crush_baseline crush_baseline.c \
+ *            $REF/src/crush/{mapper,builder,crush,hash}.c -lm
+ */
+
+#include <stdio.h>
+#include <stdlib.h>
+#include <time.h>
+#include "builder.h"
+#include "crush.h"
+#include "hash.h"
+#include "mapper.h"
+
+static double now_s(void) {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return ts.tv_sec + ts.tv_nsec * 1e-9;
+}
+
+static int add_straw2(struct crush_map *m, int type, int n, int *items,
+                      int *weights) {
+    struct crush_bucket *b = crush_make_bucket(
+        m, CRUSH_BUCKET_STRAW2, CRUSH_HASH_RJENKINS1, type, n, items, weights);
+    int id;
+    crush_add_bucket(m, 0, b, &id);
+    return id;
+}
+
+int main(int argc, char **argv) {
+    int n_racks = 40, hosts_per_rack = 16, osds_per_host = 16, numrep = 3;
+    int n_pgs = argc > 1 ? atoi(argv[1]) : 1000000;
+
+    struct crush_map *m = crush_create();
+    m->choose_total_tries = 50;
+    m->choose_local_tries = 0;
+    m->choose_local_fallback_tries = 0;
+    m->chooseleaf_descend_once = 1;
+    m->chooseleaf_vary_r = 1;
+    m->chooseleaf_stable = 1;
+
+    int dev = 0;
+    int *rack_ids = malloc(sizeof(int) * n_racks);
+    int *rack_w = malloc(sizeof(int) * n_racks);
+    for (int r = 0; r < n_racks; r++) {
+        int host_ids[64], host_w[64];
+        for (int h = 0; h < hosts_per_rack; h++) {
+            int items[64], weights[64];
+            for (int o = 0; o < osds_per_host; o++) {
+                items[o] = dev++;
+                weights[o] = 0x10000;
+            }
+            host_ids[h] = add_straw2(m, 1, osds_per_host, items, weights);
+            host_w[h] = osds_per_host * 0x10000;
+        }
+        rack_ids[r] = add_straw2(m, 2, hosts_per_rack, host_ids, host_w);
+        rack_w[r] = hosts_per_rack * osds_per_host * 0x10000;
+    }
+    int root = add_straw2(m, 3, n_racks, rack_ids, rack_w);
+
+    struct crush_rule *rule = crush_make_rule(3, 0, 1, 1, 10);
+    crush_rule_set_step(rule, 0, CRUSH_RULE_TAKE, root, 0);
+    crush_rule_set_step(rule, 1, CRUSH_RULE_CHOOSELEAF_FIRSTN, numrep, 1);
+    crush_rule_set_step(rule, 2, CRUSH_RULE_EMIT, 0, 0);
+    int ruleno = crush_add_rule(m, rule, -1);
+    crush_finalize(m);
+
+    int nw = dev;
+    __u32 *weights = malloc(sizeof(__u32) * nw);
+    for (int i = 0; i < nw; i++) weights[i] = 0x10000;
+    void *cw = malloc(m->working_size + 3 * numrep * sizeof(int));
+    crush_init_workspace(m, cw);
+    int result[8];
+
+    /* warmup + 3 timed repeats, median-free best (favor the baseline) */
+    double best = 0;
+    long long sink = 0;
+    for (int rep = 0; rep < 4; rep++) {
+        double t0 = now_s();
+        for (int x = 0; x < n_pgs; x++) {
+            int len = crush_do_rule(m, ruleno, x, result, numrep,
+                                    weights, nw, cw, NULL);
+            sink += len ? result[0] : 0;
+        }
+        double dt = now_s() - t0;
+        double rate = n_pgs / dt;
+        if (rep > 0 && rate > best) best = rate;
+    }
+    printf("{\"config\": \"crush_10kosd_1Mpg\", \"mappings_per_s\": %.0f, "
+           "\"sink\": %lld}\n", best, sink);
+    return 0;
+}

@@ -1,0 +1,36 @@
+#!/bin/sh
+# Build + run the measured CPU baseline suite and write the repo-root
+# BASELINE_MEASURED.json that bench.py uses for vs_baseline denominators.
+# See ec_baseline.c / crush_baseline.c / crc_baseline.c headers for what
+# each measures and why it stands in for the reference binaries
+# (empty submodules in this checkout).
+set -e
+cd "$(dirname "$0")"
+REF=${REF:-/root/reference}
+
+python dump_ops.py > baseline_ops.h
+gcc -O3 -march=native -o ec_baseline ec_baseline.c
+gcc -O3 -march=native -o crc_baseline crc_baseline.c
+gcc -O3 -I. -I../gen_crush_golden -I"$REF/src/crush" -I"$REF/src" \
+    -o crush_baseline crush_baseline.c \
+    "$REF/src/crush/mapper.c" "$REF/src/crush/builder.c" \
+    "$REF/src/crush/crush.c" "$REF/src/crush/hash.c" -lm
+
+# run each binary to its own file first so a mid-run crash fails the
+# script (set -e alone would miss a failure on the left of a pipe)
+./ec_baseline    > ec.out
+./crc_baseline   > crc.out
+./crush_baseline > crush.out
+
+{
+  echo '{'
+  echo '  "host": "'"$(grep -m1 'model name' /proc/cpuinfo | cut -d: -f2 | sed 's/^ //')"'",'
+  echo '  "date": "'"$(date -u +%Y-%m-%dT%H:%M:%SZ)"'",'
+  echo '  "results": ['
+  sed 's/$/,/' ec.out crc.out
+  cat crush.out
+  echo '  ]'
+  echo '}'
+} > ../../BASELINE_MEASURED.json
+rm -f ec.out crc.out crush.out
+echo "wrote BASELINE_MEASURED.json"
