@@ -94,6 +94,7 @@ class MMonPaxos(Message):
     op: str = "collect"
     pn: int = 0
     rank: int = -1
+    epoch: int = 0             # election epoch (lease fencing)
     last_committed: int = 0
     version: int = 0           # version being proposed / committed
     value: bytes = b""         # pickled payload

@@ -178,6 +178,8 @@ class Monitor(Dispatcher):
                     try:
                         await self._send_mon(r, M.MMonPaxos(
                             op="lease", rank=self.rank,
+                            epoch=(self.elector.epoch
+                                   if self.elector else 0),
                             last_committed=self.paxos.last_committed))
                     except (ConnectionError, OSError):
                         pass
@@ -274,6 +276,11 @@ class Monitor(Dispatcher):
             return True
         if isinstance(msg, M.MMonPaxos):
             if msg.op == "lease":
+                # fence stale ex-leaders: a lease from an older election
+                # epoch must not refresh the timeout or flip forwarding
+                # (reference Paxos::handle_lease epoch check)
+                if self.elector is not None and msg.epoch < self.elector.epoch:
+                    return True
                 self._last_lease = time.monotonic()
                 self.leader_rank = msg.rank
             elif self.paxos:

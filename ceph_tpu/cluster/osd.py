@@ -15,6 +15,7 @@ from __future__ import annotations
 import asyncio
 import pickle
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -56,6 +57,17 @@ class PGState:
     # src/osd/OSD.h:1599): mutations hold this across their whole
     # fan-out so concurrent writes order identically on all replicas
     lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+    # reqid -> cached replies of completed mutations (reference pg_log
+    # dup tracking, osd_pg_log_dups_tracked): a resent non-idempotent op
+    # (exec, delete, ...) returns its original reply instead of
+    # re-executing.  In-memory only — a primary restart forgets dups the
+    # way a reference OSD forgets dups past the trimmed log.
+    reqid_replies: "OrderedDict[Tuple, List]" = field(
+        default_factory=OrderedDict)
+    # reqids currently executing: a dup that races its first instance
+    # waits for that instance's replies rather than re-executing
+    reqid_inflight: Dict[Tuple, asyncio.Future] = field(
+        default_factory=dict)
 
     def info(self) -> PGInfo:
         return PGInfo(last_update=self.last_update, log_tail=self.log.tail)
@@ -652,6 +664,14 @@ class OSDDaemon(Dispatcher):
             self._opq.set_client(client, QoSSpec(
                 reservation=reservation, weight=weight, limit=limit))
 
+    # ops whose effects are not idempotent under at-least-once delivery;
+    # a resend must return the cached original reply (reference pg_log
+    # dup detection, PGLog dups / osd_pg_log_dups_tracked)
+    _MUTATING_OPS = frozenset({
+        "write_full", "write", "delete", "setxattr", "rmxattr",
+        "omap_set", "omap_rmkeys", "exec"})
+    _REQID_DUPS_TRACKED = 3000
+
     async def _dispatch_client_op(self, conn, msg, m, pool, st) -> None:
         self.perf.inc("osd_client_ops")
         top = self.tracker.create(
@@ -659,9 +679,56 @@ class OSDDaemon(Dispatcher):
             f"{[o[0] for o in msg.ops]})")
         top.mark("dispatched")
         try:
-            await self._execute_client_ops(conn, msg, m, pool, st, top)
+            if any(o[0] in self._MUTATING_OPS for o in msg.ops):
+                await self._execute_mutation_dedup(conn, msg, m, pool, st,
+                                                  top)
+            else:
+                await self._execute_client_ops(conn, msg, m, pool, st, top)
         finally:
             top.finish()
+
+    async def _execute_mutation_dedup(self, conn, msg, m, pool, st, top):
+        reqid = tuple(msg.reqid)
+        cached = st.reqid_replies.get(reqid)
+        if cached is None and reqid in st.reqid_inflight:
+            # dup racing its first instance: wait for it, then answer
+            # from its replies
+            await asyncio.shield(st.reqid_inflight[reqid])
+            cached = st.reqid_replies.get(reqid)
+        if cached is not None:
+            self.perf.inc("osd_dup_ops")
+            top.mark("dup_reply_from_cache")
+            for reply in cached:
+                await conn.send(reply)
+            return
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        st.reqid_inflight[reqid] = fut
+
+        sent: List = []
+
+        class _RecordingConn:
+            """Forwards sends while capturing replies for the dup cache."""
+
+            def __init__(self, inner):
+                self._inner = inner
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+            async def send(self, reply):
+                sent.append(reply)
+                await self._inner.send(reply)
+
+        try:
+            await self._execute_client_ops(
+                _RecordingConn(conn), msg, m, pool, st, top)
+            st.reqid_replies[reqid] = sent
+            while len(st.reqid_replies) > self._REQID_DUPS_TRACKED:
+                st.reqid_replies.popitem(last=False)
+        finally:
+            st.reqid_inflight.pop(reqid, None)
+            if not fut.done():
+                fut.set_result(None)
 
     async def _execute_client_ops(self, conn, msg, m, pool, st, top):
         for opname, args in msg.ops:
@@ -1582,6 +1649,15 @@ class OSDDaemon(Dispatcher):
                     continue
                 inconsistent.append(oid)
                 self.perf.inc("osd_scrub_errors")
+                # only auto-repair with a strict-majority authoritative
+                # copy; on a tie (e.g. 1-1 on size-2 pools) repairing
+                # would arbitrarily overwrite a possibly-good replica —
+                # the reference marks the object inconsistent instead
+                sizes = sorted((len(v) for v in votes.values()),
+                               reverse=True)
+                if len(sizes) > 1 and sizes[0] == sizes[1]:
+                    self.perf.inc("osd_scrub_ties")
+                    continue
                 winner = max(votes.values(), key=len)
                 if self.osd_id not in winner:
                     if not await self._pull_rep_object(st, winner[0], oid):

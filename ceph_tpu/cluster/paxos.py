@@ -354,6 +354,9 @@ class Paxos:
             # already committed (a stale leader that missed commits)
             if msg.pn >= self.accepted_pn and \
                     msg.version == self.last_committed + 1:
+                # promise invariant: once we accept pn we must refuse any
+                # later collect with a lower pn (reference handle_begin)
+                self.accepted_pn = msg.pn
                 self.uncommitted = (msg.pn, msg.version, msg.value)
                 try:
                     await self.send(msg.rank, M.MMonPaxos(

@@ -1,0 +1,153 @@
+"""Round-4 ADVICE regression tests: reqid duplicate detection (the
+reference's pg_log dup tracking), stale-leader lease fencing, and
+scrub-repair tie handling.
+
+Reference seams: PGLog dup tracking (src/osd/PGLog.h, the
+osd_pg_log_dups_tracked window), Paxos::handle_lease epoch check
+(src/mon/Paxos.cc), and scrub auto-repair requiring an authoritative
+copy (src/osd/PrimaryLogPG scrub repair).
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.cluster import messages as M
+from ceph_tpu.cluster.vstart import start_cluster
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _send_op_raw(objecter, pool_id, oid, ops, reqid):
+    """Send one MOSDOp with a FIXED reqid and await its reply — lets a
+    test deliver byte-identical duplicates the way a resend does."""
+    pgid = objecter.object_pgid(pool_id, oid)
+    primary = objecter._target_osd(pgid)
+    addr = objecter.osdmap.osd_addrs[primary]
+    fut = asyncio.get_event_loop().create_future()
+    objecter._inflight[reqid] = fut
+    await objecter.messenger.send_message(
+        M.MOSDOp(reqid=reqid, pgid=pgid, oid=oid, ops=ops,
+                 epoch=objecter.osdmap.epoch), tuple(addr))
+    return await asyncio.wait_for(fut, timeout=30)
+
+
+def test_duplicate_exec_returns_cached_reply():
+    """A resent non-idempotent exec (inotable.alloc) must not allocate a
+    second inode: the dup gets the original reply from the reqid cache."""
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("meta", "replicated",
+                                            pg_num=8, size=2)
+            obj = client.objecter
+            reqid = (obj.client_name, 999_991)
+            ops = [("exec", {"cls": "inotable", "method": "alloc",
+                             "indata": b""})]
+            r1 = await _send_op_raw(obj, pool, "ino_obj", ops, reqid)
+            r2 = await _send_op_raw(obj, pool, "ino_obj", ops, reqid)
+            assert r1.result == 0
+            assert r2.result == r1.result
+            assert r2.data == r1.data, \
+                "duplicate exec re-executed: allocated a fresh inode"
+            # a genuinely new reqid must still allocate the next inode
+            r3 = await _send_op_raw(obj, pool, "ino_obj", ops,
+                                    (obj.client_name, 999_992))
+            assert r3.data != r1.data
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_duplicate_write_and_delete_cached():
+    """A resent delete returns the original 0, not -ENOENT."""
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("dpool", "replicated",
+                                            pg_num=8, size=2)
+            io = client.ioctx(pool)
+            obj = client.objecter
+            await io.write_full("victim", b"payload")
+            reqid = (obj.client_name, 999_993)
+            ops = [("delete", {})]
+            r1 = await _send_op_raw(obj, pool, "victim", ops, reqid)
+            r2 = await _send_op_raw(obj, pool, "victim", ops, reqid)
+            assert r1.result == 0
+            assert r2.result == 0, \
+                f"duplicate delete re-executed -> {r2.result}"
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_stale_leader_lease_ignored():
+    """A lease carrying an older election epoch must neither refresh the
+    peon's lease timer nor flip its forwarding target."""
+    async def scenario():
+        cluster = await start_cluster(2, n_mons=3)
+        try:
+            peon = next(m for m in cluster.mons if not m.is_leader)
+            leader_rank = peon.leader_rank
+            stale_epoch = peon.elector.epoch - 2
+            before = peon._last_lease
+            await asyncio.sleep(0.05)
+            # forge a lease from a deposed leader (older epoch, rank != now)
+            fake_rank = next(r for r in range(3)
+                             if r not in (leader_rank, peon.rank))
+            await peon.ms_dispatch(None, M.MMonPaxos(
+                op="lease", rank=fake_rank, epoch=stale_epoch,
+                last_committed=0))
+            assert peon.leader_rank == leader_rank, \
+                "stale lease flipped the forwarding target"
+            assert peon._last_lease == before, \
+                "stale lease refreshed the lease timer"
+            # current-epoch lease still lands
+            await peon.ms_dispatch(None, M.MMonPaxos(
+                op="lease", rank=leader_rank, epoch=peon.elector.epoch,
+                last_committed=0))
+            assert peon._last_lease > before
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_scrub_tie_marks_inconsistent_not_repaired():
+    """size-2 pool, 1-1 crc split: scrub must record the object as
+    inconsistent and must NOT push either copy over the other."""
+    async def scenario():
+        cluster = await start_cluster(2)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("two", "replicated",
+                                            pg_num=8, size=2)
+            io = client.ioctx(pool)
+            await io.write_full("tied", b"good-data")
+            await asyncio.sleep(0.1)
+            pgid = client.objecter.object_pgid(pool, "tied")
+            _, _, acting, primary = \
+                client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+            coll = f"pg_{pgid.pool}_{pgid.seed}"
+            # corrupt the PRIMARY copy: under first-inserted tie-breaking
+            # this bad copy would win and clobber the good replica
+            from ceph_tpu.cluster.store import Transaction
+            cluster.osds[primary].store.queue_transaction(
+                Transaction().write(coll, "tied", 0, b"BAD!-data"))
+            st = cluster.osds[primary].pgs[pgid]
+            report = await cluster.osds[primary].scrub_pg(st)
+            assert "tied" in report["inconsistent"]
+            assert "tied" not in report["repaired"]
+            replica = next(o for o in acting if o != primary)
+            assert cluster.osds[replica].store.read(coll, "tied") == \
+                b"good-data", "tie repair overwrote the good replica"
+        finally:
+            await cluster.stop()
+
+    run(scenario())
