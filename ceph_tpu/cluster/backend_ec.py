@@ -1,0 +1,360 @@
+"""ECBackend: striped shard writes/reads, RMW, decode recovery
+(reference src/osd/ECBackend.cc:921,986,1141 via the PGBackend seam).
+Encode/decode of the touched stripe range is one batched TPU dispatch."""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+from typing import Dict, List, Optional, Set, Tuple
+
+from ceph_tpu.cluster import messages as M
+from ceph_tpu.cluster.messenger import Connection
+from ceph_tpu.cluster.pglog import LogEntry
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+from ceph_tpu.cluster.pg import PGState, _coll
+from ceph_tpu.cluster.store import Transaction
+from ceph_tpu.ops import crc32c as crcmod
+from ceph_tpu.osdmap.osdmap import PGid, PGPool
+
+
+class ECBackendMixin:
+
+    def _codec(self, pool: PGPool):
+        codec = self._codecs.get(pool.pool_id)
+        if codec is None:
+            from ceph_tpu.ec import factory
+
+            profile = pool.ec_profile or {
+                "plugin": "jerasure", "technique": "reed_sol_van",
+                "k": "2", "m": "1"}
+            codec = factory(profile)
+            self._codecs[pool.pool_id] = codec
+        return codec
+
+    def _sinfo(self, pool: PGPool, codec) -> "StripeInfo":
+        """Stripe layout for a pool (ECUtil::stripe_info_t analog)."""
+        from ceph_tpu.ec.stripe import StripeInfo
+
+        unit = int((pool.ec_profile or {}).get(
+            "stripe_unit", self.config.osd_ec_stripe_unit))
+        return StripeInfo(codec.get_data_chunk_count(), unit)
+
+    # ----------------------------------------------------------- EC backend
+    #
+    # Objects are striped (ECUtil::stripe_info_t math, ceph_tpu.ec.stripe):
+    # shard s holds stripe-chunk s of every stripe, concatenated.  Encode /
+    # decode of the whole touched stripe range happens in one batched TPU
+    # dispatch; partial writes are read-modify-write over stripe bounds
+    # (reference ECBackend::start_rmw, ECBackend.cc:1785-1886).
+
+    async def _ec_write(self, pool: PGPool, st: PGState, oid: str,
+                        data: bytes, offset: Optional[int]) -> int:
+        """EC write incl. the RMW sequence (read old stripes, merge,
+        re-encode, fan out shard writes).  Serialization: callers hold the
+        PG-wide st.lock across the whole op, so overlapping RMWs to one
+        object can never interleave (the reference serializes them in the
+        ECBackend pipeline, ECBackend::start_rmw wait queue; our domain is
+        the whole PG, like the reference's PG lock)."""
+        from ceph_tpu.ec import stripe as stripemod
+
+        codec = self._codec(pool)
+        sinfo = self._sinfo(pool, codec)
+        coll = _coll(st.pgid)
+        eversion = self._next_version(st)
+        version = eversion[1]
+
+        if offset is None:
+            # write_full: replace the object
+            new_size = len(data)
+            chunk_off = 0
+            shards = await self._compute(
+                stripemod.encode_stripes, codec, sinfo, data)
+        else:
+            sa = self.store.getattr(coll, oid, "size")
+            old_size = int(sa) if sa else 0
+            off0, len0 = sinfo.offset_len_to_stripe_bounds(offset, len(data))
+            chunk_off = sinfo.aligned_logical_offset_to_chunk_offset(off0)
+            old_in_range = max(0, min(old_size - off0, len0))
+            old_bytes = b""
+            if old_in_range:
+                old_bytes = await self._ec_read_stripes(
+                    pool, st, oid, chunk_off, old_in_range)
+            merged = stripemod.merge_range(
+                old_bytes, old_in_range, offset - off0, data)
+            new_size = max(old_size, offset + len(data))
+            shards = await self._compute(
+                stripemod.encode_stripes, codec, sinfo, merged)
+
+        shard_size = sinfo.shard_size(new_size)
+        hinfo = {"size": new_size, "version": version}
+        n = codec.get_chunk_count()
+        reqid = self._next_reqid()
+        peers = []
+        my_shard = None
+        for shard in range(n):
+            osd = st.acting[shard] if shard < len(st.acting) else CRUSH_ITEM_NONE
+            if osd == self.osd_id:
+                my_shard = shard
+            elif osd != CRUSH_ITEM_NONE:
+                peers.append((osd, shard))
+        if my_shard is not None:
+            self._apply_shard(st.pgid, oid, my_shard,
+                              shards[my_shard].tobytes(), chunk_off,
+                              shard_size, hinfo)
+        entry = self._log_mutation(st, "modify", oid, eversion)
+        if peers:
+            fut = self._make_waiter(reqid, len(peers))
+            for osd, shard in peers:
+                try:
+                    await self._send_osd(osd, M.MOSDECSubOpWrite(
+                        reqid=reqid, pgid=st.pgid, oid=oid, shard=shard,
+                        data=shards[shard].tobytes(), chunk_off=chunk_off,
+                        shard_size=shard_size, hinfo=hinfo, entry=entry,
+                        epoch=self.osdmap.epoch))
+                except (ConnectionError, OSError, RuntimeError):
+                    self._waiter_dec(reqid)
+            try:
+                if not fut.done():
+                    await asyncio.wait_for(
+                        fut, timeout=self.config.osd_client_op_timeout)
+            except asyncio.TimeoutError:
+                return -110
+            finally:
+                self._pending.pop(reqid, None)
+        return 0
+
+    def _apply_shard(self, pgid: PGid, oid: str, shard: int, data: bytes,
+                     chunk_off: int, shard_size: int, hinfo: Dict) -> None:
+        """Apply a shard sub-range write with its crc in ONE atomic
+        transaction (ECUtil::HashInfo analog, reference ECUtil.h:105-163:
+        the crc is CUMULATIVE for appends/full rewrites — no whole-shard
+        re-read on the hot path — and data+crc can never disagree)."""
+        coll = _coll(pgid)
+        old_size = self.store.stat(coll, oid)
+        if chunk_off == 0 and len(data) >= shard_size:
+            # full-shard rewrite: one pass over the payload
+            crc = crcmod.crc32c(0xFFFFFFFF, data[:shard_size])
+        elif old_size is not None and chunk_off == old_size and \
+                shard_size == chunk_off + len(data):
+            # append: combine the stored cumulative crc with the new
+            # bytes' crc (GF(2) zero-extension, reference HashInfo append)
+            stored = self.store.getattr(coll, oid, "hinfo_crc")
+            if stored is not None:
+                crc = crcmod.crc32c_combine(
+                    int(stored), crcmod.crc32c(0, data), len(data))
+            else:
+                crc = crcmod.crc32c(0xFFFFFFFF,
+                                    self.store.read(coll, oid) + data)
+        else:
+            # true mid-shard RMW: recompute over the merged bytes
+            old = bytearray(self.store.read(coll, oid)) \
+                if old_size is not None else bytearray()
+            if len(old) < shard_size:
+                old.extend(b"\0" * (shard_size - len(old)))
+            old[chunk_off:chunk_off + len(data)] = data
+            crc = crcmod.crc32c(0xFFFFFFFF, bytes(old[:shard_size]))
+        txn = (Transaction()
+               .write(coll, oid, chunk_off, data)
+               .truncate(coll, oid, shard_size)
+               .setattr(coll, oid, "shard", str(shard).encode())
+               .setattr(coll, oid, "size", str(hinfo["size"]).encode())
+               .setattr(coll, oid, "hinfo_crc", str(crc).encode())
+               .set_version(coll, oid, hinfo["version"]))
+        self.store.queue_transaction(txn)
+
+    async def _handle_ec_write(self, conn: Connection,
+                               msg: M.MOSDECSubOpWrite) -> None:
+        shard_size = msg.shard_size if msg.shard_size is not None \
+            else msg.chunk_off + len(msg.data)
+        self._apply_shard(msg.pgid, msg.oid, msg.shard, msg.data,
+                          msg.chunk_off, shard_size, msg.hinfo)
+        st = self.pgs.get(msg.pgid)
+        if st is not None and msg.entry is not None:
+            self._log_mutation(st, msg.entry.op, msg.entry.oid,
+                               msg.entry.version, entry=msg.entry)
+        self.perf.inc("osd_ec_sub_writes")
+        await conn.send(M.MOSDECSubOpWriteReply(reqid=msg.reqid, result=0))
+
+    async def _handle_ec_read(self, conn: Connection,
+                              msg: M.MOSDECSubOpRead) -> None:
+        try:
+            full = self.store.read(_coll(msg.pgid), msg.oid)
+            stored_crc = self.store.getattr(_coll(msg.pgid), msg.oid,
+                                            "hinfo_crc")
+            # scrub-on-read: verify the shard crc (ecbackend.rst:86-99)
+            if stored_crc is not None and \
+                    int(stored_crc) != crcmod.crc32c(0xFFFFFFFF, full):
+                raise IOError("chunk crc mismatch")
+            data = full[msg.off: msg.off + msg.length] \
+                if msg.length is not None else full[msg.off:]
+            shard_attr = self.store.getattr(_coll(msg.pgid), msg.oid, "shard")
+            shard = int(shard_attr) if shard_attr else msg.shard
+            size = self.store.getattr(_coll(msg.pgid), msg.oid, "size")
+            hinfo = {"size": int(size) if size else 0}
+            if msg.shard == -1:
+                # whole-object fetch (pull recovery): carry version +
+                # xattrs so the puller stores a faithful copy
+                hinfo["version"] = self.store.get_version(
+                    _coll(msg.pgid), msg.oid)
+                o = self.store._colls.get(_coll(msg.pgid), {}).get(msg.oid)
+                hinfo["xattrs"] = dict(o.xattrs) if o else {}
+            await conn.send(M.MOSDECSubOpReadReply(
+                reqid=msg.reqid, result=0, shard=shard, data=data,
+                hinfo=hinfo))
+            self.perf.inc("osd_ec_sub_reads")
+        except (FileNotFoundError, IOError):
+            await conn.send(M.MOSDECSubOpReadReply(
+                reqid=msg.reqid, result=-2, shard=msg.shard))
+
+    async def _gather_shards(
+        self, pool: PGPool, st: PGState, oid: str, need_k: int,
+        off: int = 0, length: Optional[int] = None,
+        exclude_shards: Optional[Set[int]] = None,
+    ) -> Tuple[Dict[int, bytes], int]:
+        """Collect >= k shard (ranges) from the acting set (own shard
+        free).  ``exclude_shards``: shard ids known corrupt — they must
+        never be decode sources (scrub repair would otherwise reconstruct
+        FROM the corruption and bless it)."""
+        exclude_shards = exclude_shards or set()
+        shards: Dict[int, bytes] = {}
+        size = 0
+        my = self.store.stat(_coll(st.pgid), oid)
+        if my is not None:
+            data = self.store.read(_coll(st.pgid), oid, off, length)
+            shard_attr = self.store.getattr(_coll(st.pgid), oid, "shard")
+            if shard_attr is not None and                     int(shard_attr) not in exclude_shards:
+                shards[int(shard_attr)] = data
+            sa = self.store.getattr(_coll(st.pgid), oid, "size")
+            size = int(sa) if sa else 0
+        peers = [(shard, osd) for shard, osd in enumerate(st.acting)
+                 if osd not in (self.osd_id, CRUSH_ITEM_NONE)
+                 and shard not in shards and shard not in exclude_shards]
+        if peers and len(shards) < need_k:
+            reqid = self._next_reqid()
+            fut = self._make_waiter(reqid, len(peers))
+            for shard, osd in peers:
+                try:
+                    await self._send_osd(osd, M.MOSDECSubOpRead(
+                        reqid=reqid, pgid=st.pgid, oid=oid, shard=shard,
+                        off=off, length=length))
+                except (ConnectionError, OSError, RuntimeError):
+                    self._waiter_dec(reqid)
+            try:
+                if fut.done():
+                    acc = fut.result()
+                else:
+                    acc = await asyncio.wait_for(
+                        fut, timeout=self.config.osd_client_op_timeout)
+            except asyncio.TimeoutError:
+                acc = self._pending[reqid][1]
+            finally:
+                self._pending.pop(reqid, None)
+            for result, reply in acc:
+                if result == 0 and reply is not None:
+                    shards[reply.shard] = reply.data
+                    if reply.hinfo.get("size"):
+                        size = reply.hinfo["size"]
+        return shards, size
+
+    async def _ec_read_stripes(self, pool: PGPool, st: PGState, oid: str,
+                               chunk_off: int, logical_len: int) -> bytes:
+        """Read a stripe-aligned logical range: gather the touched chunk
+        range from >= k shards and decode it as a mini-object."""
+        from ceph_tpu.ec import stripe as stripemod
+        import numpy as np
+
+        codec = self._codec(pool)
+        sinfo = self._sinfo(pool, codec)
+        k = codec.get_data_chunk_count()
+        nstripes = sinfo.object_stripes(logical_len)
+        chunk_len = nstripes * sinfo.chunk_size
+        shards, _ = await self._gather_shards(
+            pool, st, oid, k, off=chunk_off, length=chunk_len)
+        avail = {s: np.frombuffer(d, dtype=np.uint8)
+                 for s, d in shards.items()
+                 if len(d) == chunk_len}
+        if len(avail) < k:
+            raise IOError(
+                f"only {len(avail)} of {k} shard ranges for {oid}")
+        return await self._compute(
+            stripemod.decode_stripes, codec, sinfo, avail, logical_len)
+
+    async def _ec_read(self, pool: PGPool, st: PGState, oid: str,
+                       offset: int = 0, length: Optional[int] = None) -> bytes:
+        """objects_read_async analog: min shards + batched TPU decode
+        (ECBackend.cc:2111,1588,2262)."""
+        coll = _coll(st.pgid)
+        sa = self.store.getattr(coll, oid, "size")
+        if sa is None:
+            # primary lost its shard (or never had one): probe peers
+            codec = self._codec(pool)
+            shards, size = await self._gather_shards(
+                pool, st, oid, codec.get_data_chunk_count(), 0, 0)
+            if not shards and size == 0:
+                raise FileNotFoundError(oid)
+        else:
+            size = int(sa)
+        if length is None:
+            length = max(0, size - offset)
+        if length == 0 or offset >= size:
+            return b""
+        length = min(length, size - offset)
+        codec = self._codec(pool)
+        sinfo = self._sinfo(pool, codec)
+        off0, len0 = sinfo.offset_len_to_stripe_bounds(offset, length)
+        len0 = min(len0, max(0, size - off0))
+        chunk_off = sinfo.aligned_logical_offset_to_chunk_offset(off0)
+        out = await self._ec_read_stripes(pool, st, oid, chunk_off, len0)
+        return out[offset - off0: offset - off0 + length]
+
+    async def _recover_ec_object(self, pool: PGPool, st: PGState, oid: str,
+                                 targets: Optional[List[int]] = None,
+                                 entry: Optional[LogEntry] = None,
+                                 exclude_sources: Optional[Set[int]] = None,
+                                 ) -> bool:
+        """Reconstruct shards for the target members (batched TPU decode +
+        encode, ECBackend::run_recovery_op analog).  targets=None rebuilds
+        every acting member's shard; exclude_sources keeps known-corrupt
+        shard ids out of the decode.  Returns False when the object is
+        currently unrecoverable (fewer than k shard sources)."""
+        from ceph_tpu.ec import stripe as stripemod
+        import numpy as np
+
+        codec = self._codec(pool)
+        sinfo = self._sinfo(pool, codec)
+        k = codec.get_data_chunk_count()
+        shards, size = await self._gather_shards(
+            pool, st, oid, k, exclude_shards=exclude_sources)
+        shard_len = sinfo.shard_size(size)
+        avail = {s: np.frombuffer(d, dtype=np.uint8)
+                 for s, d in shards.items() if len(d) == shard_len}
+        if len(avail) < k:
+            self.perf.inc("osd_unrecoverable")
+            return False
+        data = await self._compute(
+            stripemod.decode_stripes, codec, sinfo, avail, size)
+        chunks = await self._compute(
+            stripemod.encode_stripes, codec, sinfo, data)
+        version = max((self.store.get_version(_coll(st.pgid), oid)), 1)
+        hinfo = {"size": size, "version": version}
+        for shard, osd in enumerate(st.acting):
+            if osd == CRUSH_ITEM_NONE:
+                continue
+            if targets is not None and osd not in targets:
+                continue
+            blob = chunks[shard].tobytes()
+            if osd == self.osd_id:
+                self._apply_shard(st.pgid, oid, shard, blob, 0,
+                                  shard_len, hinfo)
+            else:
+                try:
+                    await self._send_osd(osd, M.MOSDECSubOpWrite(
+                        reqid=self._next_reqid(), pgid=st.pgid, oid=oid,
+                        shard=shard, data=blob, chunk_off=0,
+                        shard_size=shard_len, hinfo=hinfo, entry=entry,
+                        epoch=self.osdmap.epoch))
+                    self.perf.inc("osd_pushes_sent")
+                except ConnectionError:
+                    pass
+        return True

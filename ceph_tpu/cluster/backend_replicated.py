@@ -1,0 +1,184 @@
+"""ReplicatedBackend: local txn + MOSDRepOp fan-out, pull/push
+(reference src/osd/ReplicatedBackend.cc via the PGBackend seam)."""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+from typing import Optional
+
+from ceph_tpu.cluster import messages as M
+from ceph_tpu.cluster import pglog
+from ceph_tpu.cluster.pglog import LogEntry
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+from ceph_tpu.cluster.pg import PGState, _coll
+from ceph_tpu.cluster.store import Transaction
+from ceph_tpu.osdmap.osdmap import PGPool
+
+
+class ReplicatedBackendMixin:
+
+    # replicated write: local txn + MOSDRepOp fan-out (ReplicatedBackend)
+    async def _op_write_full(self, pool: PGPool, st: PGState, oid: str,
+                             data: bytes) -> int:
+        if pool.is_erasure():
+            return await self._ec_write(pool, st, oid, data, offset=None)
+        version = self._next_version(st)
+        txn = (Transaction()
+               .remove(_coll(st.pgid), oid)
+               .write(_coll(st.pgid), oid, 0, data)
+               .set_version(_coll(st.pgid), oid, version[1]))
+        return await self._replicate_txn(st, txn, "modify", oid, version)
+
+    async def _op_write(self, pool: PGPool, st: PGState, oid: str,
+                        offset: int, data: bytes) -> int:
+        """Partial write at (offset, len) — the RMW path for EC pools
+        (reference ECBackend::start_rmw, ECBackend.cc:1785)."""
+        if pool.is_erasure():
+            return await self._ec_write(pool, st, oid, data, offset=offset)
+        version = self._next_version(st)
+        txn = (Transaction()
+               .write(_coll(st.pgid), oid, offset, data)
+               .set_version(_coll(st.pgid), oid, version[1]))
+        return await self._replicate_txn(st, txn, "modify", oid, version)
+
+    async def _replicate_txn(self, st: PGState, txn: Transaction,
+                             op: str, oid: str,
+                             version: pglog.Eversion) -> int:
+        """Apply locally + fan out with the log entry; commit when all
+        acting replicas ack (reference PrimaryLogPG::issue_repop,
+        PrimaryLogPG.cc:9173)."""
+        self.store.queue_transaction(txn)
+        entry = self._log_mutation(st, op, oid, version)
+        peers = [o for o in st.acting
+                 if o != self.osd_id and o != CRUSH_ITEM_NONE]
+        if peers:
+            reqid = self._next_reqid()
+            fut = self._make_waiter(reqid, len(peers))
+            rep = M.MOSDRepOp(reqid=reqid, pgid=st.pgid,
+                              txn_blob=txn.encode(),
+                              entry=entry,
+                              epoch=self.osdmap.epoch)
+            for o in peers:
+                try:
+                    await self._send_osd(o, rep)
+                except (ConnectionError, OSError, RuntimeError):
+                    # peer unreachable (map lag around a failure): the op
+                    # proceeds on the reachable set; the logged entry
+                    # delta-recovers the peer at rejoin (reference: the
+                    # acting set shrinks, missing grows)
+                    self._waiter_dec(reqid)
+            try:
+                if not fut.done():
+                    await asyncio.wait_for(
+                        fut, timeout=self.config.osd_client_op_timeout)
+            except asyncio.TimeoutError:
+                return -110
+            finally:
+                self._pending.pop(reqid, None)
+        return 0
+
+    async def _op_delete(self, pool: PGPool, st: PGState, oid: str) -> int:
+        """Delete is ack-gated exactly like writes — fire-and-forget
+        MOSDRepOps let a slow replica resurrect the object."""
+        version = self._next_version(st)
+        txn = Transaction().remove(_coll(st.pgid), oid)
+        return await self._replicate_txn(st, txn, "delete", oid, version)
+
+    async def _op_read(self, pool: PGPool, st: PGState, oid: str,
+                       offset: int = 0, length: Optional[int] = None) -> bytes:
+        if pool.is_erasure():
+            return await self._ec_read(pool, st, oid, offset, length)
+        return self.store.read(_coll(st.pgid), oid, offset, length)
+
+    async def _pull_rep_object(self, st: PGState, source: int,
+                               oid: str) -> bool:
+        """Fetch a full replicated object from a member (pull recovery,
+        reference ReplicatedBackend::prepare_pull).  Returns success: the
+        caller must NOT claim the authoritative version for objects it
+        failed to pull."""
+        reqid = self._next_reqid()
+        fut = self._make_waiter(reqid, 1)
+        try:
+            await self._send_osd(source, M.MOSDECSubOpRead(
+                reqid=reqid, pgid=st.pgid, oid=oid, shard=-1))
+            acc = await asyncio.wait_for(fut, timeout=2.0)
+            result, reply = acc[0]
+            if result == 0 and reply is not None:
+                txn = (Transaction()
+                       .remove(_coll(st.pgid), oid)
+                       .write(_coll(st.pgid), oid, 0, reply.data)
+                       .set_version(_coll(st.pgid), oid,
+                                    reply.hinfo.get("version", 0)))
+                for k, v in reply.hinfo.get("xattrs", {}).items():
+                    txn.setattr(_coll(st.pgid), oid, k, v)
+                self.store.queue_transaction(txn)
+                return True
+        except (asyncio.TimeoutError, ConnectionError):
+            pass
+        finally:
+            self._pending.pop(reqid, None)
+        return False
+
+    async def _push_object(self, pool: PGPool, st: PGState, osd: int,
+                           oid: str, entry: LogEntry) -> None:
+        """Replay one log entry onto a stale member (delta recovery)."""
+        if entry.op == "delete":
+            try:
+                await self._send_osd(osd, M.MOSDPGPush(
+                    pgid=st.pgid, oid=oid, op="delete",
+                    version=entry.version[1], entry=entry))
+                self.perf.inc("osd_pushes_sent")
+            except ConnectionError:
+                pass
+            return
+        if pool.is_erasure():
+            await self._recover_ec_object(pool, st, oid, targets=[osd],
+                                          entry=entry)
+            return
+        coll = _coll(st.pgid)
+        if self.store.stat(coll, oid) is None:
+            return
+        data = self.store.read(coll, oid)
+        try:
+            await self._send_osd(osd, M.MOSDPGPush(
+                pgid=st.pgid, oid=oid, data=data,
+                version=entry.version[1], entry=entry))
+            self.perf.inc("osd_pushes_sent")
+        except ConnectionError:
+            pass
+
+
+    def _handle_push(self, msg: M.MOSDPGPush) -> None:
+        coll = _coll(msg.pgid)
+        st = self.pgs.get(msg.pgid)
+        if msg.op == "log_sync":
+            if st is not None:
+                st.last_update, st.log = pickle.loads(msg.data)
+                self._save_pg_meta(st)
+            return
+        if msg.op == "delete":
+            # version-guarded like pushes: a stale delete (old primary's
+            # backfill racing a newer primary's push) must not remove a
+            # newer object
+            cur = self.store.get_version(coll, msg.oid)
+            if cur <= msg.version:
+                self.store.queue_transaction(
+                    Transaction().remove(coll, msg.oid))
+        else:
+            cur = self.store.get_version(coll, msg.oid)
+            exists = self.store.stat(coll, msg.oid) is not None
+            # op == "repair": scrub found silent corruption (same version,
+            # wrong bytes) — apply unconditionally
+            if msg.op == "repair" or not (exists and cur >= msg.version):
+                txn = (Transaction()
+                       .remove(coll, msg.oid)
+                       .write(coll, msg.oid, 0, msg.data)
+                       .set_version(coll, msg.oid, msg.version))
+                for k, v in msg.xattrs.items():
+                    txn.setattr(coll, msg.oid, k, v)
+                self.store.queue_transaction(txn)
+        if st is not None and msg.entry is not None:
+            self._log_mutation(st, msg.entry.op, msg.entry.oid,
+                               msg.entry.version, entry=msg.entry)
+        self.perf.inc("osd_pushes_applied")

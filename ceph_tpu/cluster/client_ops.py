@@ -1,0 +1,378 @@
+"""Client op dispatch: QoS queue drain, dup detection, op execution
+(reference PrimaryLogPG::do_op / do_osd_ops dispatch seam).
+
+Split out of osd.py: everything between "a client message arrived" and
+"a backend mutation/read runs" — targeting checks, the dmClock queue,
+reqid duplicate detection (pg_log dups analog), and the op interpreter
+for data/xattr/omap/exec/watch/notify verbs."""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import List, Set
+
+from ceph_tpu.cluster import messages as M
+from ceph_tpu.cluster.messenger import Connection
+from ceph_tpu.cluster.pg import PGMETA, PGState, _coll
+from ceph_tpu.cluster.store import Transaction
+
+
+class ClientOpsMixin:
+
+    # -------------------------------------------------------- client ops
+
+    async def _resolve_client_op(self, conn: Connection, msg: M.MOSDOp):
+        """Map/pool/PG/primary checks for a client op; replies and
+        returns None when the op cannot be served here."""
+        m = self.osdmap
+        if m is None:
+            await conn.send(M.MOSDOpReply(reqid=msg.reqid, result=-11))
+            return None
+        pool = m.pools.get(msg.pgid.pool)
+        if pool is None:
+            await conn.send(M.MOSDOpReply(reqid=msg.reqid, result=-2))
+            return None
+        st = self.pgs.get(msg.pgid)
+        if st is None or st.primary != self.osd_id:
+            # not primary (anymore): tell client to refresh its map
+            await conn.send(M.MOSDOpReply(
+                reqid=msg.reqid, result=-11, epoch=m.epoch))
+            self.perf.inc("osd_misdirected_ops")
+            return None
+        return m, pool, st
+
+    async def _handle_client_op(self, conn: Connection, msg: M.MOSDOp) -> None:
+        resolved = await self._resolve_client_op(conn, msg)
+        if resolved is None:
+            return
+        m, pool, st = resolved
+        if self._opq is not None:
+            self._opq.ensure_client(msg.reqid[0], self._opq_default)
+            # queue ONLY (conn, msg, stamp): map/pool/PG/primary state is
+            # re-resolved at dequeue time, and ops that outlived the
+            # client's attempt window are dropped (the client has already
+            # resent; executing the stale copy would double-apply)
+            self._opq.enqueue(msg.reqid[0],
+                              (conn, msg, time.monotonic()))
+            self.perf.inc("osd_ops_queued_mclock")
+            self._opq_event.set()
+            return
+        await self._dispatch_client_op(conn, msg, m, pool, st)
+
+    async def _opq_drain(self) -> None:
+        """Serve the dmClock queue (the ShardedOpWQ dequeue loop): QoS
+        decides WHEN an op starts; execution runs as its own task so one
+        slow write never head-of-line blocks other clients/PGs."""
+        while not self._stopped:
+            item = self._opq.dequeue()
+            if item is None:
+                wait = self._opq.next_eligible_in()
+                if wait is not None:
+                    # throttled: sleep until the earliest L-tag matures
+                    await asyncio.sleep(min(max(wait, 0.002), 0.25))
+                else:
+                    self._opq_event.clear()
+                    try:
+                        await asyncio.wait_for(self._opq_event.wait(), 5.0)
+                    except asyncio.TimeoutError:
+                        pass
+                continue
+            conn, msg, stamp = item
+            if time.monotonic() - stamp > self.config.osd_client_op_timeout:
+                # the client abandoned this attempt and resent: executing
+                # the stale copy would double-apply the op
+                self.perf.inc("osd_ops_dropped_stale")
+                continue
+            t = asyncio.get_event_loop().create_task(
+                self._serve_queued_op(conn, msg))
+            self._opq_running.add(t)
+            t.add_done_callback(self._opq_running.discard)
+
+    async def _serve_queued_op(self, conn, msg) -> None:
+        try:
+            resolved = await self._resolve_client_op(conn, msg)
+            if resolved is None:
+                return
+            m, pool, st = resolved
+            await self._dispatch_client_op(conn, msg, m, pool, st)
+        except Exception as e:
+            # mirror ms_dispatch's error contract: the client gets a
+            # prompt EIO instead of a timeout
+            self.perf.inc("osd_dispatch_errors")
+            try:
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=-5, data=repr(e)))
+            except (ConnectionError, OSError, RuntimeError):
+                pass
+
+    def set_qos(self, client: str, reservation: float = 0.0,
+                weight: float = 1.0, limit: float = 0.0) -> None:
+        """Live per-client QoS update (mclock profile analog)."""
+        from ceph_tpu.cluster.dmclock import QoSSpec
+
+        if self._opq is not None:
+            self._opq.set_client(client, QoSSpec(
+                reservation=reservation, weight=weight, limit=limit))
+
+    # ops whose effects are not idempotent under at-least-once delivery;
+    # a resend must return the cached original reply (reference pg_log
+    # dup detection, PGLog dups / osd_pg_log_dups_tracked)
+    _MUTATING_OPS = frozenset({
+        "write_full", "write", "delete", "setxattr", "rmxattr",
+        "omap_set", "omap_rmkeys", "exec"})
+    _REQID_DUPS_TRACKED = 3000
+
+    async def _dispatch_client_op(self, conn, msg, m, pool, st) -> None:
+        self.perf.inc("osd_client_ops")
+        top = self.tracker.create(
+            f"osd_op({msg.reqid[0]}:{msg.reqid[1]} {msg.oid} "
+            f"{[o[0] for o in msg.ops]})")
+        top.mark("dispatched")
+        try:
+            if any(o[0] in self._MUTATING_OPS for o in msg.ops):
+                await self._execute_mutation_dedup(conn, msg, m, pool, st,
+                                                  top)
+            else:
+                await self._execute_client_ops(conn, msg, m, pool, st, top)
+        finally:
+            top.finish()
+
+    async def _execute_mutation_dedup(self, conn, msg, m, pool, st, top):
+        reqid = tuple(msg.reqid)
+        cached = st.reqid_replies.get(reqid)
+        if cached is None and reqid in st.reqid_inflight:
+            # dup racing its first instance: wait for it, then answer
+            # from its replies
+            await asyncio.shield(st.reqid_inflight[reqid])
+            cached = st.reqid_replies.get(reqid)
+        if cached is not None:
+            self.perf.inc("osd_dup_ops")
+            top.mark("dup_reply_from_cache")
+            for reply in cached:
+                await conn.send(reply)
+            return
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        st.reqid_inflight[reqid] = fut
+
+        sent: List = []
+
+        class _RecordingConn:
+            """Forwards sends while capturing replies for the dup cache."""
+
+            def __init__(self, inner):
+                self._inner = inner
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+            async def send(self, reply):
+                sent.append(reply)
+                await self._inner.send(reply)
+
+        try:
+            await self._execute_client_ops(
+                _RecordingConn(conn), msg, m, pool, st, top)
+            st.reqid_replies[reqid] = sent
+            while len(st.reqid_replies) > self._REQID_DUPS_TRACKED:
+                st.reqid_replies.popitem(last=False)
+        finally:
+            st.reqid_inflight.pop(reqid, None)
+            if not fut.done():
+                fut.set_result(None)
+
+    async def _execute_client_ops(self, conn, msg, m, pool, st, top):
+        for opname, args in msg.ops:
+            if opname == "write_full":
+                async with st.lock:
+                    r = await self._op_write_full(
+                        pool, st, msg.oid, args["data"])
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=r, epoch=m.epoch))
+            elif opname == "write":
+                async with st.lock:
+                    r = await self._op_write(pool, st, msg.oid,
+                                             args["offset"], args["data"])
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=r, epoch=m.epoch))
+            elif opname == "read":
+                try:
+                    data = await self._op_read(
+                        pool, st, msg.oid,
+                        args.get("offset", 0), args.get("length"))
+                    await conn.send(M.MOSDOpReply(
+                        reqid=msg.reqid, result=0, data=data, epoch=m.epoch))
+                except FileNotFoundError:
+                    await conn.send(M.MOSDOpReply(
+                        reqid=msg.reqid, result=-2, epoch=m.epoch))
+            elif opname == "delete":
+                async with st.lock:
+                    r = await self._op_delete(pool, st, msg.oid)
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=r, epoch=m.epoch))
+            elif opname == "stat":
+                size = self.store.stat(_coll(st.pgid), msg.oid)
+                if pool.is_erasure():
+                    xs = self.store.getattr(_coll(st.pgid), msg.oid, "size")
+                    size = int(xs) if xs else (None if size is None else size)
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid,
+                    result=0 if size is not None else -2,
+                    data=size, epoch=m.epoch))
+            elif opname == "list":
+                names = self._list_pg_objects(st.pgid)
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=0, data=names, epoch=m.epoch))
+            elif opname in ("getxattr", "getxattrs", "omap_get"):
+                r, data = self._op_read_meta(st, msg.oid, opname, args)
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=r, data=data, epoch=m.epoch))
+            elif opname in ("setxattr", "rmxattr", "omap_set",
+                            "omap_rmkeys"):
+                async with st.lock:
+                    r = await self._op_write_meta(st, msg.oid, opname, args)
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=r, epoch=m.epoch))
+            elif opname == "exec":
+                async with st.lock:
+                    r, data = await self._op_exec(st, msg.oid, args)
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=r, data=data, epoch=m.epoch))
+            elif opname == "watch":
+                self._watchers.setdefault((st.pgid, msg.oid), {})[
+                    (str(msg.src), args["cookie"])] = conn
+                self.perf.inc("osd_watches")
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=0, epoch=m.epoch))
+            elif opname == "unwatch":
+                self._watchers.get((st.pgid, msg.oid), {}).pop(
+                    (str(msg.src), args["cookie"]), None)
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=0, epoch=m.epoch))
+            elif opname == "notify":
+                # off the connection's dispatch loop: a notifier that also
+                # watches the object acks over this same connection, which
+                # must keep reading while the notify gathers acks
+                async def _notify_bg(reqid=msg.reqid, oid=msg.oid,
+                                     a=args, epoch=m.epoch):
+                    ackers = await self._op_notify(st, oid, a)
+                    try:
+                        await conn.send(M.MOSDOpReply(
+                            reqid=reqid, result=0, data=ackers,
+                            epoch=epoch))
+                    except (ConnectionError, OSError):
+                        pass
+
+                self._tasks.append(
+                    asyncio.get_event_loop().create_task(_notify_bg()))
+            elif opname == "notify_ack":
+                entry = self._notifies.get(args["notify_id"])
+                if entry is not None:
+                    fut, acked = entry
+                    acked.add(str(msg.src))
+                    if not fut.done() and len(acked) >= fut.needed:  # type: ignore[attr-defined]
+                        fut.set_result(None)
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=0, epoch=m.epoch))
+            else:
+                await conn.send(M.MOSDOpReply(reqid=msg.reqid, result=-95))
+
+    # ------------------------------------------------- xattr/omap/exec ops
+    #
+    # User xattrs are stored with a "_" prefix, exactly like the reference
+    # object store's user-attr namespace, so they never collide with the
+    # internal shard/size/hinfo attrs.
+
+    def _op_read_meta(self, st: PGState, oid: str, opname: str, args):
+        coll = _coll(st.pgid)
+        if self.store.stat(coll, oid) is None:
+            return -2, None
+        if opname == "getxattr":
+            v = self.store.getattr(coll, oid, "_" + args["name"])
+            return (0, v) if v is not None else (-61, None)  # ENODATA
+        if opname == "getxattrs":
+            return 0, {k[1:]: v for k, v in
+                       self.store.get_xattrs(coll, oid).items()
+                       if k.startswith("_")}
+        if opname == "omap_get":
+            return 0, self.store.omap_get(coll, oid)
+        return -95, None
+
+    async def _op_write_meta(self, st: PGState, oid: str, opname: str,
+                             args) -> int:
+        """Metadata mutations ride the same logged+replicated transaction
+        path as data writes (reference do_osd_ops xattr/omap cases write
+        into the op's transaction, PrimaryLogPG.cc:4917)."""
+        coll = _coll(st.pgid)
+        txn = Transaction().touch(coll, oid)
+        if opname == "setxattr":
+            txn.setattr(coll, oid, "_" + args["name"], args["value"])
+        elif opname == "rmxattr":
+            txn.rmattr(coll, oid, "_" + args["name"])
+        elif opname == "omap_set":
+            txn.omap_set(coll, oid, args["kv"])
+        elif opname == "omap_rmkeys":
+            txn.omap_rmkeys(coll, oid, list(args["keys"]))
+        version = self._next_version(st)
+        txn.set_version(coll, oid, version[1])
+        return await self._replicate_txn(st, txn, "modify", oid, version)
+
+    async def _op_exec(self, st: PGState, oid: str, args):
+        """Object-class execution (reference do_osd_ops CEPH_OSD_OP_CALL):
+        the method's reads hit the store, its writes collect into a txn
+        that commits + replicates atomically with the op."""
+        from ceph_tpu.cluster.objclass import (
+            ClassRegistry, ClsError, MethodContext,
+        )
+
+        coll = _coll(st.pgid)
+        txn = Transaction().touch(coll, oid)
+        ctx = MethodContext(self.store, coll, oid, txn)
+        try:
+            out = ClassRegistry.instance().call(
+                args["cls"], args["method"], ctx, args.get("indata", b""))
+        except ClsError as e:
+            return e.errno, str(e)
+        self.perf.inc("osd_cls_calls")
+        if len(txn.ops) > 1:  # beyond the touch: mutations to commit
+            version = self._next_version(st)
+            txn.set_version(coll, oid, version[1])
+            r = await self._replicate_txn(st, txn, "modify", oid, version)
+            if r != 0:
+                return r, None
+        return 0, out
+
+    async def _op_notify(self, st: PGState, oid: str, args):
+        """Fan a notify out to every watcher and gather acks within the
+        timeout (reference PrimaryLogPG::do_osd_op_effects + Notify)."""
+        watchers = self._watchers.get((st.pgid, oid), {})
+        live = {k: c for k, c in watchers.items() if not c.closed}
+        self._watchers[(st.pgid, oid)] = live
+        if not live:
+            return []
+        self._notify_id += 1
+        nid = self._notify_id
+        fut = asyncio.get_event_loop().create_future()
+        fut.needed = len(live)  # type: ignore[attr-defined]
+        acked: Set[str] = set()
+        self._notifies[nid] = (fut, acked)
+        for (watcher, cookie), conn in live.items():
+            try:
+                await conn.send(M.MWatchNotify(
+                    pool=st.pgid.pool, oid=oid, notify_id=nid,
+                    cookie=cookie, payload=args.get("payload", b"")))
+            except (ConnectionError, OSError, RuntimeError):
+                fut.needed -= 1  # type: ignore[attr-defined]
+                if len(acked) >= fut.needed and not fut.done():  # type: ignore[attr-defined]
+                    fut.set_result(None)
+        try:
+            if not fut.done() and fut.needed > 0:  # type: ignore[attr-defined]
+                await asyncio.wait_for(
+                    fut, timeout=args.get("timeout",
+                                          self.config.osd_client_op_timeout))
+        except asyncio.TimeoutError:
+            pass
+        finally:
+            self._notifies.pop(nid, None)
+        self.perf.inc("osd_notifies")
+        return sorted(acked)

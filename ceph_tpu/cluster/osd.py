@@ -34,63 +34,27 @@ from ceph_tpu.crush.types import CRUSH_ITEM_NONE
 from ceph_tpu.ops import crc32c as crcmod
 from ceph_tpu.osdmap.osdmap import OSDMap, PGid, PGPool
 from ceph_tpu.utils import Config, PerfCounters
+from ceph_tpu.cluster.backend_ec import ECBackendMixin
+from ceph_tpu.cluster.backend_replicated import ReplicatedBackendMixin
+from ceph_tpu.cluster.client_ops import ClientOpsMixin
+from ceph_tpu.cluster.pg import (  # noqa: F401  (re-exported: tools/tests)
+    MOSDPGQuery,
+    MOSDPGQueryReply,
+    PGMETA,
+    PGState,
+    PGLogMixin,
+    _coll,
+)
+from ceph_tpu.cluster.recovery import RecoveryMixin
+from ceph_tpu.cluster.scrub import ScrubMixin
 
-# the per-PG metadata object holding the persisted log + last_update
-# (reference: the pgmeta ghobject, PG::_init / read_info)
-PGMETA = "_pgmeta_"
 # the daemon-level metadata collection: superblock with the current osdmap
 # (reference OSDSuperblock, read at OSD::init, src/osd/OSD.cc:2556)
 METACOLL = "meta"
 
 
-@dataclass
-class PGState:
-    pgid: PGid
-    up: List[int] = field(default_factory=list)
-    acting: List[int] = field(default_factory=list)
-    primary: int = -1
-    # pg_info_t analog: every mutation advances last_update and appends to
-    # the log (reference PG.h pg_log)
-    last_update: pglog.Eversion = pglog.ZERO
-    log: PGLog = field(default_factory=PGLog)
-    # per-PG op serialization domain (reference PG lock / ShardedOpWQ,
-    # src/osd/OSD.h:1599): mutations hold this across their whole
-    # fan-out so concurrent writes order identically on all replicas
-    lock: asyncio.Lock = field(default_factory=asyncio.Lock)
-    # reqid -> cached replies of completed mutations (reference pg_log
-    # dup tracking, osd_pg_log_dups_tracked): a resent non-idempotent op
-    # (exec, delete, ...) returns its original reply instead of
-    # re-executing.  In-memory only — a primary restart forgets dups the
-    # way a reference OSD forgets dups past the trimmed log.
-    reqid_replies: "OrderedDict[Tuple, List]" = field(
-        default_factory=OrderedDict)
-    # reqids currently executing: a dup that races its first instance
-    # waits for that instance's replies rather than re-executing
-    reqid_inflight: Dict[Tuple, asyncio.Future] = field(
-        default_factory=dict)
-
-    def info(self) -> PGInfo:
-        return PGInfo(last_update=self.last_update, log_tail=self.log.tail)
-
-
-@dataclass
-class MOSDPGQuery(M.Message):
-    pgid: Optional[PGid] = None
-
-
-@dataclass
-class MOSDPGQueryReply(M.Message):
-    pgid: Optional[PGid] = None
-    objects: Dict[str, int] = field(default_factory=dict)  # oid -> seq
-    info: Optional[PGInfo] = None
-    log: Optional[PGLog] = None
-
-
-def _coll(pgid: PGid) -> str:
-    return f"pg_{pgid.pool}_{pgid.seed}"
-
-
-class OSDDaemon(Dispatcher):
+class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
+                ECBackendMixin, RecoveryMixin, ScrubMixin, Dispatcher):
     def __init__(self, osd_id: int, mon_addr,
                  config: Optional[Config] = None,
                  store: Optional[ObjectStore] = None):
@@ -207,98 +171,6 @@ class OSDDaemon(Dispatcher):
     async def _mon_send(self, msg, raise_on_fail: bool = False) -> bool:
         return await self.monc.send(msg, raise_on_fail=raise_on_fail)
 
-    # --------------------------------------------------------- pg log state
-
-    def _next_version(self, st: PGState) -> pglog.Eversion:
-        """eversion for the next mutation: (map epoch, next seq)."""
-        return (self.osdmap.epoch if self.osdmap else 0, st.last_update[1] + 1)
-
-    @staticmethod
-    def _meta_key(version: pglog.Eversion) -> str:
-        return f"{version[0]:010d}.{version[1]:012d}"
-
-    def _log_mutation(self, st: PGState, op: str, oid: str,
-                      version: pglog.Eversion,
-                      entry: Optional[LogEntry] = None):
-        """Append a log entry + persist it INCREMENTALLY to the pgmeta
-        object (one omap key per entry + a head attr), so a restarted OSD
-        peers from its on-store log instead of backfilling and the hot
-        write path never re-serializes the whole log (reference: log
-        entries ride the op's own transaction, PG::write_if_dirty).
-        Replicas pass the primary's ``entry`` through verbatim so every
-        member's log (incl. prior_version chains) stays byte-identical.
-        Returns the appended LogEntry, or None for a replayed duplicate."""
-        if version <= st.last_update:
-            return None  # replayed/duplicate entry
-        if entry is None:
-            entry = LogEntry(op=op, oid=oid, version=version,
-                             prior_version=st.last_update)
-        st.log.append(entry)
-        st.last_update = version
-        dropped = st.log.trim()
-        coll = _coll(st.pgid)
-        txn = (Transaction()
-               .omap_set(coll, PGMETA,
-                         {self._meta_key(version): pickle.dumps(entry)})
-               .setattr(coll, PGMETA, "last_update", pickle.dumps(version))
-               .setattr(coll, PGMETA, "log_tail", pickle.dumps(st.log.tail)))
-        if dropped:
-            txn.omap_rmkeys(coll, PGMETA,
-                            [self._meta_key(e.version) for e in dropped])
-        self.store.queue_transaction(txn)
-        return entry
-
-    def _save_pg_meta(self, st: PGState) -> None:
-        """Full rewrite of the persisted log (recovery-time adoption of an
-        authoritative log; NOT on the per-op path)."""
-        coll = _coll(st.pgid)
-        old = list(self.store.omap_get(coll, PGMETA))
-        txn = Transaction()
-        if old:
-            txn.omap_rmkeys(coll, PGMETA, old)
-        txn.omap_set(coll, PGMETA,
-                     {self._meta_key(e.version): pickle.dumps(e)
-                      for e in st.log.entries})
-        txn.setattr(coll, PGMETA, "last_update", pickle.dumps(st.last_update))
-        txn.setattr(coll, PGMETA, "log_tail", pickle.dumps(st.log.tail))
-        self.store.queue_transaction(txn)
-
-    def _load_pg_meta(self, pgid: PGid) -> Tuple[pglog.Eversion, PGLog]:
-        coll = _coll(pgid)
-        lu = self.store.getattr(coll, PGMETA, "last_update")
-        if lu is None:
-            return pglog.ZERO, PGLog()
-        last_update = pickle.loads(lu)
-        tail_blob = self.store.getattr(coll, PGMETA, "log_tail")
-        tail = pickle.loads(tail_blob) if tail_blob else pglog.ZERO
-        entries = [pickle.loads(v) for _, v in
-                   sorted(self.store.omap_get(coll, PGMETA).items())]
-        entries = [e for e in entries if e.version > tail]
-        return last_update, PGLog(tail=tail, entries=entries)
-
-    def _list_pg_objects(self, pgid: PGid) -> List[str]:
-        return [o for o in self.store.list_objects(_coll(pgid))
-                if o != PGMETA]
-
-    def _codec(self, pool: PGPool):
-        codec = self._codecs.get(pool.pool_id)
-        if codec is None:
-            from ceph_tpu.ec import factory
-
-            profile = pool.ec_profile or {
-                "plugin": "jerasure", "technique": "reed_sol_van",
-                "k": "2", "m": "1"}
-            codec = factory(profile)
-            self._codecs[pool.pool_id] = codec
-        return codec
-
-    def _sinfo(self, pool: PGPool, codec) -> "StripeInfo":
-        """Stripe layout for a pool (ECUtil::stripe_info_t analog)."""
-        from ceph_tpu.ec.stripe import StripeInfo
-
-        unit = int((pool.ec_profile or {}).get(
-            "stripe_unit", self.config.osd_ec_stripe_unit))
-        return StripeInfo(codec.get_data_chunk_count(), unit)
 
     # ------------------------------------------------------------- dispatch
 
@@ -568,1166 +440,6 @@ class OSDDaemon(Dispatcher):
                 if pool.can_shift_osds() else [int(o) for o in row]
             upp = int(upp_arr[seed])
             yield pgid, up, upp, up, upp
-
-    # -------------------------------------------------------- client ops
-
-    async def _resolve_client_op(self, conn: Connection, msg: M.MOSDOp):
-        """Map/pool/PG/primary checks for a client op; replies and
-        returns None when the op cannot be served here."""
-        m = self.osdmap
-        if m is None:
-            await conn.send(M.MOSDOpReply(reqid=msg.reqid, result=-11))
-            return None
-        pool = m.pools.get(msg.pgid.pool)
-        if pool is None:
-            await conn.send(M.MOSDOpReply(reqid=msg.reqid, result=-2))
-            return None
-        st = self.pgs.get(msg.pgid)
-        if st is None or st.primary != self.osd_id:
-            # not primary (anymore): tell client to refresh its map
-            await conn.send(M.MOSDOpReply(
-                reqid=msg.reqid, result=-11, epoch=m.epoch))
-            self.perf.inc("osd_misdirected_ops")
-            return None
-        return m, pool, st
-
-    async def _handle_client_op(self, conn: Connection, msg: M.MOSDOp) -> None:
-        resolved = await self._resolve_client_op(conn, msg)
-        if resolved is None:
-            return
-        m, pool, st = resolved
-        if self._opq is not None:
-            self._opq.ensure_client(msg.reqid[0], self._opq_default)
-            # queue ONLY (conn, msg, stamp): map/pool/PG/primary state is
-            # re-resolved at dequeue time, and ops that outlived the
-            # client's attempt window are dropped (the client has already
-            # resent; executing the stale copy would double-apply)
-            self._opq.enqueue(msg.reqid[0],
-                              (conn, msg, time.monotonic()))
-            self.perf.inc("osd_ops_queued_mclock")
-            self._opq_event.set()
-            return
-        await self._dispatch_client_op(conn, msg, m, pool, st)
-
-    async def _opq_drain(self) -> None:
-        """Serve the dmClock queue (the ShardedOpWQ dequeue loop): QoS
-        decides WHEN an op starts; execution runs as its own task so one
-        slow write never head-of-line blocks other clients/PGs."""
-        while not self._stopped:
-            item = self._opq.dequeue()
-            if item is None:
-                wait = self._opq.next_eligible_in()
-                if wait is not None:
-                    # throttled: sleep until the earliest L-tag matures
-                    await asyncio.sleep(min(max(wait, 0.002), 0.25))
-                else:
-                    self._opq_event.clear()
-                    try:
-                        await asyncio.wait_for(self._opq_event.wait(), 5.0)
-                    except asyncio.TimeoutError:
-                        pass
-                continue
-            conn, msg, stamp = item
-            if time.monotonic() - stamp > self.config.osd_client_op_timeout:
-                # the client abandoned this attempt and resent: executing
-                # the stale copy would double-apply the op
-                self.perf.inc("osd_ops_dropped_stale")
-                continue
-            t = asyncio.get_event_loop().create_task(
-                self._serve_queued_op(conn, msg))
-            self._opq_running.add(t)
-            t.add_done_callback(self._opq_running.discard)
-
-    async def _serve_queued_op(self, conn, msg) -> None:
-        try:
-            resolved = await self._resolve_client_op(conn, msg)
-            if resolved is None:
-                return
-            m, pool, st = resolved
-            await self._dispatch_client_op(conn, msg, m, pool, st)
-        except Exception as e:
-            # mirror ms_dispatch's error contract: the client gets a
-            # prompt EIO instead of a timeout
-            self.perf.inc("osd_dispatch_errors")
-            try:
-                await conn.send(M.MOSDOpReply(
-                    reqid=msg.reqid, result=-5, data=repr(e)))
-            except (ConnectionError, OSError, RuntimeError):
-                pass
-
-    def set_qos(self, client: str, reservation: float = 0.0,
-                weight: float = 1.0, limit: float = 0.0) -> None:
-        """Live per-client QoS update (mclock profile analog)."""
-        from ceph_tpu.cluster.dmclock import QoSSpec
-
-        if self._opq is not None:
-            self._opq.set_client(client, QoSSpec(
-                reservation=reservation, weight=weight, limit=limit))
-
-    # ops whose effects are not idempotent under at-least-once delivery;
-    # a resend must return the cached original reply (reference pg_log
-    # dup detection, PGLog dups / osd_pg_log_dups_tracked)
-    _MUTATING_OPS = frozenset({
-        "write_full", "write", "delete", "setxattr", "rmxattr",
-        "omap_set", "omap_rmkeys", "exec"})
-    _REQID_DUPS_TRACKED = 3000
-
-    async def _dispatch_client_op(self, conn, msg, m, pool, st) -> None:
-        self.perf.inc("osd_client_ops")
-        top = self.tracker.create(
-            f"osd_op({msg.reqid[0]}:{msg.reqid[1]} {msg.oid} "
-            f"{[o[0] for o in msg.ops]})")
-        top.mark("dispatched")
-        try:
-            if any(o[0] in self._MUTATING_OPS for o in msg.ops):
-                await self._execute_mutation_dedup(conn, msg, m, pool, st,
-                                                  top)
-            else:
-                await self._execute_client_ops(conn, msg, m, pool, st, top)
-        finally:
-            top.finish()
-
-    async def _execute_mutation_dedup(self, conn, msg, m, pool, st, top):
-        reqid = tuple(msg.reqid)
-        cached = st.reqid_replies.get(reqid)
-        if cached is None and reqid in st.reqid_inflight:
-            # dup racing its first instance: wait for it, then answer
-            # from its replies
-            await asyncio.shield(st.reqid_inflight[reqid])
-            cached = st.reqid_replies.get(reqid)
-        if cached is not None:
-            self.perf.inc("osd_dup_ops")
-            top.mark("dup_reply_from_cache")
-            for reply in cached:
-                await conn.send(reply)
-            return
-        fut: asyncio.Future = asyncio.get_event_loop().create_future()
-        st.reqid_inflight[reqid] = fut
-
-        sent: List = []
-
-        class _RecordingConn:
-            """Forwards sends while capturing replies for the dup cache."""
-
-            def __init__(self, inner):
-                self._inner = inner
-
-            def __getattr__(self, name):
-                return getattr(self._inner, name)
-
-            async def send(self, reply):
-                sent.append(reply)
-                await self._inner.send(reply)
-
-        try:
-            await self._execute_client_ops(
-                _RecordingConn(conn), msg, m, pool, st, top)
-            st.reqid_replies[reqid] = sent
-            while len(st.reqid_replies) > self._REQID_DUPS_TRACKED:
-                st.reqid_replies.popitem(last=False)
-        finally:
-            st.reqid_inflight.pop(reqid, None)
-            if not fut.done():
-                fut.set_result(None)
-
-    async def _execute_client_ops(self, conn, msg, m, pool, st, top):
-        for opname, args in msg.ops:
-            if opname == "write_full":
-                async with st.lock:
-                    r = await self._op_write_full(
-                        pool, st, msg.oid, args["data"])
-                await conn.send(M.MOSDOpReply(
-                    reqid=msg.reqid, result=r, epoch=m.epoch))
-            elif opname == "write":
-                async with st.lock:
-                    r = await self._op_write(pool, st, msg.oid,
-                                             args["offset"], args["data"])
-                await conn.send(M.MOSDOpReply(
-                    reqid=msg.reqid, result=r, epoch=m.epoch))
-            elif opname == "read":
-                try:
-                    data = await self._op_read(
-                        pool, st, msg.oid,
-                        args.get("offset", 0), args.get("length"))
-                    await conn.send(M.MOSDOpReply(
-                        reqid=msg.reqid, result=0, data=data, epoch=m.epoch))
-                except FileNotFoundError:
-                    await conn.send(M.MOSDOpReply(
-                        reqid=msg.reqid, result=-2, epoch=m.epoch))
-            elif opname == "delete":
-                async with st.lock:
-                    r = await self._op_delete(pool, st, msg.oid)
-                await conn.send(M.MOSDOpReply(
-                    reqid=msg.reqid, result=r, epoch=m.epoch))
-            elif opname == "stat":
-                size = self.store.stat(_coll(st.pgid), msg.oid)
-                if pool.is_erasure():
-                    xs = self.store.getattr(_coll(st.pgid), msg.oid, "size")
-                    size = int(xs) if xs else (None if size is None else size)
-                await conn.send(M.MOSDOpReply(
-                    reqid=msg.reqid,
-                    result=0 if size is not None else -2,
-                    data=size, epoch=m.epoch))
-            elif opname == "list":
-                names = self._list_pg_objects(st.pgid)
-                await conn.send(M.MOSDOpReply(
-                    reqid=msg.reqid, result=0, data=names, epoch=m.epoch))
-            elif opname in ("getxattr", "getxattrs", "omap_get"):
-                r, data = self._op_read_meta(st, msg.oid, opname, args)
-                await conn.send(M.MOSDOpReply(
-                    reqid=msg.reqid, result=r, data=data, epoch=m.epoch))
-            elif opname in ("setxattr", "rmxattr", "omap_set",
-                            "omap_rmkeys"):
-                async with st.lock:
-                    r = await self._op_write_meta(st, msg.oid, opname, args)
-                await conn.send(M.MOSDOpReply(
-                    reqid=msg.reqid, result=r, epoch=m.epoch))
-            elif opname == "exec":
-                async with st.lock:
-                    r, data = await self._op_exec(st, msg.oid, args)
-                await conn.send(M.MOSDOpReply(
-                    reqid=msg.reqid, result=r, data=data, epoch=m.epoch))
-            elif opname == "watch":
-                self._watchers.setdefault((st.pgid, msg.oid), {})[
-                    (str(msg.src), args["cookie"])] = conn
-                self.perf.inc("osd_watches")
-                await conn.send(M.MOSDOpReply(
-                    reqid=msg.reqid, result=0, epoch=m.epoch))
-            elif opname == "unwatch":
-                self._watchers.get((st.pgid, msg.oid), {}).pop(
-                    (str(msg.src), args["cookie"]), None)
-                await conn.send(M.MOSDOpReply(
-                    reqid=msg.reqid, result=0, epoch=m.epoch))
-            elif opname == "notify":
-                # off the connection's dispatch loop: a notifier that also
-                # watches the object acks over this same connection, which
-                # must keep reading while the notify gathers acks
-                async def _notify_bg(reqid=msg.reqid, oid=msg.oid,
-                                     a=args, epoch=m.epoch):
-                    ackers = await self._op_notify(st, oid, a)
-                    try:
-                        await conn.send(M.MOSDOpReply(
-                            reqid=reqid, result=0, data=ackers,
-                            epoch=epoch))
-                    except (ConnectionError, OSError):
-                        pass
-
-                self._tasks.append(
-                    asyncio.get_event_loop().create_task(_notify_bg()))
-            elif opname == "notify_ack":
-                entry = self._notifies.get(args["notify_id"])
-                if entry is not None:
-                    fut, acked = entry
-                    acked.add(str(msg.src))
-                    if not fut.done() and len(acked) >= fut.needed:  # type: ignore[attr-defined]
-                        fut.set_result(None)
-                await conn.send(M.MOSDOpReply(
-                    reqid=msg.reqid, result=0, epoch=m.epoch))
-            else:
-                await conn.send(M.MOSDOpReply(reqid=msg.reqid, result=-95))
-
-    # ------------------------------------------------- xattr/omap/exec ops
-    #
-    # User xattrs are stored with a "_" prefix, exactly like the reference
-    # object store's user-attr namespace, so they never collide with the
-    # internal shard/size/hinfo attrs.
-
-    def _op_read_meta(self, st: PGState, oid: str, opname: str, args):
-        coll = _coll(st.pgid)
-        if self.store.stat(coll, oid) is None:
-            return -2, None
-        if opname == "getxattr":
-            v = self.store.getattr(coll, oid, "_" + args["name"])
-            return (0, v) if v is not None else (-61, None)  # ENODATA
-        if opname == "getxattrs":
-            return 0, {k[1:]: v for k, v in
-                       self.store.get_xattrs(coll, oid).items()
-                       if k.startswith("_")}
-        if opname == "omap_get":
-            return 0, self.store.omap_get(coll, oid)
-        return -95, None
-
-    async def _op_write_meta(self, st: PGState, oid: str, opname: str,
-                             args) -> int:
-        """Metadata mutations ride the same logged+replicated transaction
-        path as data writes (reference do_osd_ops xattr/omap cases write
-        into the op's transaction, PrimaryLogPG.cc:4917)."""
-        coll = _coll(st.pgid)
-        txn = Transaction().touch(coll, oid)
-        if opname == "setxattr":
-            txn.setattr(coll, oid, "_" + args["name"], args["value"])
-        elif opname == "rmxattr":
-            txn.rmattr(coll, oid, "_" + args["name"])
-        elif opname == "omap_set":
-            txn.omap_set(coll, oid, args["kv"])
-        elif opname == "omap_rmkeys":
-            txn.omap_rmkeys(coll, oid, list(args["keys"]))
-        version = self._next_version(st)
-        txn.set_version(coll, oid, version[1])
-        return await self._replicate_txn(st, txn, "modify", oid, version)
-
-    async def _op_exec(self, st: PGState, oid: str, args):
-        """Object-class execution (reference do_osd_ops CEPH_OSD_OP_CALL):
-        the method's reads hit the store, its writes collect into a txn
-        that commits + replicates atomically with the op."""
-        from ceph_tpu.cluster.objclass import (
-            ClassRegistry, ClsError, MethodContext,
-        )
-
-        coll = _coll(st.pgid)
-        txn = Transaction().touch(coll, oid)
-        ctx = MethodContext(self.store, coll, oid, txn)
-        try:
-            out = ClassRegistry.instance().call(
-                args["cls"], args["method"], ctx, args.get("indata", b""))
-        except ClsError as e:
-            return e.errno, str(e)
-        self.perf.inc("osd_cls_calls")
-        if len(txn.ops) > 1:  # beyond the touch: mutations to commit
-            version = self._next_version(st)
-            txn.set_version(coll, oid, version[1])
-            r = await self._replicate_txn(st, txn, "modify", oid, version)
-            if r != 0:
-                return r, None
-        return 0, out
-
-    async def _op_notify(self, st: PGState, oid: str, args):
-        """Fan a notify out to every watcher and gather acks within the
-        timeout (reference PrimaryLogPG::do_osd_op_effects + Notify)."""
-        watchers = self._watchers.get((st.pgid, oid), {})
-        live = {k: c for k, c in watchers.items() if not c.closed}
-        self._watchers[(st.pgid, oid)] = live
-        if not live:
-            return []
-        self._notify_id += 1
-        nid = self._notify_id
-        fut = asyncio.get_event_loop().create_future()
-        fut.needed = len(live)  # type: ignore[attr-defined]
-        acked: Set[str] = set()
-        self._notifies[nid] = (fut, acked)
-        for (watcher, cookie), conn in live.items():
-            try:
-                await conn.send(M.MWatchNotify(
-                    pool=st.pgid.pool, oid=oid, notify_id=nid,
-                    cookie=cookie, payload=args.get("payload", b"")))
-            except (ConnectionError, OSError, RuntimeError):
-                fut.needed -= 1  # type: ignore[attr-defined]
-                if len(acked) >= fut.needed and not fut.done():  # type: ignore[attr-defined]
-                    fut.set_result(None)
-        try:
-            if not fut.done() and fut.needed > 0:  # type: ignore[attr-defined]
-                await asyncio.wait_for(
-                    fut, timeout=args.get("timeout",
-                                          self.config.osd_client_op_timeout))
-        except asyncio.TimeoutError:
-            pass
-        finally:
-            self._notifies.pop(nid, None)
-        self.perf.inc("osd_notifies")
-        return sorted(acked)
-
-    # replicated write: local txn + MOSDRepOp fan-out (ReplicatedBackend)
-    async def _op_write_full(self, pool: PGPool, st: PGState, oid: str,
-                             data: bytes) -> int:
-        if pool.is_erasure():
-            return await self._ec_write(pool, st, oid, data, offset=None)
-        version = self._next_version(st)
-        txn = (Transaction()
-               .remove(_coll(st.pgid), oid)
-               .write(_coll(st.pgid), oid, 0, data)
-               .set_version(_coll(st.pgid), oid, version[1]))
-        return await self._replicate_txn(st, txn, "modify", oid, version)
-
-    async def _op_write(self, pool: PGPool, st: PGState, oid: str,
-                        offset: int, data: bytes) -> int:
-        """Partial write at (offset, len) — the RMW path for EC pools
-        (reference ECBackend::start_rmw, ECBackend.cc:1785)."""
-        if pool.is_erasure():
-            return await self._ec_write(pool, st, oid, data, offset=offset)
-        version = self._next_version(st)
-        txn = (Transaction()
-               .write(_coll(st.pgid), oid, offset, data)
-               .set_version(_coll(st.pgid), oid, version[1]))
-        return await self._replicate_txn(st, txn, "modify", oid, version)
-
-    async def _replicate_txn(self, st: PGState, txn: Transaction,
-                             op: str, oid: str,
-                             version: pglog.Eversion) -> int:
-        """Apply locally + fan out with the log entry; commit when all
-        acting replicas ack (reference PrimaryLogPG::issue_repop,
-        PrimaryLogPG.cc:9173)."""
-        self.store.queue_transaction(txn)
-        entry = self._log_mutation(st, op, oid, version)
-        peers = [o for o in st.acting
-                 if o != self.osd_id and o != CRUSH_ITEM_NONE]
-        if peers:
-            reqid = self._next_reqid()
-            fut = self._make_waiter(reqid, len(peers))
-            rep = M.MOSDRepOp(reqid=reqid, pgid=st.pgid,
-                              txn_blob=txn.encode(),
-                              entry=entry,
-                              epoch=self.osdmap.epoch)
-            for o in peers:
-                try:
-                    await self._send_osd(o, rep)
-                except (ConnectionError, OSError, RuntimeError):
-                    # peer unreachable (map lag around a failure): the op
-                    # proceeds on the reachable set; the logged entry
-                    # delta-recovers the peer at rejoin (reference: the
-                    # acting set shrinks, missing grows)
-                    self._waiter_dec(reqid)
-            try:
-                if not fut.done():
-                    await asyncio.wait_for(
-                        fut, timeout=self.config.osd_client_op_timeout)
-            except asyncio.TimeoutError:
-                return -110
-            finally:
-                self._pending.pop(reqid, None)
-        return 0
-
-    async def _op_delete(self, pool: PGPool, st: PGState, oid: str) -> int:
-        """Delete is ack-gated exactly like writes — fire-and-forget
-        MOSDRepOps let a slow replica resurrect the object."""
-        version = self._next_version(st)
-        txn = Transaction().remove(_coll(st.pgid), oid)
-        return await self._replicate_txn(st, txn, "delete", oid, version)
-
-    async def _op_read(self, pool: PGPool, st: PGState, oid: str,
-                       offset: int = 0, length: Optional[int] = None) -> bytes:
-        if pool.is_erasure():
-            return await self._ec_read(pool, st, oid, offset, length)
-        return self.store.read(_coll(st.pgid), oid, offset, length)
-
-    # ----------------------------------------------------------- EC backend
-    #
-    # Objects are striped (ECUtil::stripe_info_t math, ceph_tpu.ec.stripe):
-    # shard s holds stripe-chunk s of every stripe, concatenated.  Encode /
-    # decode of the whole touched stripe range happens in one batched TPU
-    # dispatch; partial writes are read-modify-write over stripe bounds
-    # (reference ECBackend::start_rmw, ECBackend.cc:1785-1886).
-
-    async def _ec_write(self, pool: PGPool, st: PGState, oid: str,
-                        data: bytes, offset: Optional[int]) -> int:
-        """EC write incl. the RMW sequence (read old stripes, merge,
-        re-encode, fan out shard writes).  Serialization: callers hold the
-        PG-wide st.lock across the whole op, so overlapping RMWs to one
-        object can never interleave (the reference serializes them in the
-        ECBackend pipeline, ECBackend::start_rmw wait queue; our domain is
-        the whole PG, like the reference's PG lock)."""
-        from ceph_tpu.ec import stripe as stripemod
-
-        codec = self._codec(pool)
-        sinfo = self._sinfo(pool, codec)
-        coll = _coll(st.pgid)
-        eversion = self._next_version(st)
-        version = eversion[1]
-
-        if offset is None:
-            # write_full: replace the object
-            new_size = len(data)
-            chunk_off = 0
-            shards = await self._compute(
-                stripemod.encode_stripes, codec, sinfo, data)
-        else:
-            sa = self.store.getattr(coll, oid, "size")
-            old_size = int(sa) if sa else 0
-            off0, len0 = sinfo.offset_len_to_stripe_bounds(offset, len(data))
-            chunk_off = sinfo.aligned_logical_offset_to_chunk_offset(off0)
-            old_in_range = max(0, min(old_size - off0, len0))
-            old_bytes = b""
-            if old_in_range:
-                old_bytes = await self._ec_read_stripes(
-                    pool, st, oid, chunk_off, old_in_range)
-            merged = stripemod.merge_range(
-                old_bytes, old_in_range, offset - off0, data)
-            new_size = max(old_size, offset + len(data))
-            shards = await self._compute(
-                stripemod.encode_stripes, codec, sinfo, merged)
-
-        shard_size = sinfo.shard_size(new_size)
-        hinfo = {"size": new_size, "version": version}
-        n = codec.get_chunk_count()
-        reqid = self._next_reqid()
-        peers = []
-        my_shard = None
-        for shard in range(n):
-            osd = st.acting[shard] if shard < len(st.acting) else CRUSH_ITEM_NONE
-            if osd == self.osd_id:
-                my_shard = shard
-            elif osd != CRUSH_ITEM_NONE:
-                peers.append((osd, shard))
-        if my_shard is not None:
-            self._apply_shard(st.pgid, oid, my_shard,
-                              shards[my_shard].tobytes(), chunk_off,
-                              shard_size, hinfo)
-        entry = self._log_mutation(st, "modify", oid, eversion)
-        if peers:
-            fut = self._make_waiter(reqid, len(peers))
-            for osd, shard in peers:
-                try:
-                    await self._send_osd(osd, M.MOSDECSubOpWrite(
-                        reqid=reqid, pgid=st.pgid, oid=oid, shard=shard,
-                        data=shards[shard].tobytes(), chunk_off=chunk_off,
-                        shard_size=shard_size, hinfo=hinfo, entry=entry,
-                        epoch=self.osdmap.epoch))
-                except (ConnectionError, OSError, RuntimeError):
-                    self._waiter_dec(reqid)
-            try:
-                if not fut.done():
-                    await asyncio.wait_for(
-                        fut, timeout=self.config.osd_client_op_timeout)
-            except asyncio.TimeoutError:
-                return -110
-            finally:
-                self._pending.pop(reqid, None)
-        return 0
-
-    def _apply_shard(self, pgid: PGid, oid: str, shard: int, data: bytes,
-                     chunk_off: int, shard_size: int, hinfo: Dict) -> None:
-        """Apply a shard sub-range write with its crc in ONE atomic
-        transaction (ECUtil::HashInfo analog, reference ECUtil.h:105-163:
-        the crc is CUMULATIVE for appends/full rewrites — no whole-shard
-        re-read on the hot path — and data+crc can never disagree)."""
-        coll = _coll(pgid)
-        old_size = self.store.stat(coll, oid)
-        if chunk_off == 0 and len(data) >= shard_size:
-            # full-shard rewrite: one pass over the payload
-            crc = crcmod.crc32c(0xFFFFFFFF, data[:shard_size])
-        elif old_size is not None and chunk_off == old_size and \
-                shard_size == chunk_off + len(data):
-            # append: combine the stored cumulative crc with the new
-            # bytes' crc (GF(2) zero-extension, reference HashInfo append)
-            stored = self.store.getattr(coll, oid, "hinfo_crc")
-            if stored is not None:
-                crc = crcmod.crc32c_combine(
-                    int(stored), crcmod.crc32c(0, data), len(data))
-            else:
-                crc = crcmod.crc32c(0xFFFFFFFF,
-                                    self.store.read(coll, oid) + data)
-        else:
-            # true mid-shard RMW: recompute over the merged bytes
-            old = bytearray(self.store.read(coll, oid)) \
-                if old_size is not None else bytearray()
-            if len(old) < shard_size:
-                old.extend(b"\0" * (shard_size - len(old)))
-            old[chunk_off:chunk_off + len(data)] = data
-            crc = crcmod.crc32c(0xFFFFFFFF, bytes(old[:shard_size]))
-        txn = (Transaction()
-               .write(coll, oid, chunk_off, data)
-               .truncate(coll, oid, shard_size)
-               .setattr(coll, oid, "shard", str(shard).encode())
-               .setattr(coll, oid, "size", str(hinfo["size"]).encode())
-               .setattr(coll, oid, "hinfo_crc", str(crc).encode())
-               .set_version(coll, oid, hinfo["version"]))
-        self.store.queue_transaction(txn)
-
-    async def _handle_ec_write(self, conn: Connection,
-                               msg: M.MOSDECSubOpWrite) -> None:
-        shard_size = msg.shard_size if msg.shard_size is not None \
-            else msg.chunk_off + len(msg.data)
-        self._apply_shard(msg.pgid, msg.oid, msg.shard, msg.data,
-                          msg.chunk_off, shard_size, msg.hinfo)
-        st = self.pgs.get(msg.pgid)
-        if st is not None and msg.entry is not None:
-            self._log_mutation(st, msg.entry.op, msg.entry.oid,
-                               msg.entry.version, entry=msg.entry)
-        self.perf.inc("osd_ec_sub_writes")
-        await conn.send(M.MOSDECSubOpWriteReply(reqid=msg.reqid, result=0))
-
-    async def _handle_ec_read(self, conn: Connection,
-                              msg: M.MOSDECSubOpRead) -> None:
-        try:
-            full = self.store.read(_coll(msg.pgid), msg.oid)
-            stored_crc = self.store.getattr(_coll(msg.pgid), msg.oid,
-                                            "hinfo_crc")
-            # scrub-on-read: verify the shard crc (ecbackend.rst:86-99)
-            if stored_crc is not None and \
-                    int(stored_crc) != crcmod.crc32c(0xFFFFFFFF, full):
-                raise IOError("chunk crc mismatch")
-            data = full[msg.off: msg.off + msg.length] \
-                if msg.length is not None else full[msg.off:]
-            shard_attr = self.store.getattr(_coll(msg.pgid), msg.oid, "shard")
-            shard = int(shard_attr) if shard_attr else msg.shard
-            size = self.store.getattr(_coll(msg.pgid), msg.oid, "size")
-            hinfo = {"size": int(size) if size else 0}
-            if msg.shard == -1:
-                # whole-object fetch (pull recovery): carry version +
-                # xattrs so the puller stores a faithful copy
-                hinfo["version"] = self.store.get_version(
-                    _coll(msg.pgid), msg.oid)
-                o = self.store._colls.get(_coll(msg.pgid), {}).get(msg.oid)
-                hinfo["xattrs"] = dict(o.xattrs) if o else {}
-            await conn.send(M.MOSDECSubOpReadReply(
-                reqid=msg.reqid, result=0, shard=shard, data=data,
-                hinfo=hinfo))
-            self.perf.inc("osd_ec_sub_reads")
-        except (FileNotFoundError, IOError):
-            await conn.send(M.MOSDECSubOpReadReply(
-                reqid=msg.reqid, result=-2, shard=msg.shard))
-
-    async def _gather_shards(
-        self, pool: PGPool, st: PGState, oid: str, need_k: int,
-        off: int = 0, length: Optional[int] = None,
-        exclude_shards: Optional[Set[int]] = None,
-    ) -> Tuple[Dict[int, bytes], int]:
-        """Collect >= k shard (ranges) from the acting set (own shard
-        free).  ``exclude_shards``: shard ids known corrupt — they must
-        never be decode sources (scrub repair would otherwise reconstruct
-        FROM the corruption and bless it)."""
-        exclude_shards = exclude_shards or set()
-        shards: Dict[int, bytes] = {}
-        size = 0
-        my = self.store.stat(_coll(st.pgid), oid)
-        if my is not None:
-            data = self.store.read(_coll(st.pgid), oid, off, length)
-            shard_attr = self.store.getattr(_coll(st.pgid), oid, "shard")
-            if shard_attr is not None and                     int(shard_attr) not in exclude_shards:
-                shards[int(shard_attr)] = data
-            sa = self.store.getattr(_coll(st.pgid), oid, "size")
-            size = int(sa) if sa else 0
-        peers = [(shard, osd) for shard, osd in enumerate(st.acting)
-                 if osd not in (self.osd_id, CRUSH_ITEM_NONE)
-                 and shard not in shards and shard not in exclude_shards]
-        if peers and len(shards) < need_k:
-            reqid = self._next_reqid()
-            fut = self._make_waiter(reqid, len(peers))
-            for shard, osd in peers:
-                try:
-                    await self._send_osd(osd, M.MOSDECSubOpRead(
-                        reqid=reqid, pgid=st.pgid, oid=oid, shard=shard,
-                        off=off, length=length))
-                except (ConnectionError, OSError, RuntimeError):
-                    self._waiter_dec(reqid)
-            try:
-                if fut.done():
-                    acc = fut.result()
-                else:
-                    acc = await asyncio.wait_for(
-                        fut, timeout=self.config.osd_client_op_timeout)
-            except asyncio.TimeoutError:
-                acc = self._pending[reqid][1]
-            finally:
-                self._pending.pop(reqid, None)
-            for result, reply in acc:
-                if result == 0 and reply is not None:
-                    shards[reply.shard] = reply.data
-                    if reply.hinfo.get("size"):
-                        size = reply.hinfo["size"]
-        return shards, size
-
-    async def _ec_read_stripes(self, pool: PGPool, st: PGState, oid: str,
-                               chunk_off: int, logical_len: int) -> bytes:
-        """Read a stripe-aligned logical range: gather the touched chunk
-        range from >= k shards and decode it as a mini-object."""
-        from ceph_tpu.ec import stripe as stripemod
-        import numpy as np
-
-        codec = self._codec(pool)
-        sinfo = self._sinfo(pool, codec)
-        k = codec.get_data_chunk_count()
-        nstripes = sinfo.object_stripes(logical_len)
-        chunk_len = nstripes * sinfo.chunk_size
-        shards, _ = await self._gather_shards(
-            pool, st, oid, k, off=chunk_off, length=chunk_len)
-        avail = {s: np.frombuffer(d, dtype=np.uint8)
-                 for s, d in shards.items()
-                 if len(d) == chunk_len}
-        if len(avail) < k:
-            raise IOError(
-                f"only {len(avail)} of {k} shard ranges for {oid}")
-        return await self._compute(
-            stripemod.decode_stripes, codec, sinfo, avail, logical_len)
-
-    async def _ec_read(self, pool: PGPool, st: PGState, oid: str,
-                       offset: int = 0, length: Optional[int] = None) -> bytes:
-        """objects_read_async analog: min shards + batched TPU decode
-        (ECBackend.cc:2111,1588,2262)."""
-        coll = _coll(st.pgid)
-        sa = self.store.getattr(coll, oid, "size")
-        if sa is None:
-            # primary lost its shard (or never had one): probe peers
-            codec = self._codec(pool)
-            shards, size = await self._gather_shards(
-                pool, st, oid, codec.get_data_chunk_count(), 0, 0)
-            if not shards and size == 0:
-                raise FileNotFoundError(oid)
-        else:
-            size = int(sa)
-        if length is None:
-            length = max(0, size - offset)
-        if length == 0 or offset >= size:
-            return b""
-        length = min(length, size - offset)
-        codec = self._codec(pool)
-        sinfo = self._sinfo(pool, codec)
-        off0, len0 = sinfo.offset_len_to_stripe_bounds(offset, length)
-        len0 = min(len0, max(0, size - off0))
-        chunk_off = sinfo.aligned_logical_offset_to_chunk_offset(off0)
-        out = await self._ec_read_stripes(pool, st, oid, chunk_off, len0)
-        return out[offset - off0: offset - off0 + length]
-
-    # ------------------------------------------------------------- recovery
-
-    async def _recover_all(self) -> None:
-        await asyncio.sleep(self.config.osd_recovery_delay_start)
-        for pgid, st in list(self.pgs.items()):
-            if st.primary == self.osd_id:
-                try:
-                    await self._recover_pg(st)
-                except Exception:
-                    # count AND surface: a silently-failing recovery loop
-                    # means a pool that never re-protects itself
-                    self.perf.inc("osd_recovery_errors")
-                    import logging
-                    logging.getLogger("ceph_tpu.osd").exception(
-                        "osd.%d: recovery of pg %s failed", self.osd_id, pgid)
-
-    async def _query_pg(self, osd: int, pgid: PGid):
-        """GetInfo/GetLog exchange with one member (reference peering
-        Query/Notify, PG.h RecoveryMachine GetInfo)."""
-        key = ("pgq", str(pgid), osd)
-        fut = self._make_waiter(key, 1)
-        try:
-            await self._send_osd(osd, MOSDPGQuery(pgid=pgid))
-            acc = await asyncio.wait_for(fut, timeout=2.0)
-            return acc[0][1]
-        except (asyncio.TimeoutError, ConnectionError):
-            return None
-        finally:
-            self._pending.pop(key, None)
-
-    async def _recover_pg(self, st: PGState) -> None:
-        """Primary-driven peering + recovery (flattened RecoveryMachine,
-        reference src/osd/PG.h:1994-2498):
-
-        1. GetInfo: collect (last_update, log) from every acting member.
-        2. GetLog: the max last_update owns the authoritative log; if that
-           is not us, bring ourselves up first (delta when our
-           last_update is inside the auth log window, backfill otherwise).
-        3. Active/Recovering: push ONLY the log delta to each stale
-           member; full-inventory backfill when a member is behind the
-           log tail.
-
-        Runs under the PG lock: peering mutates st.log/st.last_update, and
-        a client write interleaving with log adoption could regress
-        last_update and reuse an eversion (the reference blocks ops during
-        peering for the same reason)."""
-        async with st.lock:
-            await self._recover_pg_locked(st)
-
-    async def _recover_pg_locked(self, st: PGState) -> None:
-        m = self.osdmap
-        pool = m.pools[st.pgid.pool]
-        members = [o for o in st.acting
-                   if o not in (self.osd_id, CRUSH_ITEM_NONE)]
-        infos: Dict[int, PGInfo] = {self.osd_id: st.info()}
-        logs: Dict[int, PGLog] = {self.osd_id: st.log}
-        inventories: Dict[int, Dict[str, int]] = {}
-        for osd in members:
-            reply = await self._query_pg(osd, st.pgid)
-            if reply is None:
-                continue
-            infos[osd] = reply.info or PGInfo()
-            logs[osd] = reply.log or PGLog()
-            inventories[osd] = reply.objects or {}
-
-        auth = pglog.choose_authoritative(infos)
-        if auth != self.osd_id and \
-                infos[auth].last_update > st.last_update:
-            await self._sync_self_from(
-                pool, st, auth, logs[auth], inventories.get(auth, {}))
-
-        for osd in members:
-            if osd not in infos:
-                continue
-            peer_lu = infos[osd].last_update
-            if peer_lu >= st.last_update:
-                continue
-            to_sync = st.log.objects_to_sync(peer_lu)
-            if to_sync is None:
-                await self._backfill_member(
-                    pool, st, osd, inventories.get(osd, {}))
-            else:
-                # replay in VERSION order so the member's log advances
-                # monotonically (out-of-order pushes would hit the
-                # duplicate guard and leave silent log holes)
-                for oid, entry in sorted(to_sync.items(),
-                                         key=lambda kv: kv[1].version):
-                    await self._push_object(pool, st, osd, oid, entry)
-        self.perf.inc("osd_pg_recoveries")
-
-    async def _sync_self_from(self, pool: PGPool, st: PGState, auth: int,
-                              auth_log: PGLog,
-                              auth_inventory: Dict[str, int]) -> None:
-        """Bring the primary up to the authoritative member's state."""
-        coll = _coll(st.pgid)
-        to_sync = auth_log.objects_to_sync(st.last_update)
-        if to_sync is None:
-            # behind the log window: full backfill from auth's inventory
-            mine = {oid: self.store.get_version(coll, oid)
-                    for oid in self._list_pg_objects(st.pgid)}
-            to_pull = [oid for oid, ver in auth_inventory.items()
-                       if mine.get(oid, -1) < ver]
-            # objects we hold that the authoritative member does not =
-            # deletes we missed (possibly trimmed past the log tail);
-            # without this, a rejoining primary resurrects deleted objects
-            for oid in mine:
-                if oid not in auth_inventory:
-                    self.store.queue_transaction(
-                        Transaction().remove(coll, oid))
-        else:
-            to_pull = []
-            for oid, entry in to_sync.items():
-                if entry.op == "delete":
-                    self.store.queue_transaction(
-                        Transaction().remove(coll, oid))
-                else:
-                    to_pull.append(oid)
-        ok = True
-        for oid in to_pull:
-            if pool.is_erasure():
-                ok &= await self._recover_ec_object(
-                    pool, st, oid, targets=[self.osd_id])
-            else:
-                ok &= await self._pull_rep_object(st, auth, oid)
-        if not ok:
-            # a pull failed (auth unreachable mid-recovery): do NOT claim
-            # the authoritative version — stay stale so the next peering
-            # round retries instead of serving/pushing stale bytes as new
-            self.perf.inc("osd_recovery_incomplete")
-            return
-        # adopt the authoritative log
-        st.log = PGLog(tail=auth_log.tail,
-                       entries=list(auth_log.entries),
-                       max_entries=auth_log.max_entries)
-        st.last_update = auth_log.head if auth_log.entries else \
-            max(st.last_update, auth_log.tail)
-        self._save_pg_meta(st)
-
-    async def _pull_rep_object(self, st: PGState, source: int,
-                               oid: str) -> bool:
-        """Fetch a full replicated object from a member (pull recovery,
-        reference ReplicatedBackend::prepare_pull).  Returns success: the
-        caller must NOT claim the authoritative version for objects it
-        failed to pull."""
-        reqid = self._next_reqid()
-        fut = self._make_waiter(reqid, 1)
-        try:
-            await self._send_osd(source, M.MOSDECSubOpRead(
-                reqid=reqid, pgid=st.pgid, oid=oid, shard=-1))
-            acc = await asyncio.wait_for(fut, timeout=2.0)
-            result, reply = acc[0]
-            if result == 0 and reply is not None:
-                txn = (Transaction()
-                       .remove(_coll(st.pgid), oid)
-                       .write(_coll(st.pgid), oid, 0, reply.data)
-                       .set_version(_coll(st.pgid), oid,
-                                    reply.hinfo.get("version", 0)))
-                for k, v in reply.hinfo.get("xattrs", {}).items():
-                    txn.setattr(_coll(st.pgid), oid, k, v)
-                self.store.queue_transaction(txn)
-                return True
-        except (asyncio.TimeoutError, ConnectionError):
-            pass
-        finally:
-            self._pending.pop(reqid, None)
-        return False
-
-    async def _push_object(self, pool: PGPool, st: PGState, osd: int,
-                           oid: str, entry: LogEntry) -> None:
-        """Replay one log entry onto a stale member (delta recovery)."""
-        if entry.op == "delete":
-            try:
-                await self._send_osd(osd, M.MOSDPGPush(
-                    pgid=st.pgid, oid=oid, op="delete",
-                    version=entry.version[1], entry=entry))
-                self.perf.inc("osd_pushes_sent")
-            except ConnectionError:
-                pass
-            return
-        if pool.is_erasure():
-            await self._recover_ec_object(pool, st, oid, targets=[osd],
-                                          entry=entry)
-            return
-        coll = _coll(st.pgid)
-        if self.store.stat(coll, oid) is None:
-            return
-        data = self.store.read(coll, oid)
-        try:
-            await self._send_osd(osd, M.MOSDPGPush(
-                pgid=st.pgid, oid=oid, data=data,
-                version=entry.version[1], entry=entry))
-            self.perf.inc("osd_pushes_sent")
-        except ConnectionError:
-            pass
-
-    async def _backfill_member(self, pool: PGPool, st: PGState, osd: int,
-                               inventory: Dict[str, int]) -> None:
-        """Full-inventory resync for a member behind the log tail
-        (reference Backfilling state)."""
-        for oid in self._list_pg_objects(st.pgid):
-            ver = self.store.get_version(_coll(st.pgid), oid)
-            if inventory.get(oid, -1) >= ver:
-                continue
-            if pool.is_erasure():
-                await self._recover_ec_object(pool, st, oid, targets=[osd])
-            else:
-                data = self.store.read(_coll(st.pgid), oid)
-                try:
-                    await self._send_osd(osd, M.MOSDPGPush(
-                        pgid=st.pgid, oid=oid, data=data, version=ver))
-                    self.perf.inc("osd_pushes_sent")
-                except ConnectionError:
-                    pass
-        # stale objects the member has but we (authoritative) don't
-        mine = set(self._list_pg_objects(st.pgid))
-        for oid in inventory:
-            if oid not in mine:
-                try:
-                    await self._send_osd(osd, M.MOSDPGPush(
-                        pgid=st.pgid, oid=oid, op="delete",
-                        version=st.last_update[1]))
-                    self.perf.inc("osd_pushes_sent")
-                except ConnectionError:
-                    pass
-        # hand the member our log state so the next peering round sees it
-        # as current instead of re-backfilling
-        blob = pickle.dumps((st.last_update, st.log))
-        try:
-            await self._send_osd(osd, M.MOSDPGPush(
-                pgid=st.pgid, op="log_sync", data=blob))
-        except ConnectionError:
-            pass
-
-    async def _recover_ec_object(self, pool: PGPool, st: PGState, oid: str,
-                                 targets: Optional[List[int]] = None,
-                                 entry: Optional[LogEntry] = None,
-                                 exclude_sources: Optional[Set[int]] = None,
-                                 ) -> bool:
-        """Reconstruct shards for the target members (batched TPU decode +
-        encode, ECBackend::run_recovery_op analog).  targets=None rebuilds
-        every acting member's shard; exclude_sources keeps known-corrupt
-        shard ids out of the decode.  Returns False when the object is
-        currently unrecoverable (fewer than k shard sources)."""
-        from ceph_tpu.ec import stripe as stripemod
-        import numpy as np
-
-        codec = self._codec(pool)
-        sinfo = self._sinfo(pool, codec)
-        k = codec.get_data_chunk_count()
-        shards, size = await self._gather_shards(
-            pool, st, oid, k, exclude_shards=exclude_sources)
-        shard_len = sinfo.shard_size(size)
-        avail = {s: np.frombuffer(d, dtype=np.uint8)
-                 for s, d in shards.items() if len(d) == shard_len}
-        if len(avail) < k:
-            self.perf.inc("osd_unrecoverable")
-            return False
-        data = await self._compute(
-            stripemod.decode_stripes, codec, sinfo, avail, size)
-        chunks = await self._compute(
-            stripemod.encode_stripes, codec, sinfo, data)
-        version = max((self.store.get_version(_coll(st.pgid), oid)), 1)
-        hinfo = {"size": size, "version": version}
-        for shard, osd in enumerate(st.acting):
-            if osd == CRUSH_ITEM_NONE:
-                continue
-            if targets is not None and osd not in targets:
-                continue
-            blob = chunks[shard].tobytes()
-            if osd == self.osd_id:
-                self._apply_shard(st.pgid, oid, shard, blob, 0,
-                                  shard_len, hinfo)
-            else:
-                try:
-                    await self._send_osd(osd, M.MOSDECSubOpWrite(
-                        reqid=self._next_reqid(), pgid=st.pgid, oid=oid,
-                        shard=shard, data=blob, chunk_off=0,
-                        shard_size=shard_len, hinfo=hinfo, entry=entry,
-                        epoch=self.osdmap.epoch))
-                    self.perf.inc("osd_pushes_sent")
-                except ConnectionError:
-                    pass
-        return True
-
-    # --------------------------------------------------------------- scrub
-    #
-    # Background integrity verification (reference PG scrub +
-    # ecbackend.rst:86-99): the primary collects per-member scrub maps
-    # (oid -> computed crc32c over the bytes, batched on the device where
-    # object sizes group), detects divergent replicas / corrupt EC shards
-    # WITHOUT a client read, and repairs through the recovery machinery.
-
-    def _build_scrub_map(self, pgid: PGid) -> Dict[str, Tuple]:
-        """oid -> (version, size, computed_crc, stored_crc).  Equal-size
-        objects CRC in ONE device dispatch (crc32c_batch); odd sizes fall
-        back to the host path."""
-        import numpy as np
-
-        coll = _coll(pgid)
-        oids = self._list_pg_objects(pgid)
-        blobs = {oid: self.store.read(coll, oid) for oid in oids}
-        by_len: Dict[int, List[str]] = {}
-        for oid, b in blobs.items():
-            by_len.setdefault(len(b), []).append(oid)
-        crcs: Dict[str, int] = {}
-        for ln, group in by_len.items():
-            if len(group) >= 2 and ln > 0:
-                arr = np.stack([
-                    np.frombuffer(blobs[o], dtype=np.uint8) for o in group])
-                vals = np.asarray(crcmod.crc32c_batch(arr))
-                for o, v in zip(group, vals):
-                    crcs[o] = int(v)
-            else:
-                for o in group:
-                    crcs[o] = crcmod.crc32c(0xFFFFFFFF, blobs[o])
-        out = {}
-        for oid in oids:
-            stored = self.store.getattr(coll, oid, "hinfo_crc")
-            out[oid] = (self.store.get_version(coll, oid),
-                        len(blobs[oid]), crcs[oid],
-                        int(stored) if stored is not None else None)
-        return out
-
-    async def scrub_pg(self, st: PGState) -> Dict[str, List[str]]:
-        """Primary-driven scrub of one PG; returns
-        {"inconsistent": [...], "repaired": [...]}."""
-        async with st.lock:
-            return await self._scrub_pg_locked(st)
-
-    async def _scrub_pg_locked(self, st: PGState) -> Dict[str, List[str]]:
-        pool = self.osdmap.pools[st.pgid.pool]
-        members = [o for o in st.acting
-                   if o not in (self.osd_id, CRUSH_ITEM_NONE)]
-        maps: Dict[int, Dict[str, Tuple]] = {
-            self.osd_id: self._build_scrub_map(st.pgid)}
-        for osd in members:
-            reqid = self._next_reqid()
-            fut = self._make_waiter(reqid, 1)
-            try:
-                await self._send_osd(osd, M.MOSDScrub(
-                    reqid=reqid, pgid=st.pgid))
-                acc = await asyncio.wait_for(fut, timeout=5.0)
-                _, reply = acc[0]
-                if reply is not None:
-                    maps[osd] = reply.objects
-            except (asyncio.TimeoutError, ConnectionError):
-                pass
-            finally:
-                self._pending.pop(reqid, None)
-        inconsistent: List[str] = []
-        repaired: List[str] = []
-        if pool.is_erasure():
-            # every shard is distinct: a member is corrupt when the crc of
-            # its bytes no longer matches its stored hinfo crc
-            for osd, smap in maps.items():
-                for oid, (_ver, _size, crc, stored) in smap.items():
-                    if stored is not None and crc != stored:
-                        inconsistent.append(oid)
-                        self.perf.inc("osd_scrub_errors")
-                        bad_shard = {i for i, o in enumerate(st.acting)
-                                     if o == osd}
-                        ok = await self._recover_ec_object(
-                            pool, st, oid, targets=[osd],
-                            exclude_sources=bad_shard)
-                        if ok:
-                            repaired.append(oid)
-        else:
-            # replicated: majority crc wins, divergent members get the
-            # authoritative copy re-pushed
-            all_oids = set()
-            for smap in maps.values():
-                all_oids.update(smap)
-            for oid in sorted(all_oids):
-                votes: Dict[Tuple[int, int], List[int]] = {}
-                for osd, smap in maps.items():
-                    if oid in smap:
-                        ver, size, crc, _ = smap[oid]
-                        votes.setdefault((size, crc), []).append(osd)
-                if len(votes) <= 1 and all(oid in m for m in maps.values()):
-                    continue
-                inconsistent.append(oid)
-                self.perf.inc("osd_scrub_errors")
-                # only auto-repair with a strict-majority authoritative
-                # copy; on a tie (e.g. 1-1 on size-2 pools) repairing
-                # would arbitrarily overwrite a possibly-good replica —
-                # the reference marks the object inconsistent instead
-                sizes = sorted((len(v) for v in votes.values()),
-                               reverse=True)
-                if len(sizes) > 1 and sizes[0] == sizes[1]:
-                    self.perf.inc("osd_scrub_ties")
-                    continue
-                winner = max(votes.values(), key=len)
-                if self.osd_id not in winner:
-                    if not await self._pull_rep_object(st, winner[0], oid):
-                        continue
-                data = self.store.read(_coll(st.pgid), oid)
-                ver = self.store.get_version(_coll(st.pgid), oid)
-                fixed = True
-                for osd in members:
-                    if osd in winner:
-                        continue
-                    try:
-                        await self._send_osd(osd, M.MOSDPGPush(
-                            pgid=st.pgid, oid=oid, op="repair",
-                            data=data, version=ver))
-                        self.perf.inc("osd_pushes_sent")
-                    except ConnectionError:
-                        fixed = False
-                if fixed:
-                    repaired.append(oid)
-        self.perf.inc("osd_scrubs")
-        return {"inconsistent": inconsistent, "repaired": repaired}
-
-    async def _scrub_loop(self) -> None:
-        """Periodic background scrub of primary PGs (reference scrub
-        scheduling; interval 0 disables)."""
-        interval = self.config.osd_scrub_interval
-        if not interval:
-            return
-        while not self._stopped:
-            await asyncio.sleep(interval)
-            for st in list(self.pgs.values()):
-                if st.primary == self.osd_id and not self._stopped:
-                    try:
-                        await self.scrub_pg(st)
-                    except Exception:
-                        self.perf.inc("osd_scrub_errors")
-
-    def _handle_push(self, msg: M.MOSDPGPush) -> None:
-        coll = _coll(msg.pgid)
-        st = self.pgs.get(msg.pgid)
-        if msg.op == "log_sync":
-            if st is not None:
-                st.last_update, st.log = pickle.loads(msg.data)
-                self._save_pg_meta(st)
-            return
-        if msg.op == "delete":
-            # version-guarded like pushes: a stale delete (old primary's
-            # backfill racing a newer primary's push) must not remove a
-            # newer object
-            cur = self.store.get_version(coll, msg.oid)
-            if cur <= msg.version:
-                self.store.queue_transaction(
-                    Transaction().remove(coll, msg.oid))
-        else:
-            cur = self.store.get_version(coll, msg.oid)
-            exists = self.store.stat(coll, msg.oid) is not None
-            # op == "repair": scrub found silent corruption (same version,
-            # wrong bytes) — apply unconditionally
-            if msg.op == "repair" or not (exists and cur >= msg.version):
-                txn = (Transaction()
-                       .remove(coll, msg.oid)
-                       .write(coll, msg.oid, 0, msg.data)
-                       .set_version(coll, msg.oid, msg.version))
-                for k, v in msg.xattrs.items():
-                    txn.setattr(coll, msg.oid, k, v)
-                self.store.queue_transaction(txn)
-        if st is not None and msg.entry is not None:
-            self._log_mutation(st, msg.entry.op, msg.entry.oid,
-                               msg.entry.version, entry=msg.entry)
-        self.perf.inc("osd_pushes_applied")
 
     # ------------------------------------------------------------ heartbeat
 

@@ -1,0 +1,148 @@
+"""PG state + persistent pg log plumbing (reference src/osd/PG.h/cc).
+
+Split out of osd.py along the reference's PG seam: PGState is the
+pg_info_t/pg_log_t analog; PGLogMixin carries the incremental on-store
+log persistence every mutation rides (PG::write_if_dirty) and the
+recovery-time full rewrite/load paths."""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ceph_tpu.cluster import messages as M
+from ceph_tpu.cluster import pglog
+from ceph_tpu.cluster.pglog import LogEntry, PGInfo, PGLog
+from ceph_tpu.cluster.store import Transaction
+from ceph_tpu.osdmap.osdmap import PGid
+
+
+# the per-PG metadata object holding the persisted log + last_update
+# (reference: the pgmeta ghobject, PG::_init / read_info)
+PGMETA = "_pgmeta_"
+
+@dataclass
+class PGState:
+    pgid: PGid
+    up: List[int] = field(default_factory=list)
+    acting: List[int] = field(default_factory=list)
+    primary: int = -1
+    # pg_info_t analog: every mutation advances last_update and appends to
+    # the log (reference PG.h pg_log)
+    last_update: pglog.Eversion = pglog.ZERO
+    log: PGLog = field(default_factory=PGLog)
+    # per-PG op serialization domain (reference PG lock / ShardedOpWQ,
+    # src/osd/OSD.h:1599): mutations hold this across their whole
+    # fan-out so concurrent writes order identically on all replicas
+    lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+    # reqid -> cached replies of completed mutations (reference pg_log
+    # dup tracking, osd_pg_log_dups_tracked): a resent non-idempotent op
+    # (exec, delete, ...) returns its original reply instead of
+    # re-executing.  In-memory only — a primary restart forgets dups the
+    # way a reference OSD forgets dups past the trimmed log.
+    reqid_replies: "OrderedDict[Tuple, List]" = field(
+        default_factory=OrderedDict)
+    # reqids currently executing: a dup that races its first instance
+    # waits for that instance's replies rather than re-executing
+    reqid_inflight: Dict[Tuple, asyncio.Future] = field(
+        default_factory=dict)
+
+    def info(self) -> PGInfo:
+        return PGInfo(last_update=self.last_update, log_tail=self.log.tail)
+
+
+@dataclass
+class MOSDPGQuery(M.Message):
+    pgid: Optional[PGid] = None
+
+
+@dataclass
+class MOSDPGQueryReply(M.Message):
+    pgid: Optional[PGid] = None
+    objects: Dict[str, int] = field(default_factory=dict)  # oid -> seq
+    info: Optional[PGInfo] = None
+    log: Optional[PGLog] = None
+
+
+def _coll(pgid: PGid) -> str:
+    return f"pg_{pgid.pool}_{pgid.seed}"
+
+
+
+class PGLogMixin:
+    """Persistent pg-log state carried by the OSD daemon (PG::write_if_dirty
+    / read_info seam)."""
+
+    def _next_version(self, st: PGState) -> pglog.Eversion:
+        """eversion for the next mutation: (map epoch, next seq)."""
+        return (self.osdmap.epoch if self.osdmap else 0, st.last_update[1] + 1)
+
+    @staticmethod
+    def _meta_key(version: pglog.Eversion) -> str:
+        return f"{version[0]:010d}.{version[1]:012d}"
+
+    def _log_mutation(self, st: PGState, op: str, oid: str,
+                      version: pglog.Eversion,
+                      entry: Optional[LogEntry] = None):
+        """Append a log entry + persist it INCREMENTALLY to the pgmeta
+        object (one omap key per entry + a head attr), so a restarted OSD
+        peers from its on-store log instead of backfilling and the hot
+        write path never re-serializes the whole log (reference: log
+        entries ride the op's own transaction, PG::write_if_dirty).
+        Replicas pass the primary's ``entry`` through verbatim so every
+        member's log (incl. prior_version chains) stays byte-identical.
+        Returns the appended LogEntry, or None for a replayed duplicate."""
+        if version <= st.last_update:
+            return None  # replayed/duplicate entry
+        if entry is None:
+            entry = LogEntry(op=op, oid=oid, version=version,
+                             prior_version=st.last_update)
+        st.log.append(entry)
+        st.last_update = version
+        dropped = st.log.trim()
+        coll = _coll(st.pgid)
+        txn = (Transaction()
+               .omap_set(coll, PGMETA,
+                         {self._meta_key(version): pickle.dumps(entry)})
+               .setattr(coll, PGMETA, "last_update", pickle.dumps(version))
+               .setattr(coll, PGMETA, "log_tail", pickle.dumps(st.log.tail)))
+        if dropped:
+            txn.omap_rmkeys(coll, PGMETA,
+                            [self._meta_key(e.version) for e in dropped])
+        self.store.queue_transaction(txn)
+        return entry
+
+    def _save_pg_meta(self, st: PGState) -> None:
+        """Full rewrite of the persisted log (recovery-time adoption of an
+        authoritative log; NOT on the per-op path)."""
+        coll = _coll(st.pgid)
+        old = list(self.store.omap_get(coll, PGMETA))
+        txn = Transaction()
+        if old:
+            txn.omap_rmkeys(coll, PGMETA, old)
+        txn.omap_set(coll, PGMETA,
+                     {self._meta_key(e.version): pickle.dumps(e)
+                      for e in st.log.entries})
+        txn.setattr(coll, PGMETA, "last_update", pickle.dumps(st.last_update))
+        txn.setattr(coll, PGMETA, "log_tail", pickle.dumps(st.log.tail))
+        self.store.queue_transaction(txn)
+
+    def _load_pg_meta(self, pgid: PGid) -> Tuple[pglog.Eversion, PGLog]:
+        coll = _coll(pgid)
+        lu = self.store.getattr(coll, PGMETA, "last_update")
+        if lu is None:
+            return pglog.ZERO, PGLog()
+        last_update = pickle.loads(lu)
+        tail_blob = self.store.getattr(coll, PGMETA, "log_tail")
+        tail = pickle.loads(tail_blob) if tail_blob else pglog.ZERO
+        entries = [pickle.loads(v) for _, v in
+                   sorted(self.store.omap_get(coll, PGMETA).items())]
+        entries = [e for e in entries if e.version > tail]
+        return last_update, PGLog(tail=tail, entries=entries)
+
+    def _list_pg_objects(self, pgid: PGid) -> List[str]:
+        return [o for o in self.store.list_objects(_coll(pgid))
+                if o != PGMETA]

@@ -1,0 +1,196 @@
+"""Peering-driven recovery + backfill (reference PG::start_peering_
+interval -> PrimaryLogPG::start_recovery_ops seam): authoritative-log
+selection, delta recovery, whole-PG backfill."""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+from typing import Dict
+
+from ceph_tpu.cluster import messages as M
+from ceph_tpu.cluster import pglog
+from ceph_tpu.cluster.pglog import PGInfo, PGLog
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+from ceph_tpu.cluster.pg import MOSDPGQuery, MOSDPGQueryReply, PGState, _coll
+from ceph_tpu.cluster.store import Transaction
+from ceph_tpu.osdmap.osdmap import PGid, PGPool
+
+
+class RecoveryMixin:
+
+    # ------------------------------------------------------------- recovery
+
+    async def _recover_all(self) -> None:
+        await asyncio.sleep(self.config.osd_recovery_delay_start)
+        for pgid, st in list(self.pgs.items()):
+            if st.primary == self.osd_id:
+                try:
+                    await self._recover_pg(st)
+                except Exception:
+                    # count AND surface: a silently-failing recovery loop
+                    # means a pool that never re-protects itself
+                    self.perf.inc("osd_recovery_errors")
+                    import logging
+                    logging.getLogger("ceph_tpu.osd").exception(
+                        "osd.%d: recovery of pg %s failed", self.osd_id, pgid)
+
+    async def _query_pg(self, osd: int, pgid: PGid):
+        """GetInfo/GetLog exchange with one member (reference peering
+        Query/Notify, PG.h RecoveryMachine GetInfo)."""
+        key = ("pgq", str(pgid), osd)
+        fut = self._make_waiter(key, 1)
+        try:
+            await self._send_osd(osd, MOSDPGQuery(pgid=pgid))
+            acc = await asyncio.wait_for(fut, timeout=2.0)
+            return acc[0][1]
+        except (asyncio.TimeoutError, ConnectionError):
+            return None
+        finally:
+            self._pending.pop(key, None)
+
+    async def _recover_pg(self, st: PGState) -> None:
+        """Primary-driven peering + recovery (flattened RecoveryMachine,
+        reference src/osd/PG.h:1994-2498):
+
+        1. GetInfo: collect (last_update, log) from every acting member.
+        2. GetLog: the max last_update owns the authoritative log; if that
+           is not us, bring ourselves up first (delta when our
+           last_update is inside the auth log window, backfill otherwise).
+        3. Active/Recovering: push ONLY the log delta to each stale
+           member; full-inventory backfill when a member is behind the
+           log tail.
+
+        Runs under the PG lock: peering mutates st.log/st.last_update, and
+        a client write interleaving with log adoption could regress
+        last_update and reuse an eversion (the reference blocks ops during
+        peering for the same reason)."""
+        async with st.lock:
+            await self._recover_pg_locked(st)
+
+    async def _recover_pg_locked(self, st: PGState) -> None:
+        m = self.osdmap
+        pool = m.pools[st.pgid.pool]
+        members = [o for o in st.acting
+                   if o not in (self.osd_id, CRUSH_ITEM_NONE)]
+        infos: Dict[int, PGInfo] = {self.osd_id: st.info()}
+        logs: Dict[int, PGLog] = {self.osd_id: st.log}
+        inventories: Dict[int, Dict[str, int]] = {}
+        for osd in members:
+            reply = await self._query_pg(osd, st.pgid)
+            if reply is None:
+                continue
+            infos[osd] = reply.info or PGInfo()
+            logs[osd] = reply.log or PGLog()
+            inventories[osd] = reply.objects or {}
+
+        auth = pglog.choose_authoritative(infos)
+        if auth != self.osd_id and \
+                infos[auth].last_update > st.last_update:
+            await self._sync_self_from(
+                pool, st, auth, logs[auth], inventories.get(auth, {}))
+
+        for osd in members:
+            if osd not in infos:
+                continue
+            peer_lu = infos[osd].last_update
+            if peer_lu >= st.last_update:
+                continue
+            to_sync = st.log.objects_to_sync(peer_lu)
+            if to_sync is None:
+                await self._backfill_member(
+                    pool, st, osd, inventories.get(osd, {}))
+            else:
+                # replay in VERSION order so the member's log advances
+                # monotonically (out-of-order pushes would hit the
+                # duplicate guard and leave silent log holes)
+                for oid, entry in sorted(to_sync.items(),
+                                         key=lambda kv: kv[1].version):
+                    await self._push_object(pool, st, osd, oid, entry)
+        self.perf.inc("osd_pg_recoveries")
+
+    async def _sync_self_from(self, pool: PGPool, st: PGState, auth: int,
+                              auth_log: PGLog,
+                              auth_inventory: Dict[str, int]) -> None:
+        """Bring the primary up to the authoritative member's state."""
+        coll = _coll(st.pgid)
+        to_sync = auth_log.objects_to_sync(st.last_update)
+        if to_sync is None:
+            # behind the log window: full backfill from auth's inventory
+            mine = {oid: self.store.get_version(coll, oid)
+                    for oid in self._list_pg_objects(st.pgid)}
+            to_pull = [oid for oid, ver in auth_inventory.items()
+                       if mine.get(oid, -1) < ver]
+            # objects we hold that the authoritative member does not =
+            # deletes we missed (possibly trimmed past the log tail);
+            # without this, a rejoining primary resurrects deleted objects
+            for oid in mine:
+                if oid not in auth_inventory:
+                    self.store.queue_transaction(
+                        Transaction().remove(coll, oid))
+        else:
+            to_pull = []
+            for oid, entry in to_sync.items():
+                if entry.op == "delete":
+                    self.store.queue_transaction(
+                        Transaction().remove(coll, oid))
+                else:
+                    to_pull.append(oid)
+        ok = True
+        for oid in to_pull:
+            if pool.is_erasure():
+                ok &= await self._recover_ec_object(
+                    pool, st, oid, targets=[self.osd_id])
+            else:
+                ok &= await self._pull_rep_object(st, auth, oid)
+        if not ok:
+            # a pull failed (auth unreachable mid-recovery): do NOT claim
+            # the authoritative version — stay stale so the next peering
+            # round retries instead of serving/pushing stale bytes as new
+            self.perf.inc("osd_recovery_incomplete")
+            return
+        # adopt the authoritative log
+        st.log = PGLog(tail=auth_log.tail,
+                       entries=list(auth_log.entries),
+                       max_entries=auth_log.max_entries)
+        st.last_update = auth_log.head if auth_log.entries else \
+            max(st.last_update, auth_log.tail)
+        self._save_pg_meta(st)
+
+    async def _backfill_member(self, pool: PGPool, st: PGState, osd: int,
+                               inventory: Dict[str, int]) -> None:
+        """Full-inventory resync for a member behind the log tail
+        (reference Backfilling state)."""
+        for oid in self._list_pg_objects(st.pgid):
+            ver = self.store.get_version(_coll(st.pgid), oid)
+            if inventory.get(oid, -1) >= ver:
+                continue
+            if pool.is_erasure():
+                await self._recover_ec_object(pool, st, oid, targets=[osd])
+            else:
+                data = self.store.read(_coll(st.pgid), oid)
+                try:
+                    await self._send_osd(osd, M.MOSDPGPush(
+                        pgid=st.pgid, oid=oid, data=data, version=ver))
+                    self.perf.inc("osd_pushes_sent")
+                except ConnectionError:
+                    pass
+        # stale objects the member has but we (authoritative) don't
+        mine = set(self._list_pg_objects(st.pgid))
+        for oid in inventory:
+            if oid not in mine:
+                try:
+                    await self._send_osd(osd, M.MOSDPGPush(
+                        pgid=st.pgid, oid=oid, op="delete",
+                        version=st.last_update[1]))
+                    self.perf.inc("osd_pushes_sent")
+                except ConnectionError:
+                    pass
+        # hand the member our log state so the next peering round sees it
+        # as current instead of re-backfilling
+        blob = pickle.dumps((st.last_update, st.log))
+        try:
+            await self._send_osd(osd, M.MOSDPGPush(
+                pgid=st.pgid, op="log_sync", data=blob))
+        except ConnectionError:
+            pass
