@@ -49,7 +49,8 @@ class ECBackendMixin:
     # (reference ECBackend::start_rmw, ECBackend.cc:1785-1886).
 
     async def _ec_write(self, pool: PGPool, st: PGState, oid: str,
-                        data: bytes, offset: Optional[int]) -> int:
+                        data: bytes, offset: Optional[int],
+                        snapc=None) -> int:
         """EC write incl. the RMW sequence (read old stripes, merge,
         re-encode, fan out shard writes).  Serialization: callers hold the
         PG-wide st.lock across the whole op, so overlapping RMWs to one
@@ -88,6 +89,11 @@ class ECBackendMixin:
 
         shard_size = sinfo.shard_size(new_size)
         hinfo = {"size": new_size, "version": version}
+        # clone-on-write (make_writeable): the pre-ops clone each
+        # member's SHARD object in place — no snapshot data crosses the
+        # wire — and persist the updated SnapSet; they ride the sub-write
+        # so clone + write are atomic per shard
+        pre_ops = self._cow_pre_ops(st, oid, snapc, erasure=True)
         n = codec.get_chunk_count()
         reqid = self._next_reqid()
         peers = []
@@ -101,7 +107,7 @@ class ECBackendMixin:
         if my_shard is not None:
             self._apply_shard(st.pgid, oid, my_shard,
                               shards[my_shard].tobytes(), chunk_off,
-                              shard_size, hinfo)
+                              shard_size, hinfo, pre_ops=pre_ops)
         entry = self._log_mutation(st, "modify", oid, eversion)
         if peers:
             fut = self._make_waiter(reqid, len(peers))
@@ -111,6 +117,7 @@ class ECBackendMixin:
                         reqid=reqid, pgid=st.pgid, oid=oid, shard=shard,
                         data=shards[shard].tobytes(), chunk_off=chunk_off,
                         shard_size=shard_size, hinfo=hinfo, entry=entry,
+                        pre_ops=pre_ops,
                         epoch=self.osdmap.epoch))
                 except (ConnectionError, OSError, RuntimeError):
                     self._waiter_dec(reqid)
@@ -125,7 +132,8 @@ class ECBackendMixin:
         return 0
 
     def _apply_shard(self, pgid: PGid, oid: str, shard: int, data: bytes,
-                     chunk_off: int, shard_size: int, hinfo: Dict) -> None:
+                     chunk_off: int, shard_size: int, hinfo: Dict,
+                     pre_ops: Optional[List[Tuple]] = None) -> None:
         """Apply a shard sub-range write with its crc in ONE atomic
         transaction (ECUtil::HashInfo analog, reference ECUtil.h:105-163:
         the crc is CUMULATIVE for appends/full rewrites — no whole-shard
@@ -154,13 +162,17 @@ class ECBackendMixin:
                 old.extend(b"\0" * (shard_size - len(old)))
             old[chunk_off:chunk_off + len(data)] = data
             crc = crcmod.crc32c(0xFFFFFFFF, bytes(old[:shard_size]))
-        txn = (Transaction()
-               .write(coll, oid, chunk_off, data)
-               .truncate(coll, oid, shard_size)
-               .setattr(coll, oid, "shard", str(shard).encode())
-               .setattr(coll, oid, "size", str(hinfo["size"]).encode())
-               .setattr(coll, oid, "hinfo_crc", str(crc).encode())
-               .set_version(coll, oid, hinfo["version"]))
+        txn = Transaction()
+        if pre_ops:
+            # snapshot pre-ops (shard-local COW clone + snapset) must land
+            # in the same transaction, BEFORE the new bytes
+            txn.ops.extend(tuple(op) for op in pre_ops)
+        txn.write(coll, oid, chunk_off, data) \
+           .truncate(coll, oid, shard_size) \
+           .setattr(coll, oid, "shard", str(shard).encode()) \
+           .setattr(coll, oid, "size", str(hinfo["size"]).encode()) \
+           .setattr(coll, oid, "hinfo_crc", str(crc).encode()) \
+           .set_version(coll, oid, hinfo["version"])
         self.store.queue_transaction(txn)
 
     async def _handle_ec_write(self, conn: Connection,
@@ -168,7 +180,8 @@ class ECBackendMixin:
         shard_size = msg.shard_size if msg.shard_size is not None \
             else msg.chunk_off + len(msg.data)
         self._apply_shard(msg.pgid, msg.oid, msg.shard, msg.data,
-                          msg.chunk_off, shard_size, msg.hinfo)
+                          msg.chunk_off, shard_size, msg.hinfo,
+                          pre_ops=msg.pre_ops)
         st = self.pgs.get(msg.pgid)
         if st is not None and msg.entry is not None:
             self._log_mutation(st, msg.entry.op, msg.entry.oid,

@@ -20,27 +20,56 @@ class ReplicatedBackendMixin:
 
     # replicated write: local txn + MOSDRepOp fan-out (ReplicatedBackend)
     async def _op_write_full(self, pool: PGPool, st: PGState, oid: str,
-                             data: bytes) -> int:
+                             data: bytes, snapc=None) -> int:
         if pool.is_erasure():
-            return await self._ec_write(pool, st, oid, data, offset=None)
+            return await self._ec_write(pool, st, oid, data, offset=None,
+                                        snapc=snapc)
         version = self._next_version(st)
-        txn = (Transaction()
-               .remove(_coll(st.pgid), oid)
-               .write(_coll(st.pgid), oid, 0, data)
-               .set_version(_coll(st.pgid), oid, version[1]))
+        txn = self._snap_pre_txn(st, oid, snapc)
+        txn.remove(_coll(st.pgid), oid) \
+           .write(_coll(st.pgid), oid, 0, data) \
+           .set_version(_coll(st.pgid), oid, version[1])
         return await self._replicate_txn(st, txn, "modify", oid, version)
 
     async def _op_write(self, pool: PGPool, st: PGState, oid: str,
-                        offset: int, data: bytes) -> int:
+                        offset: int, data: bytes, snapc=None) -> int:
         """Partial write at (offset, len) — the RMW path for EC pools
         (reference ECBackend::start_rmw, ECBackend.cc:1785)."""
         if pool.is_erasure():
-            return await self._ec_write(pool, st, oid, data, offset=offset)
+            return await self._ec_write(pool, st, oid, data, offset=offset,
+                                        snapc=snapc)
         version = self._next_version(st)
-        txn = (Transaction()
-               .write(_coll(st.pgid), oid, offset, data)
-               .set_version(_coll(st.pgid), oid, version[1]))
+        txn = self._snap_pre_txn(st, oid, snapc)
+        txn.write(_coll(st.pgid), oid, offset, data) \
+           .set_version(_coll(st.pgid), oid, version[1])
         return await self._replicate_txn(st, txn, "modify", oid, version)
+
+    def _cow_pre_ops(self, st: PGState, oid: str, snapc,
+                     erasure: bool) -> list:
+        """Clone-on-write pre-ops for a mutation (make_writeable,
+        PrimaryLogPG.cc:7019) — the ONE seam both backends and delete go
+        through.  The returned ops must ride the same transaction /
+        sub-write as the mutation so clone + snapset apply atomically."""
+        from ceph_tpu.cluster import snaps as snapmod
+
+        if snapc is None:
+            return []
+        coll = _coll(st.pgid)
+        if erasure:
+            sa = self.store.getattr(coll, oid, "size")
+            size = int(sa) if sa else 0
+        else:
+            size = self.store.stat(coll, oid) or 0
+        ops, cloned = snapmod.make_writeable_ops(
+            self.store, coll, oid, snapc, size)
+        if cloned:
+            self.perf.inc("osd_snap_clones")
+        return ops
+
+    def _snap_pre_txn(self, st: PGState, oid: str, snapc) -> Transaction:
+        txn = Transaction()
+        txn.ops.extend(self._cow_pre_ops(st, oid, snapc, erasure=False))
+        return txn
 
     async def _replicate_txn(self, st: PGState, txn: Transaction,
                              op: str, oid: str,
@@ -78,11 +107,19 @@ class ReplicatedBackendMixin:
                 self._pending.pop(reqid, None)
         return 0
 
-    async def _op_delete(self, pool: PGPool, st: PGState, oid: str) -> int:
+    async def _op_delete(self, pool: PGPool, st: PGState, oid: str,
+                         snapc=None) -> int:
         """Delete is ack-gated exactly like writes — fire-and-forget
-        MOSDRepOps let a slow replica resurrect the object."""
+        MOSDRepOps let a slow replica resurrect the object.  Under a
+        SnapContext the pre-delete head is cloned first (whiteout
+        semantics: snaps keep seeing the object; for EC pools the clone
+        op copies each member's SHARD object in place)."""
+        coll = _coll(st.pgid)
         version = self._next_version(st)
-        txn = Transaction().remove(_coll(st.pgid), oid)
+        txn = Transaction()
+        txn.ops.extend(self._cow_pre_ops(st, oid, snapc,
+                                         erasure=pool.is_erasure()))
+        txn.remove(coll, oid)
         return await self._replicate_txn(st, txn, "delete", oid, version)
 
     async def _op_read(self, pool: PGPool, st: PGState, oid: str,
@@ -97,6 +134,12 @@ class ReplicatedBackendMixin:
         reference ReplicatedBackend::prepare_pull).  Returns success: the
         caller must NOT claim the authoritative version for objects it
         failed to pull."""
+        return await self._pull_rep_object_st(st, source, oid) == "ok"
+
+    async def _pull_rep_object_st(self, st: PGState, source: int,
+                                  oid: str) -> str:
+        """Pull with outcome: "ok" | "enoent" (source lacks the object —
+        definitive, not a failure) | "fail" (unreachable/timeout)."""
         reqid = self._next_reqid()
         fut = self._make_waiter(reqid, 1)
         try:
@@ -104,6 +147,8 @@ class ReplicatedBackendMixin:
                 reqid=reqid, pgid=st.pgid, oid=oid, shard=-1))
             acc = await asyncio.wait_for(fut, timeout=2.0)
             result, reply = acc[0]
+            if result == -2:
+                return "enoent"
             if result == 0 and reply is not None:
                 txn = (Transaction()
                        .remove(_coll(st.pgid), oid)
@@ -113,12 +158,12 @@ class ReplicatedBackendMixin:
                 for k, v in reply.hinfo.get("xattrs", {}).items():
                     txn.setattr(_coll(st.pgid), oid, k, v)
                 self.store.queue_transaction(txn)
-                return True
+                return "ok"
         except (asyncio.TimeoutError, ConnectionError):
             pass
         finally:
             self._pending.pop(reqid, None)
-        return False
+        return "fail"
 
     async def _push_object(self, pool: PGPool, st: PGState, osd: int,
                            oid: str, entry: LogEntry) -> None:
@@ -132,6 +177,12 @@ class ReplicatedBackendMixin:
             except ConnectionError:
                 pass
             return
+        if entry.op == "trim" or self._has_snap_state(st, oid):
+            # snapshot-bearing object: the logged head mutation implies
+            # clone/snapset changes that must travel with it
+            await self._push_snap_state(pool, st, osd, oid)
+        if entry.op == "trim":
+            return
         if pool.is_erasure():
             await self._recover_ec_object(pool, st, oid, targets=[osd],
                                           entry=entry)
@@ -143,10 +194,53 @@ class ReplicatedBackendMixin:
         try:
             await self._send_osd(osd, M.MOSDPGPush(
                 pgid=st.pgid, oid=oid, data=data,
+                xattrs=self.store.get_xattrs(coll, oid),
                 version=entry.version[1], entry=entry))
             self.perf.inc("osd_pushes_sent")
         except ConnectionError:
             pass
+
+    def _has_snap_state(self, st: PGState, oid: str) -> bool:
+        from ceph_tpu.cluster import snaps as snapmod
+
+        return self.store.getattr(_coll(st.pgid),
+                                  snapmod.snapdir_oid(oid), "ss") is not None
+
+    async def _push_snap_state(self, pool: PGPool, st: PGState, osd: int,
+                               head: str) -> None:
+        """Sync one head's snapshot state to a member: the authoritative
+        SnapSet (as a snap_sync push — the receiver also deletes clones
+        the set no longer lists, covering missed trims) plus every live
+        clone object."""
+        from ceph_tpu.cluster import snaps as snapmod
+
+        coll = _coll(st.pgid)
+        blob = self.store.getattr(coll, snapmod.snapdir_oid(head), "ss")
+        if blob is None:
+            return
+        try:
+            await self._send_osd(osd, M.MOSDPGPush(
+                pgid=st.pgid, oid=head, op="snap_sync", data=blob))
+        except ConnectionError:
+            return
+        ss = snapmod.SnapSet.decode(blob)
+        for c in ss.clones:
+            cname = snapmod.clone_oid(head, c)
+            if self.store.stat(coll, cname) is None:
+                continue
+            if pool.is_erasure():
+                await self._recover_ec_object(pool, st, cname,
+                                              targets=[osd])
+            else:
+                try:
+                    await self._send_osd(osd, M.MOSDPGPush(
+                        pgid=st.pgid, oid=cname,
+                        data=self.store.read(coll, cname),
+                        xattrs=self.store.get_xattrs(coll, cname),
+                        version=self.store.get_version(coll, cname)))
+                    self.perf.inc("osd_pushes_sent")
+                except ConnectionError:
+                    pass
 
 
     def _handle_push(self, msg: M.MOSDPGPush) -> None:
@@ -156,6 +250,24 @@ class ReplicatedBackendMixin:
             if st is not None:
                 st.last_update, st.log = pickle.loads(msg.data)
                 self._save_pg_meta(st)
+            return
+        if msg.op == "snap_sync":
+            # adopt the authoritative SnapSet; clones it no longer lists
+            # were trimmed while we were away.  Version-guarded like data
+            # pushes: an old primary still draining its push queue must
+            # never overwrite a newer snapset (and destroy its clones)
+            from ceph_tpu.cluster import snaps as snapmod
+
+            ss = snapmod.SnapSet.decode(msg.data)
+            local = snapmod.load_snapset(self.store, coll, msg.oid)
+            if local.version >= ss.version:
+                return
+            txn = Transaction()
+            txn.ops.extend(snapmod.snapset_ops(coll, msg.oid, ss))
+            txn.ops.extend(snapmod.prune_clone_ops(
+                self.store, coll, msg.oid, ss))
+            self.store.queue_transaction(txn)
+            self.perf.inc("osd_pushes_applied")
             return
         if msg.op == "delete":
             # version-guarded like pushes: a stale delete (old primary's

@@ -181,24 +181,53 @@ class ClientOpsMixin:
             if not fut.done():
                 fut.set_result(None)
 
+    def _resolve_snap_read(self, pool, st, oid: str):
+        """Map (oid, msg.snapid) -> the store object serving the read
+        (reference find_object_context): the head, a clone, or ENOENT."""
+        from ceph_tpu.cluster import snaps as snapmod
+
+        coll = _coll(st.pgid)
+        ss = snapmod.load_snapset(self.store, coll, oid)
+        head_exists = self.store.stat(coll, oid) is not None
+        return ss, coll, head_exists
+
+    def _snap_read_oid(self, pool, st, oid: str, snapid) -> str:
+        from ceph_tpu.cluster import snaps as snapmod
+
+        if snapid is None:
+            return oid
+        if snapid in pool.removed_snaps:
+            # a trimmed snap no longer exists; resolving it against the
+            # shrunk SnapSet would silently serve head data
+            raise FileNotFoundError(f"{oid}@{snapid}: snap removed")
+        ss, coll, head_exists = self._resolve_snap_read(pool, st, oid)
+        kind, cid = ss.resolve_read(snapid, head_exists)
+        if kind == "head":
+            return oid
+        if kind == "clone":
+            return snapmod.clone_oid(oid, cid)
+        raise FileNotFoundError(f"{oid}@{snapid}")
+
     async def _execute_client_ops(self, conn, msg, m, pool, st, top):
         for opname, args in msg.ops:
             if opname == "write_full":
                 async with st.lock:
                     r = await self._op_write_full(
-                        pool, st, msg.oid, args["data"])
+                        pool, st, msg.oid, args["data"], snapc=msg.snapc)
                 await conn.send(M.MOSDOpReply(
                     reqid=msg.reqid, result=r, epoch=m.epoch))
             elif opname == "write":
                 async with st.lock:
                     r = await self._op_write(pool, st, msg.oid,
-                                             args["offset"], args["data"])
+                                             args["offset"], args["data"],
+                                             snapc=msg.snapc)
                 await conn.send(M.MOSDOpReply(
                     reqid=msg.reqid, result=r, epoch=m.epoch))
             elif opname == "read":
                 try:
+                    oid = self._snap_read_oid(pool, st, msg.oid, msg.snapid)
                     data = await self._op_read(
-                        pool, st, msg.oid,
+                        pool, st, oid,
                         args.get("offset", 0), args.get("length"))
                     await conn.send(M.MOSDOpReply(
                         reqid=msg.reqid, result=0, data=data, epoch=m.epoch))
@@ -207,20 +236,31 @@ class ClientOpsMixin:
                         reqid=msg.reqid, result=-2, epoch=m.epoch))
             elif opname == "delete":
                 async with st.lock:
-                    r = await self._op_delete(pool, st, msg.oid)
+                    r = await self._op_delete(pool, st, msg.oid,
+                                              snapc=msg.snapc)
                 await conn.send(M.MOSDOpReply(
                     reqid=msg.reqid, result=r, epoch=m.epoch))
             elif opname == "stat":
-                size = self.store.stat(_coll(st.pgid), msg.oid)
-                if pool.is_erasure():
-                    xs = self.store.getattr(_coll(st.pgid), msg.oid, "size")
-                    size = int(xs) if xs else (None if size is None else size)
+                try:
+                    oid = self._snap_read_oid(pool, st, msg.oid, msg.snapid)
+                except FileNotFoundError:
+                    oid = None
+                size = None
+                if oid is not None:
+                    size = self.store.stat(_coll(st.pgid), oid)
+                    if pool.is_erasure():
+                        xs = self.store.getattr(_coll(st.pgid), oid, "size")
+                        size = int(xs) if xs else \
+                            (None if size is None else size)
                 await conn.send(M.MOSDOpReply(
                     reqid=msg.reqid,
                     result=0 if size is not None else -2,
                     data=size, epoch=m.epoch))
             elif opname == "list":
-                names = self._list_pg_objects(st.pgid)
+                from ceph_tpu.cluster import snaps as snapmod
+
+                names = [o for o in self._list_pg_objects(st.pgid)
+                         if not snapmod.is_snap_key(o)]
                 await conn.send(M.MOSDOpReply(
                     reqid=msg.reqid, result=0, data=names, epoch=m.epoch))
             elif opname in ("getxattr", "getxattrs", "omap_get"):

@@ -116,6 +116,10 @@ class MOSDOp(Message):
     oid: str = ""
     ops: List[Tuple[str, Dict[str, Any]]] = field(default_factory=list)
     epoch: int = 0
+    # snapshot axis (reference MOSDOp carries both): snapc governs
+    # clone-on-write for mutations, snapid selects the snap a read sees
+    snapc: Optional[Tuple[int, Tuple[int, ...]]] = None
+    snapid: Optional[int] = None
 
 
 @dataclass
@@ -208,6 +212,10 @@ class MOSDECSubOpWrite(Message):
     data: bytes = b""
     chunk_off: int = 0
     shard_size: Optional[int] = None
+    # store-level ops applied atomically BEFORE the shard write (COW
+    # clone of the pre-write shard, snapset persistence, clone trims) —
+    # the shard-local analog of the replicated txn fan-out
+    pre_ops: List[Tuple] = field(default_factory=list)
     hinfo: Dict[str, Any] = field(default_factory=dict)
     entry: Any = None            # pglog.LogEntry
     epoch: int = 0

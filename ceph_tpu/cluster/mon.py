@@ -409,7 +409,11 @@ class Monitor(Dispatcher):
         cmd = msg.cmd
         result, data = 0, None
         prefix = cmd.get("prefix")
-        mutating = prefix in ("osd pool create", "osd out", "osd in")
+        mutating = prefix in (
+            "osd pool create", "osd out", "osd in",
+            "osd pool mksnap", "osd pool rmsnap",
+            "osd pool selfmanaged_snap_create",
+            "osd pool selfmanaged_snap_remove")
         if mutating and not self.is_leader:
             # forward to the leader, relay its reply (reference
             # Monitor::forward_request_leader)
@@ -437,6 +441,10 @@ class Monitor(Dispatcher):
                         data, inc = self._create_pool(cmd)
                         if not await self._commit_inc(inc):
                             result, data = -11, "quorum lost"
+            elif prefix in ("osd pool mksnap", "osd pool rmsnap",
+                            "osd pool selfmanaged_snap_create",
+                            "osd pool selfmanaged_snap_remove"):
+                result, data = await self._handle_snap_command(prefix, cmd)
             elif prefix == "osd out":
                 async with self._map_mutex:
                     inc = self._new_inc()
@@ -543,6 +551,57 @@ class Monitor(Dispatcher):
         self._propose("pool_create", (pool_id, name))
         self.perf.inc("mon_pool_create")
         return pool_id, inc
+
+    async def _handle_snap_command(self, prefix: str, cmd):
+        """Pool/selfmanaged snapshot lifecycle (reference
+        OSDMonitor::prepare_pool_op on POOL_OP_CREATE_SNAP /
+        POOL_OP_CREATE_UNMANAGED_SNAP / the delete twins): every variant
+        commits an updated pg_pool_t through Paxos so OSDs learn snap ids
+        and removed_snaps from the map."""
+        import dataclasses as _dc
+
+        ref = cmd.get("pool")
+        pool_id = next((pid for pid, p in self.osdmap.pools.items()
+                        if p.name == ref or pid == ref), None)
+        if pool_id is None:
+            return -2, f"pool {ref!r} not found"
+        async with self._map_mutex:
+            pool = self.osdmap.pools[pool_id]
+            newp = _dc.replace(pool, snaps=dict(pool.snaps),
+                               removed_snaps=tuple(pool.removed_snaps))
+            data = None
+            if prefix == "osd pool mksnap":
+                name = cmd["snap"]
+                if name in newp.snaps.values():
+                    return 0, next(i for i, n in newp.snaps.items()
+                                   if n == name)  # idempotent retry
+                newp.snap_seq += 1
+                newp.snaps[newp.snap_seq] = name
+                data = newp.snap_seq
+            elif prefix == "osd pool rmsnap":
+                name = cmd["snap"]
+                sid = next((i for i, n in newp.snaps.items() if n == name),
+                           None)
+                if sid is None:
+                    return -2, f"snap {name!r} not found"
+                del newp.snaps[sid]
+                newp.removed_snaps = tuple(newp.removed_snaps) + (sid,)
+                data = sid
+            elif prefix == "osd pool selfmanaged_snap_create":
+                newp.snap_seq += 1
+                data = newp.snap_seq
+            else:  # selfmanaged_snap_remove
+                sid = int(cmd["snapid"])
+                if sid in newp.removed_snaps:
+                    return 0, sid  # idempotent retry
+                newp.removed_snaps = tuple(newp.removed_snaps) + (sid,)
+                data = sid
+            inc = self._new_inc()
+            inc.new_pools[pool_id] = newp
+            if not await self._commit_inc(inc):
+                return -11, "quorum lost"
+            self.perf.inc("mon_snap_commands")
+            return 0, data
 
     # -- map distribution --------------------------------------------------
 

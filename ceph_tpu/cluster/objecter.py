@@ -151,7 +151,8 @@ class Objecter(Dispatcher):
     async def op_submit(self, pool_id: int, oid: str,
                         ops: List[Tuple[str, Dict[str, Any]]],
                         timeout: Optional[float] = None,
-                        pgid=None) -> M.MOSDOpReply:
+                        pgid=None, snapc=None,
+                        snapid=None) -> M.MOSDOpReply:
         if timeout is None:
             timeout = self.config.rados_osd_op_timeout
         deadline = asyncio.get_event_loop().time() + timeout
@@ -167,7 +168,8 @@ class Objecter(Dispatcher):
                 fut = asyncio.get_event_loop().create_future()
                 self._inflight[reqid] = fut
                 msg = M.MOSDOp(reqid=reqid, pgid=pgid, oid=oid, ops=ops,
-                               epoch=self.osdmap.epoch)
+                               epoch=self.osdmap.epoch,
+                               snapc=snapc, snapid=snapid)
                 try:
                     await self.messenger.send_message(msg, tuple(addr))
                     # outwait the OSD's own replica-ack timeout: abandoning
@@ -298,17 +300,84 @@ class Objecter(Dispatcher):
 
 
 class IoCtx:
-    """Pool I/O context (librados IoCtx analog)."""
+    """Pool I/O context (librados IoCtx analog).
+
+    Snapshot surface (librados snap API): pool snaps attach their
+    SnapContext to writes automatically (from the osdmap's pg_pool_t);
+    ``set_snap_context`` installs an explicit selfmanaged context (RBD's
+    mode); ``set_snap_read``/per-call ``snapid`` select the snap reads
+    observe (reference rados_ioctx_snap_set_read)."""
 
     def __init__(self, objecter: Objecter, pool_id: int):
         self.objecter = objecter
         self.pool_id = pool_id
+        self._snapc: Optional[Tuple[int, Tuple[int, ...]]] = None
+        self._snap_read: Optional[int] = None
+
+    # -- snapshot controls -------------------------------------------------
+
+    def set_snap_context(self, seq: int, snaps) -> None:
+        """Selfmanaged SnapContext for subsequent writes (descending)."""
+        self._snapc = (seq, tuple(snaps))
+
+    def set_snap_read(self, snapid: Optional[int]) -> None:
+        """Snap observed by subsequent reads (None = HEAD)."""
+        self._snap_read = snapid
+
+    def _write_snapc(self):
+        if self._snapc is not None:
+            return self._snapc
+        pool = self.objecter.osdmap.pools.get(self.pool_id) \
+            if self.objecter.osdmap else None
+        if pool is not None and pool.snaps:
+            return pool.snap_context()
+        return None
+
+    async def snap_create(self, name: str) -> int:
+        """Pool snapshot (reference rados_ioctx_snap_create)."""
+        sid = await self.objecter.mon_command({
+            "prefix": "osd pool mksnap", "pool": self.pool_id, "snap": name})
+        await self.objecter._refresh_map()
+        return sid
+
+    async def snap_remove(self, name: str) -> int:
+        sid = await self.objecter.mon_command({
+            "prefix": "osd pool rmsnap", "pool": self.pool_id, "snap": name})
+        await self.objecter._refresh_map()
+        return sid
+
+    def snap_list(self) -> Dict[int, str]:
+        pool = self.objecter.osdmap.pools[self.pool_id]
+        return dict(pool.snaps)
+
+    def snap_lookup(self, name: str) -> int:
+        for sid, n in self.snap_list().items():
+            if n == name:
+                return sid
+        raise FileNotFoundError(name)
+
+    async def selfmanaged_snap_create(self) -> int:
+        """Allocate a snap id the CLIENT manages (reference
+        rados_ioctx_selfmanaged_snap_create — RBD's snapshot mode)."""
+        sid = await self.objecter.mon_command({
+            "prefix": "osd pool selfmanaged_snap_create",
+            "pool": self.pool_id})
+        await self.objecter._refresh_map()
+        return sid
+
+    async def selfmanaged_snap_remove(self, snapid: int) -> None:
+        await self.objecter.mon_command({
+            "prefix": "osd pool selfmanaged_snap_remove",
+            "pool": self.pool_id, "snapid": snapid})
+        await self.objecter._refresh_map()
+
+    # -- data ops ----------------------------------------------------------
 
     async def write_full(self, oid: str, data: bytes,
                          timeout: float = None) -> None:
         reply = await self.objecter.op_submit(
             self.pool_id, oid, [("write_full", {"data": data})],
-            timeout=timeout)
+            timeout=timeout, snapc=self._write_snapc())
         if reply.result != 0:
             raise IOError(f"write_full({oid}) -> {reply.result}: {reply.data}")
 
@@ -318,19 +387,21 @@ class IoCtx:
         (reference IoCtxImpl::write -> ECBackend::start_rmw)."""
         reply = await self.objecter.op_submit(
             self.pool_id, oid, [("write", {"offset": offset, "data": data})],
-            timeout=timeout)
+            timeout=timeout, snapc=self._write_snapc())
         if reply.result != 0:
             raise IOError(f"write({oid}) -> {reply.result}: {reply.data}")
 
     async def read(self, oid: str, offset: int = 0,
-                   length: int = None, timeout: float = None) -> bytes:
+                   length: int = None, timeout: float = None,
+                   snapid: int = None) -> bytes:
         args = {}
         if offset:
             args["offset"] = offset
         if length is not None:
             args["length"] = length
         reply = await self.objecter.op_submit(
-            self.pool_id, oid, [("read", args)], timeout=timeout)
+            self.pool_id, oid, [("read", args)], timeout=timeout,
+            snapid=snapid if snapid is not None else self._snap_read)
         if reply.result == -2:
             raise FileNotFoundError(oid)
         if reply.result != 0:
@@ -339,12 +410,15 @@ class IoCtx:
 
     async def remove(self, oid: str) -> None:
         reply = await self.objecter.op_submit(self.pool_id, oid,
-                                              [("delete", {})])
+                                              [("delete", {})],
+                                              snapc=self._write_snapc())
         if reply.result != 0:
             raise IOError(f"remove({oid}) -> {reply.result}")
 
-    async def stat(self, oid: str) -> int:
-        reply = await self.objecter.op_submit(self.pool_id, oid, [("stat", {})])
+    async def stat(self, oid: str, snapid: int = None) -> int:
+        reply = await self.objecter.op_submit(
+            self.pool_id, oid, [("stat", {})],
+            snapid=snapid if snapid is not None else self._snap_read)
         if reply.result != 0:
             raise FileNotFoundError(oid)
         return reply.data

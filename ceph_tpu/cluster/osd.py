@@ -110,6 +110,9 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         self._watchers: Dict[Tuple, Dict[Tuple[str, int], Connection]] = {}
         self._notifies: Dict[int, Tuple[asyncio.Future, Set[str]]] = {}
         self._notify_id = 0
+        # removed snaps already trimmed per PG (purged_snaps analog;
+        # in-memory — a restart re-runs one idempotent trim pass)
+        self._purged_snaps: Dict[Tuple, set] = {}
         self._stopped = False
 
     # ------------------------------------------------------------ lifecycle
@@ -383,6 +386,52 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         if changed and not self._stopped:
             self._tasks.append(asyncio.get_event_loop().create_task(
                 self._recover_all()))
+        if not self._stopped and any(
+                set(newmap.pools[st.pgid.pool].removed_snaps)
+                - self._purged_snaps.get(st.pgid, set())
+                for st in self.pgs.values()
+                if st.pgid.pool in newmap.pools
+                and newmap.pools[st.pgid.pool].removed_snaps):
+            self._tasks.append(asyncio.get_event_loop().create_task(
+                self._snap_trim_all()))
+
+    async def _snap_trim_all(self) -> None:
+        """Snap trimming (reference PrimaryLogPG::SnapTrimmer): for every
+        primary PG whose pool has removed snaps, drop them from object
+        snapsets and delete fully-trimmed clone objects.  Idempotent —
+        re-running over an already-trimmed snapset is a no-op — and
+        _purged_snaps (the reference purged_snaps analog, in-memory) keeps
+        later map epochs from rescanning stores for long-gone snaps."""
+        from ceph_tpu.cluster import snaps as snapmod
+
+        purged_now: Dict[object, set] = {}
+        for st in list(self.pgs.values()):
+            if self._stopped or st.primary != self.osd_id:
+                continue
+            pool = self.osdmap.pools.get(st.pgid.pool)
+            if pool is None or not pool.removed_snaps:
+                continue
+            removed = set(pool.removed_snaps)
+            if removed <= self._purged_snaps.get(st.pgid, set()):
+                continue
+            purged_now.setdefault(st.pgid, set()).update(removed)
+            coll = _coll(st.pgid)
+            for name in self.store.list_objects(coll):
+                if not name.endswith(snapmod._SNAPDIR):
+                    continue
+                async with st.lock:
+                    ops = snapmod.trim_ops(self.store, coll, name, removed)
+                    if not ops:
+                        continue
+                    txn = Transaction()
+                    txn.ops.extend(ops)
+                    version = self._next_version(st)
+                    await self._replicate_txn(
+                        st, txn, "trim", snapmod.head_of(name), version)
+                    self.perf.inc("osd_snaps_trimmed")
+        if not self._stopped:
+            for pgid, snaps in purged_now.items():
+                self._purged_snaps.setdefault(pgid, set()).update(snaps)
 
     def _advance_pgs(self) -> bool:
         """Recompute PG membership for this OSD; returns True if the set of

@@ -3,11 +3,13 @@
 Behavioral analog of the reference librbd core data path
 (src/librbd/: images are a header object holding metadata plus
 "rbd_data.<id>.%016x" objects laid out by the Striper; src/osdc/Striper
-drives the extent math).  Subset implemented: create/open/remove,
-size/resize, striped read/write at arbitrary offsets, snapshot ids
-recorded in the header (metadata-level snapshots), stats.  The data path
-rides IoCtx, so EC pools, recovery, and scrub all apply to images
-unchanged.
+drives the extent math).  Implemented: create/open/remove, size/resize,
+striped read/write at arbitrary offsets, REAL snapshots (selfmanaged
+RADOS snaps + clone-on-write at the OSD: snap reads are point-in-time,
+reference librbd snap_create -> ioctx selfmanaged snaps + SnapContext),
+clone with copy-on-write copy-up from the parent snap (reference
+librbd::CloneRequest / CopyupRequest), and stats.  The data path rides
+IoCtx, so EC pools, recovery, and scrub all apply to images unchanged.
 """
 
 from __future__ import annotations
@@ -32,8 +34,11 @@ class ImageHeader:
     name: str
     size: int
     layout: FileLayout
-    snaps: Dict[str, int] = field(default_factory=dict)  # name -> snap id
-    next_snap_id: int = 1
+    snaps: Dict[str, int] = field(default_factory=dict)  # name -> rados snap
+    snap_sizes: Dict[int, int] = field(default_factory=dict)  # id -> size
+    # clone parentage (librbd parent_info): (parent image, parent snapid);
+    # reads of unwritten child extents fall through to the parent snap
+    parent: Optional[tuple] = None
 
 
 class RBD:
@@ -82,14 +87,62 @@ class RBD:
         hdr: ImageHeader = pickle.loads(blob)
         return Image(self.ioctx, hdr)
 
+    async def clone(self, parent_name: str, snap_name: str,
+                    child_name: str) -> None:
+        """COW clone of a parent snapshot (reference librbd::CloneRequest):
+        the child starts with NO data objects; reads fall through to the
+        parent snap, writes copy-up the touched object first."""
+        parent = await self.open(parent_name)
+        psid = parent.header.snaps.get(snap_name)
+        if psid is None:
+            raise FileNotFoundError(f"{parent_name}@{snap_name}")
+        size = parent.header.snap_sizes.get(psid, parent.header.size)
+        hdr = ImageHeader(name=child_name, size=size,
+                          layout=parent.header.layout,
+                          parent=(parent_name, psid))
+        try:
+            await self.ioctx.stat(self._header_oid(child_name))
+            raise FileExistsError(child_name)
+        except FileNotFoundError:
+            pass
+        await self.ioctx.write_full(self._header_oid(child_name),
+                                    pickle.dumps(hdr))
+
 
 class Image:
-    """Open image handle (reference librbd::Image)."""
+    """Open image handle (reference librbd::Image).
+
+    Data ops run through a private IoCtx carrying this image's
+    SnapContext (librbd keeps its own per-image snapc the same way), so
+    snapshots of one image never affect another image's writes."""
 
     def __init__(self, ioctx: IoCtx, header: ImageHeader):
         self.ioctx = ioctx
+        self._io = IoCtx(ioctx.objecter, ioctx.pool_id)
         self.header = header
         self._fmt = f"rbd_data.{header.name}.%016x"
+        self._parent: Optional["Image"] = None
+        # per-object copy-up serialization (librbd CopyupRequest holds the
+        # object context lock): without it, a second concurrent writer's
+        # copy-up write_full could land AFTER the first writer's partial
+        # write and clobber its acknowledged bytes with parent data
+        self._copyup_locks: Dict[int, asyncio.Lock] = {}
+        self._apply_snapc()
+
+    def _apply_snapc(self) -> None:
+        sids = sorted(self.header.snaps.values(), reverse=True)
+        if sids:
+            self._io.set_snap_context(sids[0], sids)
+        else:
+            self._io._snapc = None
+
+    async def _get_parent(self) -> Optional["Image"]:
+        if self.header.parent is None:
+            return None
+        if self._parent is None:
+            pname, _ = self.header.parent
+            self._parent = await RBD(self.ioctx).open(pname)
+        return self._parent
 
     # -- metadata -----------------------------------------------------------
 
@@ -115,28 +168,38 @@ class Image:
             if tail_end > new_size:
                 zeros = b"\0" * (tail_end - new_size)
                 await self.write(new_size, zeros, _size_check=old)
-            # drop every object of fully-dead sets
+            # drop every object of fully-dead sets (through the snapc io:
+            # a snapshotted image's shrink must clone-on-write, so snaps
+            # keep reading the pre-shrink bytes)
             for objno in range(live_sets * layout.stripe_count,
                                old_sets * layout.stripe_count):
                 try:
-                    await self.ioctx.remove(self._fmt % objno)
+                    await self._io.remove(self._fmt % objno)
                 except (IOError, FileNotFoundError):
                     pass
         self.header.size = new_size
         await self._save_header()
 
     async def snap_create(self, snap_name: str) -> int:
-        """Metadata-level snapshot id (SnapContext bookkeeping analog;
-        data cloning is future work)."""
-        sid = self.header.next_snap_id
-        self.header.next_snap_id += 1
+        """Point-in-time snapshot (reference librbd snap_create:
+        selfmanaged RADOS snap id + SnapContext on subsequent writes, so
+        the OSD clone-on-writes every later mutation)."""
+        if snap_name in self.header.snaps:
+            raise FileExistsError(snap_name)
+        sid = await self._io.selfmanaged_snap_create()
         self.header.snaps[snap_name] = sid
+        self.header.snap_sizes[sid] = self.header.size
+        self._apply_snapc()
         await self._save_header()
         return sid
 
     async def snap_remove(self, snap_name: str) -> None:
-        del self.header.snaps[snap_name]
+        """Drops the snap and lets the OSD trimmer reclaim its clones."""
+        sid = self.header.snaps.pop(snap_name)
+        self.header.snap_sizes.pop(sid, None)
+        self._apply_snapc()
         await self._save_header()
+        await self._io.selfmanaged_snap_remove(sid)
 
     def snap_list(self) -> Dict[str, int]:
         return dict(self.header.snaps)
@@ -151,14 +214,52 @@ class Image:
         extents = file_to_extents(self._fmt, self.header.layout,
                                   offset, len(data))
         per_object = StripedReader.scatter(extents, data)
+        if self.header.parent is not None:
+            # COW copy-up (librbd CopyupRequest): a partial write to an
+            # object the child has never written must first materialize
+            # the parent snap's bytes, or the untouched part of the
+            # object would read back as zeros
+            objno_of = {ex.oid: ex.objectno for ex in extents}
+            await asyncio.gather(*[
+                self._copyup(oid, objno_of[oid]) for oid in per_object])
         # per-object writes run concurrently; each is an atomic OSD op
         await asyncio.gather(*[
-            self.ioctx.write(oid, blob, offset=obj_off)
+            self._io.write(oid, blob, offset=obj_off)
             for oid, parts in per_object.items()
             for obj_off, blob in parts])
 
-    async def read(self, offset: int, length: int) -> bytes:
-        length = min(length, max(0, self.header.size - offset))
+    async def _copyup(self, oid: str, objno: int) -> None:
+        lock = self._copyup_locks.setdefault(objno, asyncio.Lock())
+        async with lock:
+            try:
+                await self._io.stat(oid)
+                return  # child already has this object
+            except FileNotFoundError:
+                pass
+            parent = await self._get_parent()
+            if parent is None:
+                return
+            _, psid = self.header.parent
+            try:
+                pdata = await parent._io.read(parent._fmt % objno,
+                                              snapid=psid)
+            except FileNotFoundError:
+                return  # parent sparse here too
+            if pdata:
+                await self._io.write_full(oid, pdata)
+
+    async def read(self, offset: int, length: int,
+                   snap_name: str = None) -> bytes:
+        """Point-in-time read when ``snap_name`` is given (reference
+        librbd snap_set + read: each object read resolves to the clone
+        covering the snap at the OSD); unwritten extents of a cloned
+        child fall through to the parent snap."""
+        snapid = None
+        size = self.header.size
+        if snap_name is not None:
+            snapid = self.header.snaps[snap_name]
+            size = self.header.snap_sizes.get(snapid, size)
+        length = min(length, max(0, size - offset))
         if length == 0:
             return b""
         extents = file_to_extents(self._fmt, self.header.layout,
@@ -166,10 +267,21 @@ class Image:
 
         async def fetch(ex):
             try:
-                return ex.oid, await self.ioctx.read(
-                    ex.oid, offset=ex.offset, length=ex.length)
+                return ex.oid, await self._io.read(
+                    ex.oid, offset=ex.offset, length=ex.length,
+                    snapid=snapid)
             except FileNotFoundError:
-                return ex.oid, b""  # sparse: never written
+                pass
+            parent = await self._get_parent()
+            if parent is not None:
+                _, psid = self.header.parent
+                try:
+                    return ex.oid, await parent._io.read(
+                        parent._fmt % ex.objectno, offset=ex.offset,
+                        length=ex.length, snapid=psid)
+                except FileNotFoundError:
+                    pass
+            return ex.oid, b""  # sparse: never written
 
         got = dict(await asyncio.gather(*[fetch(ex) for ex in extents]))
         return StripedReader.assemble(extents, got, length, relative=True)
@@ -181,7 +293,7 @@ class Image:
         n_objs = n_sets * layout.stripe_count
         for objno in range(n_objs):
             try:
-                await self.ioctx.remove(self._fmt % objno)
+                await self._io.remove(self._fmt % objno)
             except (IOError, FileNotFoundError):
                 pass
 

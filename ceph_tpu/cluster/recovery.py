@@ -136,13 +136,23 @@ class RecoveryMixin:
                         Transaction().remove(coll, oid))
                 else:
                     to_pull.append(oid)
+        from ceph_tpu.cluster import snaps as snapmod
+
         ok = True
         for oid in to_pull:
-            if pool.is_erasure():
+            if pool.is_erasure() and not oid.endswith(snapmod._SNAPDIR):
                 ok &= await self._recover_ec_object(
                     pool, st, oid, targets=[self.osd_id])
             else:
+                # snapdir metadata objects pull as plain copies even on
+                # EC pools (identical on every member)
                 ok &= await self._pull_rep_object(st, auth, oid)
+            if not snapmod.is_snap_key(oid):
+                # a delta-synced head may imply clone/snapset changes that
+                # have no log entries of their own (COW writes, trims);
+                # a FAILED snap pull must block adoption of the
+                # authoritative log exactly like a failed head pull
+                ok &= await self._pull_snap_state(pool, st, auth, oid)
         if not ok:
             # a pull failed (auth unreachable mid-recovery): do NOT claim
             # the authoritative version — stay stale so the next peering
@@ -157,21 +167,65 @@ class RecoveryMixin:
             max(st.last_update, auth_log.tail)
         self._save_pg_meta(st)
 
+    async def _pull_snap_state(self, pool: PGPool, st: PGState, auth: int,
+                               head: str) -> bool:
+        """Pull one head's snapshot state from the authoritative member:
+        its snapdir SnapSet, any clone objects we lack, and prune clones
+        the set no longer lists (missed trims).  Returns False on a pull
+        FAILURE (auth unreachable) — the caller must then refuse to adopt
+        the authoritative log; "auth has no snap state" is success."""
+        from ceph_tpu.cluster import snaps as snapmod
+
+        coll = _coll(st.pgid)
+        sd = snapmod.snapdir_oid(head)
+        status = await self._pull_rep_object_st(st, auth, sd)
+        if status == "enoent":
+            return True  # no snap state upstream (the common case)
+        if status != "ok":
+            return False
+        blob = self.store.getattr(coll, sd, "ss")
+        if blob is None:
+            return True
+        ss = snapmod.SnapSet.decode(blob)
+        ok = True
+        for c in ss.clones:
+            cname = snapmod.clone_oid(head, c)
+            if self.store.stat(coll, cname) is not None:
+                continue
+            if pool.is_erasure():
+                ok &= await self._recover_ec_object(pool, st, cname,
+                                                    targets=[self.osd_id])
+            else:
+                ok &= await self._pull_rep_object(st, auth, cname)
+        txn = Transaction()
+        txn.ops.extend(snapmod.prune_clone_ops(self.store, coll, head, ss))
+        if txn.ops:
+            self.store.queue_transaction(txn)
+        return ok
+
     async def _backfill_member(self, pool: PGPool, st: PGState, osd: int,
                                inventory: Dict[str, int]) -> None:
         """Full-inventory resync for a member behind the log tail
         (reference Backfilling state)."""
+        from ceph_tpu.cluster import snaps as snapmod
+
         for oid in self._list_pg_objects(st.pgid):
             ver = self.store.get_version(_coll(st.pgid), oid)
             if inventory.get(oid, -1) >= ver:
                 continue
-            if pool.is_erasure():
+            # snapdir objects are pure metadata (identical on every
+            # member, EC pools included): push data+xattrs directly;
+            # everything else on an EC pool (clones included) is a real
+            # EC object whose member shard gets reconstructed
+            if pool.is_erasure() and not oid.endswith(snapmod._SNAPDIR):
                 await self._recover_ec_object(pool, st, oid, targets=[osd])
             else:
                 data = self.store.read(_coll(st.pgid), oid)
                 try:
                     await self._send_osd(osd, M.MOSDPGPush(
-                        pgid=st.pgid, oid=oid, data=data, version=ver))
+                        pgid=st.pgid, oid=oid, data=data,
+                        xattrs=self.store.get_xattrs(_coll(st.pgid), oid),
+                        version=ver))
                     self.perf.inc("osd_pushes_sent")
                 except ConnectionError:
                     pass

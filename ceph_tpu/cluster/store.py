@@ -49,6 +49,12 @@ class Transaction:
         self.ops.append(("remove", coll, oid))
         return self
 
+    def clone(self, coll: str, src: str, dst: str):
+        """Full-object copy (data + xattrs + omap), the COW primitive of
+        the snapshot axis (reference ObjectStore::Transaction::clone)."""
+        self.ops.append(("clone", coll, src, dst))
+        return self
+
     def setattr(self, coll: str, oid: str, name: str, value: bytes):
         self.ops.append(("setattr", coll, oid, name, bytes(value)))
         return self
@@ -137,6 +143,13 @@ class MemStore(ObjectStore):
             o.version += 1
         elif kind == "remove":
             self._coll(op[1]).pop(op[2], None)
+        elif kind == "clone":
+            _, coll, src, dst = op
+            s = self._coll(coll).get(src)
+            if s is not None:
+                self._coll(coll)[dst] = Obj(
+                    data=bytearray(s.data), xattrs=dict(s.xattrs),
+                    omap=dict(s.omap), version=s.version)
         elif kind == "setattr":
             _, coll, oid, name, value = op
             self._coll(coll).setdefault(oid, Obj()).xattrs[name] = value

@@ -65,6 +65,13 @@ class PGPool:
     hashpspool: bool = True
     ec_profile: Dict[str, str] = field(default_factory=dict)
     name: str = ""
+    # snapshot state (reference pg_pool_t snap fields): snap_seq is the
+    # pool-wide snap id allocator; snaps maps POOL snap ids to names
+    # (selfmanaged snaps draw ids from the same allocator but are tracked
+    # by the client, e.g. RBD); removed_snaps drive OSD snap trimming
+    snap_seq: int = 0
+    snaps: Dict[int, str] = field(default_factory=dict)
+    removed_snaps: Tuple[int, ...] = ()
 
     @property
     def pg_num_mask(self) -> int:
@@ -73,6 +80,12 @@ class PGPool:
     @property
     def pgp_num_mask(self) -> int:
         return _calc_mask(self.pgp_num)
+
+    def snap_context(self) -> Tuple[int, Tuple[int, ...]]:
+        """(seq, existent POOL snaps descending) — the SnapContext writes
+        carry by default on a pool-snapshotted pool."""
+        return (self.snap_seq,
+                tuple(sorted(self.snaps.keys(), reverse=True)))
 
     def can_shift_osds(self) -> bool:
         return self.type == POOL_TYPE_REPLICATED

@@ -1,0 +1,143 @@
+"""RBD real snapshots + clone/copy-up (round-4: the snapshot axis wired
+through librbd's surface).
+
+Reference: librbd snap_create (selfmanaged RADOS snaps + SnapContext),
+snap_set + point-in-time reads, librbd::CloneRequest (COW children) and
+CopyupRequest (partial child write materializes the parent object)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.cluster.rbd import RBD
+from ceph_tpu.cluster.vstart import start_cluster
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_rbd_snapshot_point_in_time_read():
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("rbds", "replicated",
+                                            pg_num=8, size=2)
+            rbd = RBD(client.ioctx(pool))
+            await rbd.create("img", size=1 << 20, stripe_unit=4096,
+                             stripe_count=2, object_size=16384)
+            img = await rbd.open("img")
+            v1 = bytes(range(256)) * 256            # 64 KiB
+            await img.write(8192, v1)
+            sid = await img.snap_create("s1")
+            assert img.snap_list() == {"s1": sid}
+            # overwrite part of the snapped range
+            await img.write(12000, b"Y" * 30000)
+            head = await img.read(8192, len(v1))
+            assert head[12000 - 8192:12000 - 8192 + 30000] == b"Y" * 30000
+            # the snap still reads the ORIGINAL bytes
+            assert await img.read(8192, len(v1), snap_name="s1") == v1
+            # a write AFTER the snap to a previously untouched region
+            # must not appear in the snap
+            await img.write(200000, b"Z" * 5000)
+            assert await img.read(200000, 5000, snap_name="s1") == \
+                b"\0" * 5000
+            assert await img.read(200000, 5000) == b"Z" * 5000
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_rbd_snapshot_on_ec_pool():
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create(
+                "rbdecs", "erasure", pg_num=8,
+                ec_profile={"plugin": "jerasure",
+                            "technique": "reed_sol_van",
+                            "k": "2", "m": "1"})
+            rbd = RBD(client.ioctx(pool))
+            await rbd.create("eimg", size=1 << 20, stripe_unit=8192,
+                             stripe_count=1, object_size=32768)
+            img = await rbd.open("eimg")
+            v1 = b"ec-snap-payload!" * 2048          # 32 KiB
+            await img.write(0, v1)
+            await img.snap_create("es1")
+            await img.write(0, b"N" * len(v1))
+            assert await img.read(0, len(v1)) == b"N" * len(v1)
+            assert await img.read(0, len(v1), snap_name="es1") == v1
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_rbd_clone_and_copyup():
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("rbdc", "replicated",
+                                            pg_num=8, size=2)
+            rbd = RBD(client.ioctx(pool))
+            await rbd.create("parent", size=1 << 20, stripe_unit=4096,
+                             stripe_count=1, object_size=16384)
+            parent = await rbd.open("parent")
+            base = bytes(range(256)) * 128           # 32 KiB
+            await parent.write(0, base)
+            await parent.snap_create("gold")
+            # parent diverges after the snap
+            await parent.write(0, b"P" * 1000)
+
+            await rbd.clone("parent", "gold", "child")
+            child = await rbd.open("child")
+            assert child.size() == 1 << 20
+            # child reads fall through to the parent SNAP (not its head)
+            assert await child.read(0, len(base)) == base
+            # partial child write triggers copy-up: the rest of that
+            # object must still show parent-snap bytes, not zeros
+            await child.write(100, b"c" * 50)
+            got = await child.read(0, 16384)
+            expect = bytearray(base[:16384])
+            expect[100:150] = b"c" * 50
+            assert got == bytes(expect)
+            # the parent snap and head are untouched by child writes
+            assert await parent.read(0, 150, snap_name="gold") == base[:150]
+            assert (await parent.read(0, 1000)) == b"P" * 1000
+            # writes beyond parent data stay child-local
+            await child.write(500000, b"only-child" * 10)
+            assert await child.read(500000, 100) == b"only-child" * 10
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_rbd_snap_remove_triggers_trim():
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("rbdt", "replicated",
+                                            pg_num=8, size=2)
+            rbd = RBD(client.ioctx(pool))
+            await rbd.create("timg", size=1 << 20)
+            img = await rbd.open("timg")
+            await img.write(0, b"A" * 4096)
+            await img.snap_create("t1")
+            await img.write(0, b"B" * 4096)
+            assert await img.read(0, 4096, snap_name="t1") == b"A" * 4096
+            await img.snap_remove("t1")
+            assert img.snap_list() == {}
+            with pytest.raises(KeyError):
+                await img.read(0, 10, snap_name="t1")
+            # head unaffected
+            assert await img.read(0, 4096) == b"B" * 4096
+        finally:
+            await cluster.stop()
+
+    run(scenario())

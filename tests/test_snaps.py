@@ -1,0 +1,226 @@
+"""Object snapshots & clones, end to end (round-4 item 1).
+
+Reference seams: SnapContext (src/common/snap_types.h:41), SnapSet
+(src/osd/osd_types.h:4431), clone-on-write in
+PrimaryLogPG::make_writeable (src/osd/PrimaryLogPG.cc:7019), snap-read
+resolution in find_object_context, snap trimming
+(PrimaryLogPG::SnapTrimmer), and the librados snap API
+(rados_ioctx_snap_create / selfmanaged twins / snap_set_read).
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.cluster.snaps import (
+    SnapContext,
+    SnapSet,
+    clone_oid,
+    is_snap_key,
+)
+from ceph_tpu.cluster.vstart import start_cluster
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+EC_PROFILE = {"plugin": "jerasure", "technique": "reed_sol_van",
+              "k": "2", "m": "1"}
+
+
+# ---------------------------------------------------------------- unit tier
+
+def test_snapset_clone_decision_and_resolution():
+    ss = SnapSet()
+    # snap 1 exists, object written under seq=1 -> clone of pre-write head
+    snapc = SnapContext(seq=1, snaps=(1,))
+    assert ss.needs_clone(snapc, head_exists=True)
+    cid = ss.add_clone(snapc, head_size=10)
+    assert cid == 1 and ss.seq == 1
+    # snap 1 reads the clone; snap 2 (taken later, no writes) the head
+    assert ss.resolve_read(1, head_exists=True) == ("clone", 1)
+    assert ss.resolve_read(2, head_exists=True) == ("head", None)
+    assert ss.resolve_read(None, head_exists=True) == ("head", None)
+    # head deleted: snap 1 still resolves, HEAD/2 do not
+    assert ss.resolve_read(1, head_exists=False) == ("clone", 1)
+    assert ss.resolve_read(2, head_exists=False) == ("enoent", None)
+    assert ss.resolve_read(None, head_exists=False) == ("enoent", None)
+
+
+def test_snapset_trim():
+    ss = SnapSet()
+    ss.add_clone(SnapContext(seq=1, snaps=(1,)), 10)
+    ss.add_clone(SnapContext(seq=3, snaps=(3, 2, 1)), 20)
+    v = ss.version
+    assert v >= 2                        # every mutation stamps a version
+    dead, dirty = ss.trim({2})
+    assert dirty and dead == []          # clone 3 still serves snap 3
+    assert ss.version > v                # trims must bump it too (the
+    v = ss.version                       # backfill gate keys off it)
+    dead, dirty = ss.trim({1})
+    assert dead == [1]                   # clone 1 served only snap 1
+    dead, dirty = ss.trim({3})
+    assert dead == [3]
+    assert ss.clones == []
+    assert ss.version > v
+
+
+def test_snap_key_naming():
+    assert is_snap_key(clone_oid("obj", 5))
+    assert not is_snap_key("obj")
+    assert not is_snap_key("obj@5")      # client oids with @ are fine
+
+
+# ------------------------------------------------------------- cluster tier
+
+def test_pool_snap_write_snap_overwrite_read_back_replicated():
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("rsnap", "replicated",
+                                            pg_num=8, size=3)
+            io = client.ioctx(pool)
+            v1 = b"version-one" * 50
+            v2 = b"VERSION-TWO!" * 77
+            await io.write_full("obj", v1)
+            sid = await io.snap_create("s1")
+            await io.write_full("obj", v2)
+            assert await io.read("obj") == v2
+            assert await io.read("obj", snapid=sid) == v1
+            # a second snap with no intervening write sees the head data
+            sid2 = await io.snap_create("s2")
+            assert await io.read("obj", snapid=sid2) == v2
+            # snap_list + lookup
+            assert io.snap_lookup("s1") == sid
+            assert set(io.snap_list().values()) == {"s1", "s2"}
+            # clones never leak into listings
+            assert await io.list_objects() == ["obj"]
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_selfmanaged_snap_ec_pool_byte_exact():
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("ecsnap", "erasure",
+                                            pg_num=8,
+                                            ec_profile=dict(EC_PROFILE))
+            io = client.ioctx(pool)
+            v1 = bytes(range(256)) * 40          # 10240 bytes
+            v2 = bytes(reversed(range(256))) * 60
+            await io.write_full("eobj", v1)
+            sid = await io.selfmanaged_snap_create()
+            io.set_snap_context(sid, [sid])
+            await io.write_full("eobj", v2)
+            assert await io.read("eobj") == v2
+            assert await io.read("eobj", snapid=sid) == v1
+            # partial overwrite (RMW path) after a second snap
+            sid2 = await io.selfmanaged_snap_create()
+            io.set_snap_context(sid2, [sid2, sid])
+            await io.write("eobj", b"X" * 1000, offset=500)
+            at2 = await io.read("eobj", snapid=sid2)
+            assert at2 == v2
+            head = await io.read("eobj")
+            assert head[500:1500] == b"X" * 1000
+            assert head[:500] == v2[:500]
+            assert await io.read("eobj", snapid=sid) == v1
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_delete_after_snap_keeps_snap_readable():
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("dsnap", "replicated",
+                                            pg_num=8, size=2)
+            io = client.ioctx(pool)
+            payload = b"preserve-me" * 30
+            await io.write_full("victim", payload)
+            sid = await io.snap_create("keep")
+            await io.remove("victim")
+            with pytest.raises(FileNotFoundError):
+                await io.read("victim")
+            assert await io.read("victim", snapid=sid) == payload
+            with pytest.raises(FileNotFoundError):
+                await io.stat("victim")
+            assert await io.stat("victim", snapid=sid) == len(payload)
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_snap_trim_removes_clone_objects():
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("tsnap", "replicated",
+                                            pg_num=8, size=2)
+            io = client.ioctx(pool)
+            await io.write_full("obj", b"old")
+            sid = await io.snap_create("s1")
+            await io.write_full("obj", b"new")
+            assert await io.read("obj", snapid=sid) == b"old"
+            pgid = client.objecter.object_pgid(pool, "obj")
+            coll = f"pg_{pgid.pool}_{pgid.seed}"
+            cname = clone_oid("obj", sid)
+            _, _, acting, _ = \
+                client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+            assert all(cluster.osds[o].store.stat(coll, cname) is not None
+                       for o in acting), "clone object missing pre-trim"
+            await io.snap_remove("s1")
+            # trimmer runs off the map-update path on every member
+            for _ in range(100):
+                if all(cluster.osds[o].store.stat(coll, cname) is None
+                       for o in acting):
+                    break
+                await asyncio.sleep(0.1)
+            assert all(cluster.osds[o].store.stat(coll, cname) is None
+                       for o in acting), "trim left clone objects behind"
+            with pytest.raises(FileNotFoundError):
+                await io.read("obj", snapid=sid)
+            assert await io.read("obj") == b"new"
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_ec_snap_survives_shard_loss():
+    """Snap reads ride the same decode path as head reads: kill one OSD
+    and the clone must still reconstruct."""
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("ecs2", "erasure",
+                                            pg_num=4,
+                                            ec_profile=dict(EC_PROFILE))
+            io = client.ioctx(pool)
+            v1 = b"snapdata" * 512
+            await io.write_full("hot", v1)
+            sid = await io.selfmanaged_snap_create()
+            io.set_snap_context(sid, [sid])
+            await io.write_full("hot", b"headdata" * 700)
+            pgid = client.objecter.object_pgid(pool, "hot")
+            _, _, acting, primary = \
+                client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+            victim = next(o for o in acting if o != primary)
+            await cluster.osds[victim].stop()
+            got = await io.read("hot", snapid=sid, timeout=60)
+            assert got == v1
+        finally:
+            await cluster.stop()
+
+    run(scenario())

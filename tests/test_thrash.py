@@ -101,3 +101,62 @@ def test_thrash_osds_replicated():
             await cluster.stop()
 
     run(scenario())
+
+
+def test_thrash_osds_with_snapshots():
+    """Thrash with pool snapshots in the mix (round-4 item 1 gate): after
+    bounces + recovery, every snap reads back the contents recorded at
+    snap time and heads read their last-acknowledged data."""
+    async def scenario():
+        rng = random.Random(7)
+        cfg = _fast_config()
+        cfg.mon_osd_down_out_interval = 60.0
+        cluster = await start_cluster(5, config=cfg)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("sthrash", "replicated",
+                                            pg_num=8, size=3)
+            io = client.ioctx(pool)
+            acked = {}
+            snap_expect = {}   # (snapid) -> {oid: bytes at snap time}
+
+            async def put(i, gen):
+                oid = f"obj{i}"
+                data = f"snapgen{gen}-{i}-".encode() * 50
+                try:
+                    await io.write_full(oid, data, timeout=60)
+                    acked[oid] = data
+                except (IOError, OSError, TimeoutError):
+                    pass
+
+            for round_no in range(3):
+                for i in range(5):
+                    await put(i, round_no)
+                sid = await io.snap_create(f"s{round_no}")
+                snap_expect[sid] = dict(acked)
+                victim = rng.choice(list(cluster.osds))
+                stopped = cluster.osds.pop(victim)
+                store = stopped.store
+                await stopped.stop()
+                for i in range(5):
+                    await put(i, round_no + 100)  # overwrite under snapc
+                osd = OSDDaemon(victim, cluster.mon_addr, config=cfg,
+                                store=store)
+                await osd.start()
+                cluster.osds[victim] = osd
+                deadline = asyncio.get_event_loop().time() + 20
+                while asyncio.get_event_loop().time() < deadline:
+                    if cluster.mon.osdmap.osd_up[victim]:
+                        break
+                    await asyncio.sleep(0.05)
+
+            for oid, data in sorted(acked.items()):
+                assert await io.read(oid, timeout=60) == data, oid
+            for sid, objs in snap_expect.items():
+                for oid, data in sorted(objs.items()):
+                    got = await io.read(oid, snapid=sid, timeout=60)
+                    assert got == data, (oid, sid)
+        finally:
+            await cluster.stop()
+
+    run(scenario())
