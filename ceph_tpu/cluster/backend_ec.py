@@ -12,7 +12,7 @@ from ceph_tpu.cluster import messages as M
 from ceph_tpu.cluster.messenger import Connection
 from ceph_tpu.cluster.pglog import LogEntry
 from ceph_tpu.crush.types import CRUSH_ITEM_NONE
-from ceph_tpu.cluster.pg import PGState, _coll
+from ceph_tpu.cluster.pg import PGRB, PGState, _coll
 from ceph_tpu.cluster.store import Transaction
 from ceph_tpu.ops import crc32c as crcmod
 from ceph_tpu.osdmap.osdmap import PGid, PGPool
@@ -29,6 +29,13 @@ class ECBackendMixin:
                 "plugin": "jerasure", "technique": "reed_sol_van",
                 "k": "2", "m": "1"}
             codec = factory(profile)
+            if self.config.osd_ec_mesh == "on":
+                # route the pool's batch encode/decode over the device
+                # mesh (parallel/engine.py) — the multi-chip data plane
+                from ceph_tpu.parallel.engine import wrap_codec_for_mesh
+
+                codec = wrap_codec_for_mesh(
+                    codec, self.config.osd_ec_mesh_devices)
             self._codecs[pool.pool_id] = codec
         return codec
 
@@ -129,6 +136,8 @@ class ECBackendMixin:
                 return -110
             finally:
                 self._pending.pop(reqid, None)
+        # every shard acked: this version can never roll back now
+        self._advance_last_complete(st, eversion)
         return 0
 
     def _apply_shard(self, pgid: PGid, oid: str, shard: int, data: bytes,
@@ -167,6 +176,22 @@ class ECBackendMixin:
             # snapshot pre-ops (shard-local COW clone + snapset) must land
             # in the same transaction, BEFORE the new bytes
             txn.ops.extend(tuple(op) for op in pre_ops)
+        # rollback record (ecbackend.rst:10-27): the exact pre-write state
+        # of the touched shard range, so peering can REWIND this entry if
+        # the write never completes cluster-wide; pruned at commit
+        existed = old_size is not None
+        rec = {
+            "oid": oid, "existed": existed, "chunk_off": chunk_off,
+            "old_range": (bytes(self.store.read(coll, oid, chunk_off,
+                                                len(data)))
+                          if existed else b""),
+            "old_total": old_size or 0,
+            "old_attrs": {k: self.store.getattr(coll, oid, k)
+                          for k in ("shard", "size", "hinfo_crc")},
+            "old_version": self.store.get_version(coll, oid),
+        }
+        txn.omap_set(coll, PGRB,
+                     {self._rb_key(hinfo["version"]): pickle.dumps(rec)})
         txn.write(coll, oid, chunk_off, data) \
            .truncate(coll, oid, shard_size) \
            .setattr(coll, oid, "shard", str(shard).encode()) \
@@ -204,12 +229,14 @@ class ECBackendMixin:
             shard_attr = self.store.getattr(_coll(msg.pgid), msg.oid, "shard")
             shard = int(shard_attr) if shard_attr else msg.shard
             size = self.store.getattr(_coll(msg.pgid), msg.oid, "size")
-            hinfo = {"size": int(size) if size else 0}
+            hinfo = {"size": int(size) if size else 0,
+                     # version on EVERY reply: the gatherer groups shards
+                     # by generation before decoding (stale-member guard)
+                     "version": self.store.get_version(
+                         _coll(msg.pgid), msg.oid)}
             if msg.shard == -1:
-                # whole-object fetch (pull recovery): carry version +
-                # xattrs so the puller stores a faithful copy
-                hinfo["version"] = self.store.get_version(
-                    _coll(msg.pgid), msg.oid)
+                # whole-object fetch (pull recovery): carry xattrs so the
+                # puller stores a faithful copy
                 o = self.store._colls.get(_coll(msg.pgid), {}).get(msg.oid)
                 hinfo["xattrs"] = dict(o.xattrs) if o else {}
             await conn.send(M.MOSDECSubOpReadReply(
@@ -230,20 +257,26 @@ class ECBackendMixin:
         never be decode sources (scrub repair would otherwise reconstruct
         FROM the corruption and bless it)."""
         exclude_shards = exclude_shards or set()
-        shards: Dict[int, bytes] = {}
-        size = 0
+        # (shard -> (bytes, version, size)): versions gate which shards
+        # may decode together — a stale rejoined member's shard from an
+        # older generation mixed with current shards would decode to
+        # garbage (the reference compares per-shard object_info versions
+        # when gathering, ECBackend::handle_sub_read_reply)
+        got: Dict[int, Tuple[bytes, int, int]] = {}
         my = self.store.stat(_coll(st.pgid), oid)
         if my is not None:
             data = self.store.read(_coll(st.pgid), oid, off, length)
             shard_attr = self.store.getattr(_coll(st.pgid), oid, "shard")
             if shard_attr is not None and                     int(shard_attr) not in exclude_shards:
-                shards[int(shard_attr)] = data
-            sa = self.store.getattr(_coll(st.pgid), oid, "size")
-            size = int(sa) if sa else 0
+                sa = self.store.getattr(_coll(st.pgid), oid, "size")
+                got[int(shard_attr)] = (
+                    data,
+                    self.store.get_version(_coll(st.pgid), oid),
+                    int(sa) if sa else 0)
         peers = [(shard, osd) for shard, osd in enumerate(st.acting)
                  if osd not in (self.osd_id, CRUSH_ITEM_NONE)
-                 and shard not in shards and shard not in exclude_shards]
-        if peers and len(shards) < need_k:
+                 and shard not in got and shard not in exclude_shards]
+        if peers and len(got) < need_k:
             reqid = self._next_reqid()
             fut = self._make_waiter(reqid, len(peers))
             for shard, osd in peers:
@@ -265,9 +298,36 @@ class ECBackendMixin:
                 self._pending.pop(reqid, None)
             for result, reply in acc:
                 if result == 0 and reply is not None:
-                    shards[reply.shard] = reply.data
-                    if reply.hinfo.get("size"):
-                        size = reply.hinfo["size"]
+                    got[reply.shard] = (
+                        reply.data,
+                        reply.hinfo.get("version", 0),
+                        reply.hinfo.get("size", 0))
+        # choose the shard group that decodes consistently: newest
+        # version first, but versions ABOVE the commit watermark are
+        # skipped when an older viable group exists — an un-acked write
+        # may still be rolled back by peering, and serving bytes that
+        # later vanish would break read-your-ack semantics (the reference
+        # compares object_info versions in handle_sub_read_reply and
+        # serves committed state)
+        committed_seq = st.last_complete[1]
+        shards: Dict[int, bytes] = {}
+        size = 0
+        versions = sorted({ver for _, ver, _ in got.values()}, reverse=True)
+        viable = []
+        for v in versions:
+            group = {s: d for s, (d, ver, _) in got.items() if ver == v}
+            if len(group) >= min(need_k, len(got)):
+                viable.append((v, group))
+        chosen = None
+        for v, group in viable:
+            if v <= committed_seq:
+                chosen = (v, group)
+                break
+        if chosen is None and viable:
+            chosen = viable[0]  # only un-acked state exists (new object)
+        if chosen is not None:
+            v, shards = chosen
+            size = max(sz for _, ver, sz in got.values() if ver == v)
         return shards, size
 
     async def _ec_read_stripes(self, pool: PGPool, st: PGState, oid: str,

@@ -105,6 +105,8 @@ class ReplicatedBackendMixin:
                 return -110
             finally:
                 self._pending.pop(reqid, None)
+        # all acting members acked: advance the never-roll-back watermark
+        self._advance_last_complete(st, version)
         return 0
 
     async def _op_delete(self, pool: PGPool, st: PGState, oid: str,
@@ -119,6 +121,13 @@ class ReplicatedBackendMixin:
         txn = Transaction()
         txn.ops.extend(self._cow_pre_ops(st, oid, snapc,
                                          erasure=pool.is_erasure()))
+        if pool.is_erasure():
+            # rollback record for the delete, captured MEMBER-LOCALLY by
+            # the store op (each member journals its own shard bytes) so
+            # an un-acked delete can rewind during peering
+            from ceph_tpu.cluster.pg import PGRB
+
+            txn.rb_capture(coll, oid, PGRB, self._rb_key(version[1]))
         txn.remove(coll, oid)
         return await self._replicate_txn(st, txn, "delete", oid, version)
 
@@ -200,6 +209,22 @@ class ReplicatedBackendMixin:
         except ConnectionError:
             pass
 
+    async def _repull_after_rewind(self, st: PGState, oids) -> None:
+        """Re-fetch objects a record-less rewind had to remove, from the
+        acting primary (the instruction sender)."""
+        pool = self.osdmap.pools.get(st.pgid.pool)
+        if pool is None:
+            return
+        for oid in oids:
+            try:
+                if pool.is_erasure():
+                    await self._recover_ec_object(pool, st, oid,
+                                                  targets=[self.osd_id])
+                elif st.primary >= 0 and st.primary != self.osd_id:
+                    await self._pull_rep_object(st, st.primary, oid)
+            except (ConnectionError, OSError, asyncio.TimeoutError):
+                self.perf.inc("osd_recovery_incomplete")
+
     def _has_snap_state(self, st: PGState, oid: str) -> bool:
         from ceph_tpu.cluster import snaps as snapmod
 
@@ -250,6 +275,26 @@ class ReplicatedBackendMixin:
             if st is not None:
                 st.last_update, st.log = pickle.loads(msg.data)
                 self._save_pg_meta(st)
+            return
+        if msg.op == "rewind":
+            # primary-instructed divergent-log rewind (PGLog.cc:287):
+            # undo our entries beyond the authoritative head from the
+            # local rollback journal.  Self-protection: never rewind
+            # below our own commit watermark — entries acked to clients
+            # are not rollbackable, whatever a (possibly stale) primary
+            # says
+            if st is not None:
+                target = pickle.loads(msg.data)
+                if st.last_update > target >= st.last_complete:
+                    need = self.rewind_divergent_log(st, target)
+                    if need:
+                        # fallback removals (lost records): re-pull the
+                        # authoritative copies off the dispatch path
+                        import asyncio as _aio
+
+                        _aio.get_event_loop().create_task(
+                            self._repull_after_rewind(st, list(need)))
+            self.perf.inc("osd_pushes_applied")
             return
         if msg.op == "snap_sync":
             # adopt the authoritative SnapSet; clones it no longer lists

@@ -453,6 +453,7 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                             Transaction().create_collection(_coll(pgid)))
                         st = PGState(pgid, up, acting, actp)
                         st.last_update, st.log = self._load_pg_meta(pgid)
+                        st.last_complete = self._load_last_complete(pgid)
                         self.pgs[pgid] = st
                     else:
                         if old.acting != acting:

@@ -23,6 +23,11 @@ from ceph_tpu.osdmap.osdmap import PGid
 # the per-PG metadata object holding the persisted log + last_update
 # (reference: the pgmeta ghobject, PG::_init / read_info)
 PGMETA = "_pgmeta_"
+# per-PG rollback journal: omap keyed by entry seq holding the local
+# pre-write state of EC shard mutations (reference: the rollback info
+# ECBackend attaches to local transactions,
+# doc/dev/osd_internals/erasure_coding/ecbackend.rst:10-27)
+PGRB = "_pgrb_"
 
 @dataclass
 class PGState:
@@ -33,6 +38,10 @@ class PGState:
     # pg_info_t analog: every mutation advances last_update and appends to
     # the log (reference PG.h pg_log)
     last_update: pglog.Eversion = pglog.ZERO
+    # newest version known acked by EVERY acting member (reference
+    # last_complete / min_last_complete_ondisk): entries above it may be
+    # rolled back during peering, entries at or below never are
+    last_complete: pglog.Eversion = pglog.ZERO
     log: PGLog = field(default_factory=PGLog)
     # per-PG op serialization domain (reference PG lock / ShardedOpWQ,
     # src/osd/OSD.h:1599): mutations hold this across their whole
@@ -51,7 +60,8 @@ class PGState:
         default_factory=dict)
 
     def info(self) -> PGInfo:
-        return PGInfo(last_update=self.last_update, log_tail=self.log.tail)
+        return PGInfo(last_update=self.last_update, log_tail=self.log.tail,
+                      last_complete=self.last_complete)
 
 
 @dataclass
@@ -99,7 +109,8 @@ class PGLogMixin:
             return None  # replayed/duplicate entry
         if entry is None:
             entry = LogEntry(op=op, oid=oid, version=version,
-                             prior_version=st.last_update)
+                             prior_version=st.last_update,
+                             committed=st.last_complete)
         st.log.append(entry)
         st.last_update = version
         dropped = st.log.trim()
@@ -112,8 +123,85 @@ class PGLogMixin:
         if dropped:
             txn.omap_rmkeys(coll, PGMETA,
                             [self._meta_key(e.version) for e in dropped])
+        # learn the primary's commit watermark from the entry stream and
+        # drop rollback records for entries that can no longer rewind
+        committed = getattr(entry, "committed", pglog.ZERO)
+        if committed > st.last_complete:
+            self._advance_last_complete(st, committed, txn)
         self.store.queue_transaction(txn)
         return entry
+
+    def _advance_last_complete(self, st: PGState, version: pglog.Eversion,
+                               txn: Optional[Transaction] = None) -> None:
+        """Raise the never-roll-back watermark and prune the rollback
+        journal up to it (rollback info exists only to undo UN-acked
+        entries, ecbackend.rst:10-27)."""
+        if version <= st.last_complete:
+            return
+        st.last_complete = version
+        coll = _coll(st.pgid)
+        own = txn is None
+        if own:
+            txn = Transaction()
+        txn.setattr(coll, PGMETA, "last_complete", pickle.dumps(version))
+        dead = [k for k in self.store.omap_get(coll, PGRB)
+                if int(k) <= version[1]]
+        if dead:
+            txn.omap_rmkeys(coll, PGRB, dead)
+        if own:
+            self.store.queue_transaction(txn)
+
+    @staticmethod
+    def _rb_key(seq: int) -> str:
+        return f"{seq:012d}"
+
+    def rewind_divergent_log(self, st: PGState,
+                             auth_head: pglog.Eversion) -> List[str]:
+        """Roll this member's log back to ``auth_head`` (reference
+        PGLog::rewind_divergent_log, PGLog.cc:287): undo each divergent
+        entry from its rollback record — restoring the EXACT pre-write
+        shard bytes/attrs — newest first.  Entries without a record
+        (replicated pools, lost records) fall back to removing the
+        object; the returned oid list names those, for the caller to
+        re-pull/push from the authoritative copy."""
+        coll = _coll(st.pgid)
+        rb = self.store.omap_get(coll, PGRB)
+        need_copy: List[str] = []
+        txn = Transaction()
+        divergent = [e for e in st.log.entries if e.version > auth_head]
+        for e in reversed(divergent):
+            rec_blob = rb.get(self._rb_key(e.version[1]))
+            if e.op == "trim":
+                # snap-trim rollback is a no-op: removed_snaps come from
+                # the osdmap, so the authoritative primary re-trims (the
+                # operation is idempotent) and snap_sync reconciles
+                pass
+            elif rec_blob is None:
+                txn.remove(coll, e.oid)
+                need_copy.append(e.oid)
+            else:
+                rec = pickle.loads(rec_blob)
+                if not rec["existed"]:
+                    txn.remove(coll, rec["oid"])
+                else:
+                    txn.write(coll, rec["oid"], rec["chunk_off"],
+                              rec["old_range"])
+                    txn.truncate(coll, rec["oid"], rec["old_total"])
+                    for name, val in rec["old_attrs"].items():
+                        if val is None:
+                            txn.rmattr(coll, rec["oid"], name)
+                        else:
+                            txn.setattr(coll, rec["oid"], name, val)
+                    txn.set_version(coll, rec["oid"], rec["old_version"])
+                txn.omap_rmkeys(coll, PGRB, [self._rb_key(e.version[1])])
+            txn.omap_rmkeys(coll, PGMETA, [self._meta_key(e.version)])
+            self.perf.inc("osd_log_rewinds")
+        st.log.entries = [e for e in st.log.entries
+                          if e.version <= auth_head]
+        st.last_update = auth_head
+        txn.setattr(coll, PGMETA, "last_update", pickle.dumps(auth_head))
+        self.store.queue_transaction(txn)
+        return need_copy
 
     def _save_pg_meta(self, st: PGState) -> None:
         """Full rewrite of the persisted log (recovery-time adoption of an
@@ -143,6 +231,13 @@ class PGLogMixin:
         entries = [e for e in entries if e.version > tail]
         return last_update, PGLog(tail=tail, entries=entries)
 
+    def _load_last_complete(self, pgid: PGid) -> pglog.Eversion:
+        blob = self.store.getattr(_coll(pgid), PGMETA, "last_complete")
+        return pickle.loads(blob) if blob else pglog.ZERO
+
     def _list_pg_objects(self, pgid: PGid) -> List[str]:
+        # PGMETA and the rollback journal are PG bookkeeping, and the
+        # journal is member-LOCAL (each shard's pre-write bytes differ) —
+        # neither may ever be listed, scrubbed, or backfilled as data
         return [o for o in self.store.list_objects(_coll(pgid))
-                if o != PGMETA]
+                if o not in (PGMETA, PGRB)]

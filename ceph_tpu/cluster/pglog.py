@@ -35,6 +35,10 @@ class LogEntry:
     oid: str
     version: Eversion
     prior_version: Eversion = ZERO
+    # primary's last_complete at append time: replicas learn the commit
+    # watermark from the entry stream and prune their rollback journal
+    # up to it (reference min_last_complete_ondisk piggybacking)
+    committed: Eversion = ZERO
 
 
 @dataclass
@@ -90,10 +94,28 @@ class PGInfo:
 
     last_update: Eversion = ZERO
     log_tail: Eversion = ZERO
+    last_complete: Eversion = ZERO
 
 
-def choose_authoritative(infos: Dict[int, PGInfo]) -> int:
-    """The member with the newest last_update owns the authoritative log
-    (reference PG::choose_acting / find_best_info: max last_update, ties
-    broken by lowest osd id for determinism)."""
-    return min(infos, key=lambda o: (tuple(-x for x in infos[o].last_update), o))
+def choose_authoritative(infos: Dict[int, PGInfo],
+                         require_rollback: bool = False) -> int:
+    """Authoritative-log election (reference find_best_info).
+
+    Replicated pools: max last_update wins (a write present anywhere may
+    have been acked; full-object pushes make roll-FORWARD cheap).
+
+    EC pools (``require_rollback``, the reference's pg_pool_t flag): the
+    MIN last_update among members at-or-above the global commit
+    watermark wins, so an un-acked partial-stripe write — applied on
+    some shards only, unreconstructable if fewer than k have it — is
+    ROLLED BACK rather than blessed.  Members below the watermark are
+    stale rejoiners, excluded so acked writes can never be rolled back
+    (the reference excludes them via last_epoch_started)."""
+    if not require_rollback:
+        return min(infos,
+                   key=lambda o: (tuple(-x for x in infos[o].last_update), o))
+    committed = max(i.last_complete for i in infos.values())
+    candidates = {o: i for o, i in infos.items()
+                  if i.last_update >= committed}
+    return min(candidates,
+               key=lambda o: (candidates[o].last_update, o))

@@ -84,7 +84,18 @@ class RecoveryMixin:
             logs[osd] = reply.log or PGLog()
             inventories[osd] = reply.objects or {}
 
-        auth = pglog.choose_authoritative(infos)
+        auth = pglog.choose_authoritative(
+            infos, require_rollback=pool.is_erasure())
+        auth_head = infos[auth].last_update
+        if pool.is_erasure() and st.last_update > auth_head:
+            # we hold entries the authoritative log rolls back: an
+            # un-acked partial-stripe write that not every shard applied
+            # (reference PGLog::rewind_divergent_log, PGLog.cc:287 +
+            # ecbackend.rst rollback).  Undo from our rollback journal.
+            need = self.rewind_divergent_log(st, auth_head)
+            for oid in need:  # record lost: re-pull the auth copy
+                await self._recover_ec_object(pool, st, oid,
+                                              targets=[self.osd_id])
         if auth != self.osd_id and \
                 infos[auth].last_update > st.last_update:
             await self._sync_self_from(
@@ -94,6 +105,21 @@ class RecoveryMixin:
             if osd not in infos:
                 continue
             peer_lu = infos[osd].last_update
+            if pool.is_erasure() and peer_lu > st.last_update and \
+                    st.last_update >= auth_head:
+                # divergent member: instruct it to rewind to our head
+                # (it holds a superset of our log, so after the rewind
+                # it is exactly current — nothing to push).  Guarded on
+                # US holding the authoritative head: a stale primary
+                # that failed to self-sync must never roll healthy
+                # replicas back to its own stale state
+                try:
+                    await self._send_osd(osd, M.MOSDPGPush(
+                        pgid=st.pgid, op="rewind",
+                        data=pickle.dumps(st.last_update)))
+                except ConnectionError:
+                    pass
+                continue
             if peer_lu >= st.last_update:
                 continue
             to_sync = st.log.objects_to_sync(peer_lu)

@@ -55,6 +55,16 @@ class Transaction:
         self.ops.append(("clone", coll, src, dst))
         return self
 
+    def rb_capture(self, coll: str, oid: str, rb_oid: str, key: str):
+        """Snapshot THIS store's current state of ``oid`` into the
+        rollback journal object's omap under ``key`` — evaluated locally
+        by each member so a fanned-out transaction captures each member's
+        OWN pre-op bytes (EC shards differ per member; the reference
+        attaches rollback info to the local transaction the same way,
+        ecbackend.rst:10-27)."""
+        self.ops.append(("rb_capture", coll, oid, rb_oid, key))
+        return self
+
     def setattr(self, coll: str, oid: str, name: str, value: bytes):
         self.ops.append(("setattr", coll, oid, name, bytes(value)))
         return self
@@ -150,6 +160,20 @@ class MemStore(ObjectStore):
                 self._coll(coll)[dst] = Obj(
                     data=bytearray(s.data), xattrs=dict(s.xattrs),
                     omap=dict(s.omap), version=s.version)
+        elif kind == "rb_capture":
+            _, coll, oid, rb_oid, key = op
+            o = self._coll(coll).get(oid)
+            rec = {
+                "oid": oid, "existed": o is not None, "chunk_off": 0,
+                "old_range": bytes(o.data) if o else b"",
+                "old_total": len(o.data) if o else 0,
+                "old_attrs": ({k: o.xattrs.get(k)
+                               for k in ("shard", "size", "hinfo_crc")}
+                              if o else {}),
+                "old_version": o.version if o else 0,
+            }
+            self._coll(coll).setdefault(rb_oid, Obj()).omap[key] = \
+                pickle.dumps(rec)
         elif kind == "setattr":
             _, coll, oid, name, value = op
             self._coll(coll).setdefault(oid, Obj()).xattrs[name] = value

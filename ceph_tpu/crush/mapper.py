@@ -546,14 +546,20 @@ class TensorMapper:
             else:
                 raise NotImplementedError(f"rule op {op}")
         return result, rlen
-    def do_rule_batch(self, ruleno: int, xs, result_max: int, weights):
-        """Map a batch of x values; returns (N, result_max) int32 with
-        CRUSH_ITEM_NONE padding, plus lengths, matching crush_do_rule."""
+    def compiled_rule(self, ruleno: int, result_max: int):
+        """Public seam for external dispatch harnesses (e.g. the mesh
+        shard-out in parallel/engine.py): the cached compiled rule fn
+        ``(xs, weights, tensors) -> (result, lens)`` plus the map tensor
+        args, sharing this mapper's compile cache."""
         key = (ruleno, result_max)
         if key not in self._compiled:
             self._compiled[key] = self._build_rule_fn(ruleno, result_max)
-        fn = self._compiled[key]
-        tensors = self._tensor_args()
+        return self._compiled[key], self._tensor_args()
+
+    def do_rule_batch(self, ruleno: int, xs, result_max: int, weights):
+        """Map a batch of x values; returns (N, result_max) int32 with
+        CRUSH_ITEM_NONE padding, plus lengths, matching crush_do_rule."""
+        fn, tensors = self.compiled_rule(ruleno, result_max)
         xs = jnp.asarray(xs, dtype=U32)
         weights = jnp.asarray(weights, dtype=U32)
         n = xs.shape[0]

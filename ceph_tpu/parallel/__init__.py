@@ -14,3 +14,7 @@ from ceph_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     distributed_ec_step,
 )
+from ceph_tpu.parallel.engine import (  # noqa: F401
+    MeshECEngine,
+    crush_batch_sharded,
+)

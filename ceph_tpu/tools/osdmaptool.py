@@ -20,9 +20,31 @@ def main(argv=None) -> int:
     ap.add_argument("--print", dest="do_print", action="store_true")
     ap.add_argument("--test-map-pgs", action="store_true")
     ap.add_argument("--pool", type=int, default=None)
+    ap.add_argument("--upmap", metavar="OUTFN", default=None,
+                    help="compute pg_upmap_items balancing PGs/OSD "
+                         "(calc_pg_upmaps, OSDMap.cc:3771) and write the "
+                         "rebalanced map")
+    ap.add_argument("--upmap-deviation", type=float, default=0.05)
+    ap.add_argument("--upmap-max", type=int, default=30)
     args = ap.parse_args(argv)
 
     m = pickle.loads(open(args.mapfn, "rb").read())
+    if args.upmap is not None:
+        from ceph_tpu.osdmap import balancer
+
+        pools = [args.pool] if args.pool is not None else None
+        before = balancer.pg_per_osd_stddev(m, pools)
+        changes = balancer.calc_pg_upmaps(
+            m, pools, max_deviation_ratio=args.upmap_deviation,
+            max_iterations=args.upmap_max)
+        after = balancer.pg_per_osd_stddev(m, pools)
+        for pgid, items in sorted(changes.items()):
+            pairs = " ".join(f"{a}->{b}" for a, b in items)
+            print(f"upmap {pgid.pool}.{pgid.seed} items {pairs}")
+        print(f"pgs-per-osd stddev {before:.2f} -> {after:.2f} "
+              f"({len(changes)} pg_upmap_items)")
+        with open(args.upmap, "wb") as f:
+            f.write(pickle.dumps(m))
     if args.do_print:
         print(f"epoch {m.epoch}")
         print(f"max_osd {m.max_osd}")

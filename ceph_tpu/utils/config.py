@@ -70,6 +70,12 @@ OPTIONS: List[Option] = [
     # ec
     Option("osd_ec_batch_size", int, 64, "stripes per device dispatch"),
     Option("osd_ec_stripe_unit", int, 4096),
+    # route EC pool batch encode/decode through the sharded mesh engine
+    # (parallel/engine.py): "on" = use a device mesh, "off" = the
+    # single-device codec engines.  ("on" needs >1 jax device; the mesh
+    # is the EC data plane the way NCCL fan-out is the reference's.)
+    Option("osd_ec_mesh", str, "off"),
+    Option("osd_ec_mesh_devices", int, 0),  # 0 = all visible devices
     # store
     Option("memstore_device_bytes", int, 1 << 30),
     Option("bluestore_csum_type", str, "crc32c"),

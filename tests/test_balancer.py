@@ -1,0 +1,82 @@
+"""Upmap balancer: calc_pg_upmaps (round-4 item 8).
+
+Reference: OSDMap::calc_pg_upmaps (src/osd/OSDMap.cc:3771) +
+try_pg_upmap (:3727) — iterative deviation-driven pg_upmap_items
+generation, validity-checked against the rule's failure domain.
+"""
+
+import pickle
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from ceph_tpu.osdmap import balancer
+from ceph_tpu.osdmap.osdmap import PGid, build_simple_osdmap
+
+
+def _domain_of(m, osd):
+    parent = {}
+    for bid, b in m.crush.buckets.items():
+        for item in b.items:
+            parent[item] = bid
+    node = osd
+    while node in parent:
+        node = parent[node]
+        if m.crush.buckets[node].type == 1:  # host
+            return node
+    return osd
+
+
+def test_balancer_reduces_stddev_and_stays_valid():
+    m = build_simple_osdmap(n_osds=32, osds_per_host=4, pg_num=256)
+    pid = list(m.pools)[0]
+    before = balancer.pg_per_osd_stddev(m, [pid])
+    changes = balancer.calc_pg_upmaps(m, [pid])
+    after = balancer.pg_per_osd_stddev(m, [pid])
+    assert changes, "no upmaps computed on a skewed map"
+    assert after < before * 0.6, (before, after)
+    # every mapping stays structurally valid: size maintained, no dup
+    # OSDs, failure domains (hosts) distinct — the try_pg_upmap contract
+    up, upp = m.pool_mapping(pid)
+    pool = m.pools[pid]
+    for s in range(pool.pg_num):
+        members = [int(v) for v in up[s] if v >= 0]
+        assert len(members) == len(set(members)), f"dup osd in pg {s}"
+        doms = [_domain_of(m, o) for o in members]
+        assert len(doms) == len(set(doms)), \
+            f"pg {s} violates host failure domain: {members}"
+
+
+def test_balancer_respects_upmap_application():
+    """The computed items actually reroute placement: recomputing the
+    mapping with them applied differs from the raw map."""
+    m = build_simple_osdmap(n_osds=16, osds_per_host=4, pg_num=128)
+    pid = list(m.pools)[0]
+    raw_up, _ = m.pool_mapping(pid)
+    changes = balancer.calc_pg_upmaps(m, [pid])
+    new_up, _ = m.pool_mapping(pid)
+    moved = {pgid.seed for pgid in changes}
+    for s in moved:
+        assert not np.array_equal(raw_up[s], new_up[s]), s
+    # untouched PGs keep their placement (balancing is surgical)
+    for s in set(range(128)) - moved:
+        assert np.array_equal(raw_up[s], new_up[s]), s
+
+
+def test_osdmaptool_upmap_cli(tmp_path):
+    m = build_simple_osdmap(n_osds=32, osds_per_host=4, pg_num=256)
+    src = tmp_path / "map.bin"
+    dst = tmp_path / "balanced.bin"
+    src.write_bytes(pickle.dumps(m))
+    out = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.tools.osdmaptool", str(src),
+         "--upmap", str(dst)],
+        capture_output=True, text=True, timeout=600)
+    assert out.returncode == 0, out.stderr
+    assert "pgs-per-osd stddev" in out.stdout
+    m2 = pickle.loads(dst.read_bytes())
+    assert m2.pg_upmap_items, "balanced map carries no upmap items"
+    assert balancer.pg_per_osd_stddev(m2) < \
+        balancer.pg_per_osd_stddev(m)

@@ -44,3 +44,141 @@ def test_graft_dryrun():
     import __graft_entry__ as g
 
     g.dryrun_multichip(8)
+
+
+# ---- round 4: the generalized mesh data plane (MeshECEngine) ----
+
+def _engine(k=8, m=4):
+    from ceph_tpu.ec import matrices
+    from ceph_tpu.parallel import MeshECEngine, make_mesh
+
+    mesh = make_mesh(8)
+    return MeshECEngine(mesh, k, m, matrices.isa_rs_matrix(k, m)), mesh
+
+
+def test_mesh_engine_encode_matches_single_device():
+    from ceph_tpu.ec import factory
+
+    eng, _ = _engine()
+    codec = factory({"plugin": "isa", "k": "8", "m": "4"})
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, (8, 8, 256), dtype=np.uint8)
+    mesh_par = np.asarray(eng.encode_batch(data))
+    single = np.asarray(codec.encode_batch(data))
+    assert np.array_equal(mesh_par, single)
+
+
+@pytest.mark.parametrize("erasures", [
+    (0,), (5,), (8,), (11,),              # single: data / parity
+    (0, 11), (2, 3), (9, 10),             # double
+    (0, 4, 8), (1, 2, 3, 9),              # up to m erasures
+])
+def test_mesh_engine_decode_patterns(erasures):
+    """Arbitrary erasure patterns reconstruct byte-exactly on the mesh
+    (the round-3 demo hardcoded shard 0)."""
+    eng, _ = _engine()
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, (8, 8, 128), dtype=np.uint8)
+    parity = np.asarray(eng.encode_batch(data))
+    chunks = np.concatenate([data, parity], axis=1)
+    got = np.asarray(eng.decode_batch(erasures, chunks))
+    want = chunks[:, list(erasures), :]
+    assert np.array_equal(got, want), erasures
+
+
+def test_mesh_engine_rmw_delta_parity():
+    """Partial-stripe RMW: delta parity update equals full re-encode."""
+    eng, _ = _engine()
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, (8, 8, 128), dtype=np.uint8)
+    parity = np.asarray(eng.encode_batch(data))
+    chunks = np.concatenate([data, parity], axis=1)
+    update = rng.integers(0, 256, (8, 8, 32), dtype=np.uint8)
+    new_chunks = np.asarray(eng.rmw_batch(chunks, update, col_start=48))
+    # reference: patch the data and re-encode from scratch
+    patched = data.copy()
+    patched[:, :, 48:80] = update
+    want_parity = np.asarray(eng.encode_batch(patched))
+    assert np.array_equal(new_chunks[:, :8, :], patched)
+    assert np.array_equal(new_chunks[:, 8:, :], want_parity)
+
+
+def test_mesh_engine_rmw_then_decode():
+    """RMW output survives shard loss — the combined path the cluster's
+    EC pool runs (write, partial overwrite, degraded read)."""
+    eng, _ = _engine()
+    rng = np.random.default_rng(4)
+    data = rng.integers(0, 256, (8, 8, 128), dtype=np.uint8)
+    parity = np.asarray(eng.encode_batch(data))
+    chunks = np.concatenate([data, parity], axis=1)
+    update = rng.integers(0, 256, (8, 8, 64), dtype=np.uint8)
+    chunks = np.asarray(eng.rmw_batch(chunks, update, col_start=0))
+    got = np.asarray(eng.decode_batch((1, 6), chunks))
+    assert np.array_equal(got[:, 0, :], chunks[:, 1, :])
+    assert np.array_equal(got[:, 1, :], chunks[:, 6, :])
+
+
+def test_crush_batch_sharded_matches_single():
+    """Mesh-sharded placement must equal the single-device mapper."""
+    from ceph_tpu.crush.mapper import TensorMapper
+    from ceph_tpu.crush.types import build_hierarchy
+    from ceph_tpu.parallel import crush_batch_sharded, make_mesh
+
+    cmap, rule = build_hierarchy(n_hosts=8, osds_per_host=4, numrep=3)
+    mapper = TensorMapper(cmap)
+    weights = np.full(cmap.max_devices, 0x10000, dtype=np.uint32)
+    xs = np.arange(1000, dtype=np.uint32)
+    single = np.asarray(
+        mapper.do_rule_batch(rule, xs, result_max=3, weights=weights)[0])
+    mesh = make_mesh(8)
+    sharded, _ = crush_batch_sharded(mesh, mapper, rule, xs, 3, weights)
+    assert np.array_equal(np.asarray(sharded), single)
+
+
+def test_ec_cluster_pool_on_mesh_data_plane():
+    """VERDICT r3 item 3 gate: a live EC pool whose batch encode/decode
+    runs through the mesh engine on a 2-device mesh — write, partial
+    RMW, read, degraded read with a stopped OSD."""
+    import asyncio
+
+    from ceph_tpu.cluster.vstart import _fast_config, start_cluster
+
+    async def scenario():
+        cfg = _fast_config()
+        cfg.osd_ec_mesh = "on"
+        cfg.osd_ec_mesh_devices = 2
+        cluster = await start_cluster(3, config=cfg)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create(
+                "mesh_ec", "erasure", pg_num=4,
+                ec_profile={"plugin": "jerasure",
+                            "technique": "reed_sol_van",
+                            "k": "2", "m": "1"})
+            io = client.ioctx(pool)
+            payload = bytes(range(256)) * 64          # 16 KiB
+            await io.write_full("mobj", payload)
+            # the pool's codec really is the mesh adapter
+            from ceph_tpu.parallel.engine import MeshCodecAdapter
+
+            some_osd = next(iter(cluster.osds.values()))
+            pobj = some_osd.osdmap.pools[pool]
+            assert isinstance(some_osd._codec(pobj), MeshCodecAdapter)
+            assert await io.read("mobj") == payload
+            # partial overwrite = the RMW path through the mesh engine
+            await io.write("mobj", b"M" * 3000, offset=1000)
+            got = await io.read("mobj")
+            assert got[1000:4000] == b"M" * 3000
+            assert got[:1000] == payload[:1000]
+            # degraded read: stop a non-primary member
+            pgid = client.objecter.object_pgid(pool, "mobj")
+            _, _, acting, primary = \
+                client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+            victim = next(o for o in acting if o != primary)
+            await cluster.osds[victim].stop()
+            got = await io.read("mobj", timeout=60)
+            assert got[1000:4000] == b"M" * 3000
+        finally:
+            await cluster.stop()
+
+    asyncio.run(scenario())

@@ -1,0 +1,238 @@
+"""Divergent-log rewind + EC rollback (round-4 item 5).
+
+Reference: PGLog::rewind_divergent_log (src/osd/PGLog.cc:287), the EC
+rollback design (doc/dev/osd_internals/erasure_coding/ecbackend.rst:
+10-27), and find_best_info's require_rollback MIN-last_update election —
+an un-acked partial-stripe write applied on some shards only must be
+ROLLED BACK during peering (restoring the exact pre-write shard bytes),
+never blessed or object-copied forward.
+"""
+
+import asyncio
+import pickle
+import random
+
+import pytest
+
+from ceph_tpu.cluster import messages as M
+from ceph_tpu.cluster import pglog
+from ceph_tpu.cluster.osd import OSDDaemon
+from ceph_tpu.cluster.pg import PGRB
+from ceph_tpu.cluster.vstart import _fast_config, start_cluster
+from ceph_tpu.ops import crc32c as crcmod
+
+EC_PROFILE = {"plugin": "jerasure", "technique": "reed_sol_van",
+              "k": "2", "m": "1"}
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _shard_crc(osd, coll, oid):
+    data = osd.store.read(coll, oid)
+    return crcmod.crc32c(0xFFFFFFFF, bytes(data))
+
+
+def test_ec_partial_write_rolls_back():
+    """Primary applies its shard + log entry but the sub-writes never
+    reach the replicas (crash mid-write).  Peering must elect the
+    replicas' shorter log (min-rule) and REWIND the primary's divergent
+    entry, restoring its pre-write shard bytes exactly (verified via
+    per-shard crc), not copy objects around."""
+    async def scenario():
+        cfg = _fast_config()
+        cfg.osd_client_op_timeout = 1.0   # the doomed write times out fast
+        cluster = await start_cluster(3, config=cfg)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("rwnd", "erasure", pg_num=4,
+                                            ec_profile=dict(EC_PROFILE))
+            io = client.ioctx(pool)
+            v1 = bytes(range(256)) * 32
+            await io.write_full("victim", v1)
+            await asyncio.sleep(0.05)
+
+            pgid = client.objecter.object_pgid(pool, "victim")
+            coll = f"pg_{pgid.pool}_{pgid.seed}"
+            _, _, acting, primary = \
+                client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+            posd = cluster.osds[primary]
+            st = posd.pgs[pgid]
+            lu_before = st.last_update
+            crc_before = _shard_crc(posd, coll, "victim")
+
+            # crash-mid-write model: the sub-writes VANISH (sent into the
+            # void, no error) — exactly what a primary death after the
+            # local apply looks like; the op times out un-acked
+            orig_send = posd._send_osd
+
+            async def drop_subwrites(osd, msg):
+                if isinstance(msg, M.MOSDECSubOpWrite):
+                    return  # swallowed: replicas never see it
+                return await orig_send(osd, msg)
+
+            posd._send_osd = drop_subwrites
+            pobj = posd.osdmap.pools[pool]
+            r = await posd._op_write_full(pobj, st, "victim", b"Z" * 8192)
+            posd._send_osd = orig_send
+            assert r == -110, "doomed write must time out un-acked"
+            # local shard applied + logged, replicas never saw it
+            assert st.last_update > lu_before
+            assert _shard_crc(posd, coll, "victim") != crc_before
+            assert st.last_complete < st.last_update
+            rb = posd.store.omap_get(coll, PGRB)
+            assert rb, "no rollback record captured for the shard write"
+
+            # peering (what the restarted primary runs): the replicas'
+            # log wins under the EC min-rule; our entry rewinds
+            await posd._recover_pg(st)
+            assert st.last_update == lu_before, "divergent entry survived"
+            assert _shard_crc(posd, coll, "victim") == crc_before, \
+                "rewind did not restore the pre-write shard bytes"
+            # the object still reads back as v1 for clients
+            assert await io.read("victim", timeout=60) == v1
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_ec_divergent_replica_rewinds_on_instruction():
+    """A REPLICA holding a divergent entry (it applied a sub-write the
+    other members never got, then the primary's log moved on without it)
+    is rolled back by the primary's rewind instruction during peering."""
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("rwnd2", "erasure", pg_num=4,
+                                            ec_profile=dict(EC_PROFILE))
+            io = client.ioctx(pool)
+            v1 = b"stable-state" * 100
+            await io.write_full("obj", v1)
+            await asyncio.sleep(0.05)
+            pgid = client.objecter.object_pgid(pool, "obj")
+            coll = f"pg_{pgid.pool}_{pgid.seed}"
+            _, _, acting, primary = \
+                client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+            replica = next(o for o in acting if o != primary)
+            rosd = cluster.osds[replica]
+            rst = rosd.pgs[pgid]
+            crc_before = _shard_crc(rosd, coll, "obj")
+            lu = rst.last_update
+
+            # forge a divergent sub-write on the replica only (the shard
+            # apply + entry the reference's crashed primary would have
+            # fanned out to just this member)
+            fake_v = (rosd.osdmap.epoch, lu[1] + 1)
+            shard = int(rosd.store.getattr(coll, "obj", "shard"))
+            rosd._apply_shard(pgid, "obj", shard, b"G" * 1024, 0, 1024,
+                              {"size": 2048, "version": fake_v[1]})
+            rosd._log_mutation(rst, "modify", "obj", fake_v)
+            assert rst.last_update == fake_v
+            assert _shard_crc(rosd, coll, "obj") != crc_before
+
+            # primary peers: sees the replica ahead, instructs rewind
+            posd = cluster.osds[primary]
+            await posd._recover_pg(posd.pgs[pgid])
+            for _ in range(50):
+                if rst.last_update == lu:
+                    break
+                await asyncio.sleep(0.1)
+            assert rst.last_update == lu, "replica kept divergent entry"
+            assert _shard_crc(rosd, coll, "obj") == crc_before, \
+                "replica shard bytes not restored"
+            assert await io.read("obj", timeout=60) == v1
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_thrash_primaries_mid_ec_write():
+    """Thrasher variant targeting primaries mid-write on an EC pool
+    (round-4 item 5 gate): writes race primary kills; afterwards every
+    ACKED write must read back and un-acked partials must have been
+    rolled back or completed — never silent shard divergence (verified
+    via scrub over every object)."""
+    async def scenario():
+        rng = random.Random(11)
+        cfg = _fast_config()
+        cfg.mon_osd_down_out_interval = 60.0
+        cluster = await start_cluster(4, config=cfg)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("pthrash", "erasure", pg_num=4,
+                                            ec_profile=dict(EC_PROFILE))
+            io = client.ioctx(pool)
+            acked = {}
+            attempted = {}   # oid -> every payload ever submitted
+
+            async def put(i, gen, timeout=60):
+                oid = f"obj{i}"
+                data = f"g{gen}-{i}-".encode() * 100
+                attempted.setdefault(oid, set()).add(data)
+                try:
+                    await io.write_full(oid, data, timeout=timeout)
+                    acked[oid] = data
+                except (IOError, OSError, TimeoutError):
+                    pass
+
+            for round_no in range(3):
+                for i in range(4):
+                    await put(i, round_no)
+                # find the primary of a random object and bounce it while
+                # writes are in flight
+                oid = f"obj{rng.randrange(4)}"
+                pgid = client.objecter.object_pgid(pool, oid)
+                _, _, _, primary = \
+                    client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+                if primary < 0 or primary not in cluster.osds:
+                    continue
+                writes = asyncio.gather(
+                    *[put(i, round_no + 10, timeout=20) for i in range(4)],
+                    return_exceptions=True)
+                await asyncio.sleep(rng.random() * 0.05)
+                stopped = cluster.osds.pop(primary)
+                store = stopped.store
+                await stopped.stop()
+                await writes
+                osd = OSDDaemon(primary, cluster.mon_addr, config=cfg,
+                                store=store)
+                await osd.start()
+                cluster.osds[primary] = osd
+                deadline = asyncio.get_event_loop().time() + 20
+                while asyncio.get_event_loop().time() < deadline:
+                    if cluster.mon.osdmap.osd_up[primary]:
+                        break
+                    await asyncio.sleep(0.05)
+                await asyncio.sleep(1.0)
+
+            # convergence: every object must hold SOME whole submitted
+            # payload (a timed-out write may legitimately land after its
+            # client gave up — at-least-once semantics — but torn or
+            # mixed-generation content is never acceptable)
+            for oid, data in sorted(acked.items()):
+                got = await io.read(oid, timeout=60)
+                assert got in attempted[oid], \
+                    (oid, got[:24], data[:24])
+            # no silent shard divergence: scrub every PG, expect zero
+            # inconsistent objects after recovery settles
+            deadline = asyncio.get_event_loop().time() + 30
+            while True:
+                bad = []
+                for o in cluster.osds.values():
+                    for st in list(o.pgs.values()):
+                        if st.primary != o.osd_id:
+                            continue
+                        rep = await o.scrub_pg(st)
+                        bad.extend(rep["inconsistent"])
+                if not bad or asyncio.get_event_loop().time() > deadline:
+                    break
+                await asyncio.sleep(1.0)
+            assert not bad, f"divergent shards after thrash: {bad}"
+        finally:
+            await cluster.stop()
+
+    run(scenario())
