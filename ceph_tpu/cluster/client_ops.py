@@ -48,12 +48,16 @@ class ClientOpsMixin:
             return
         m, pool, st = resolved
         if self._opq is not None:
-            self._opq.ensure_client(msg.reqid[0], self._opq_default)
+            # QoS identity = the STABLE client name: reqids carry a
+            # per-incarnation nonce after '#' (dup-cache uniqueness),
+            # but dmClock shares/limits attach to the entity
+            qos_client = str(msg.reqid[0]).split("#", 1)[0]
+            self._opq.ensure_client(qos_client, self._opq_default)
             # queue ONLY (conn, msg, stamp): map/pool/PG/primary state is
             # re-resolved at dequeue time, and ops that outlived the
             # client's attempt window are dropped (the client has already
             # resent; executing the stale copy would double-apply)
-            self._opq.enqueue(msg.reqid[0],
+            self._opq.enqueue(qos_client,
                               (conn, msg, time.monotonic()))
             self.perf.inc("osd_ops_queued_mclock")
             self._opq_event.set()
@@ -124,6 +128,18 @@ class ClientOpsMixin:
     _REQID_DUPS_TRACKED = 3000
 
     async def _dispatch_client_op(self, conn, msg, m, pool, st) -> None:
+        caps = getattr(conn, "peer_caps", None)
+        if caps is not None:
+            # cephx session: enforce OSD caps at dispatch (OSDCap analog)
+            from ceph_tpu.cluster import auth as authmod
+
+            need = "rw" if any(o[0] in self._MUTATING_OPS
+                               for o in msg.ops) else "r"
+            if not authmod.allows(caps, "osd", need):
+                self.perf.inc("osd_eperm")
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=-1, epoch=m.epoch))
+                return
         self.perf.inc("osd_client_ops")
         top = self.tracker.create(
             f"osd_op({msg.reqid[0]}:{msg.reqid[1]} {msg.oid} "

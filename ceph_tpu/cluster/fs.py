@@ -112,6 +112,23 @@ class FileSystem:
         await self.meta.omap_set(self._dir_oid(parent),
                                  {name: pickle.dumps(inode)})
 
+    async def set_size(self, path: str, size: int) -> None:
+        """Extend a file inode's size (the MDS applies this for client
+        data writes — the caps writeback analog).  GROW-ONLY: the value
+        is computed from the writer's possibly lease-stale stat, so a
+        blind absolute write could truncate a concurrent writer's
+        committed extension; max() keeps size-writeback monotonic (the
+        reference orders size changes through the Locker for the same
+        reason).  Explicit truncation would be its own op."""
+        import time as _time
+
+        parent, leaf, inode = await self._resolve(path)
+        if inode.mode != "file":
+            raise IsADirectoryError(path)
+        inode.size = max(inode.size, size)
+        inode.mtime = _time.time()
+        await self._set_dentry(parent, leaf, inode)
+
     # -- namespace ops ------------------------------------------------------
 
     async def _link_dentry(self, parent: int, leaf: str,

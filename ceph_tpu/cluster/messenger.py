@@ -69,6 +69,37 @@ class _MsgAck(Message):
     acked: int = 0
 
 
+@dataclass
+class _MsgAuth(Message):
+    """Connection authorizer (cephx mode): MUST be the first frame on a
+    connection; carries the sealed ticket + session-key possession proof
+    (reference CephXAuthorizer in the connection handshake)."""
+
+    authorizer: bytes = b""
+
+
+@dataclass
+class _MsgAuthRequest(Message):
+    """Client -> mon ticket request (reference CEPH_AUTH_CEPHX
+    MAuth): entity + proof of the per-entity key."""
+
+    entity: str = ""
+    nonce: bytes = b""
+    proof: bytes = b""
+
+
+@dataclass
+class _MsgAuthReply(Message):
+    """Mon -> client: sealed ticket + session key sealed under the
+    entity key (result != 0 -> refused)."""
+
+    result: int = 0
+    ticket_blob: bytes = b""
+    sealed_key: bytes = b""
+    ttl: float = 3600.0
+    error: str = ""
+
+
 class _Session:
     """Per-peer outgoing session: seq numbering + unacked replay buffer
     (reference AsyncConnection out_seq/out_q)."""
@@ -110,6 +141,16 @@ class Connection:
         self._send_lock = asyncio.Lock()
         self._seq = 0
         self.closed = False
+        # cephx session state (set by the authorizer handshake):
+        # subsequent frames both ways sign with the session key, and
+        # dispatchers consult peer_caps for authorization
+        self.session_key: Optional[bytes] = None
+        self.peer_entity: Optional[str] = None
+        self.peer_caps: Optional[Dict[str, str]] = None
+
+    def _sign_key(self) -> Optional[bytes]:
+        return self.session_key if self.session_key is not None \
+            else self.messenger.secret
 
     async def send(self, msg: Message) -> None:
         msg.src = self.messenger.name
@@ -117,7 +158,7 @@ class Connection:
             self._seq += 1
             msg.seq = self._seq
             payload = pickle.dumps(msg)
-            secret = self.messenger.secret
+            secret = self._sign_key()
             if secret is not None:
                 payload += _sign(secret, payload)
             try:
@@ -153,9 +194,16 @@ def _sign(secret: bytes, payload: bytes) -> bytes:
 
 
 class Messenger:
-    def __init__(self, name: EntityName, secret: bytes = None):
+    def __init__(self, name: EntityName, secret: bytes = None, auth=None):
         self.name = name
         self.secret = secret
+        # cephx mode (auth = auth.CephxContext): per-connection session
+        # keys replace the global secret; secret must be None then
+        self.auth = auth
+        if auth is not None:
+            self.secret = None
+        # mon-side hook: callable(_MsgAuthRequest) -> _MsgAuthReply
+        self.auth_server = None
         self.sid = next(_SID)
         self.dispatchers: List[Dispatcher] = []
         self._server: Optional[asyncio.base_events.Server] = None
@@ -163,6 +211,7 @@ class Messenger:
         self._sessions: Dict[Addr, _Session] = {}
         self._accepted: List[Connection] = []
         self._tasks: List[asyncio.Task] = []
+        self._auth_waiters: Dict[int, asyncio.Future] = {}
         self._closing = False
         self.my_addr: Optional[Addr] = None
 
@@ -195,17 +244,28 @@ class Messenger:
                 hdr = await conn.reader.readexactly(4)
                 (n,) = struct.unpack("<I", hdr)
                 payload = await conn.reader.readexactly(n)
-                if self.secret is not None:
+                verify_key = conn.session_key if conn.session_key \
+                    is not None else self.secret
+                if verify_key is not None:
                     # verify BEFORE unpickling: unauthenticated bytes
                     # must never reach the deserializer
                     if n < SIG_LEN or not _hmac.compare_digest(
-                            _sign(self.secret, payload[:-SIG_LEN]),
+                            _sign(verify_key, payload[:-SIG_LEN]),
                             payload[-SIG_LEN:]):
                         raise ConnectionError("bad message signature")
                     payload = payload[:-SIG_LEN]
                 msg = pickle.loads(payload)
                 if conn.peer is None:
                     conn.peer = msg.src
+                if self.auth is not None and await self._handle_auth_frame(
+                        conn, msg):
+                    continue
+                if self.auth is not None and conn.session_key is None:
+                    # cephx mode: nothing but the handshake may ride an
+                    # unauthenticated connection
+                    raise ConnectionError(
+                        f"unauthenticated {type(msg).__name__} from "
+                        f"{msg.src}")
                 if isinstance(msg, _MsgAck):
                     sess = self._sessions.get(conn.peer_addr)
                     if sess is not None:
@@ -233,12 +293,81 @@ class Messenger:
                 except Exception:
                     pass
 
+    async def _handle_auth_frame(self, conn: Connection, msg) -> bool:
+        """cephx transport frames (handshake-time unpickling is the one
+        unauthenticated-deserialization exception — the reference's
+        banner exchange sits at the same trust point)."""
+        from ceph_tpu.cluster import auth as authmod
+
+        if isinstance(msg, _MsgAuth):
+            t = authmod.verify_authorizer(self.auth.master, msg.authorizer) \
+                if self.auth.master is not None else None
+            if t is None:
+                raise ConnectionError("no master key to verify authorizer")
+            conn.session_key = t.session_key
+            conn.peer_entity = t.entity
+            conn.peer_caps = t.caps
+            return True
+        if isinstance(msg, _MsgAuthRequest):
+            if self.auth_server is None:
+                raise ConnectionError("not an auth server")
+            reply = self.auth_server(msg)
+            await conn.send(reply)
+            return True
+        if isinstance(msg, _MsgAuthReply):
+            fut = self._auth_waiters.pop(id(conn), None)
+            if fut is not None and not fut.done():
+                fut.set_result(msg)
+            return True
+        return False
+
+    async def cephx_bootstrap(self, mon_addr: Addr) -> None:
+        """Client ticket bootstrap (reference MAuth round-trip): prove
+        the entity key to a monitor, adopt the returned ticket."""
+        import os as _os
+
+        from ceph_tpu.cluster import auth as authmod
+
+        nonce = _os.urandom(16)
+        proof = _hmac.new(self.auth.entity_secret,
+                          b"authreq:" + self.auth.entity.encode() + nonce,
+                          hashlib.sha256).digest()[:SIG_LEN]
+        reader, writer = await asyncio.open_connection(
+            mon_addr[0], mon_addr[1])
+        conn = Connection(self, reader, writer, peer_addr=tuple(mon_addr))
+        fut = asyncio.get_event_loop().create_future()
+        self._auth_waiters[id(conn)] = fut
+        task = asyncio.get_event_loop().create_task(self._read_loop(conn))
+        self._tasks.append(task)
+        try:
+            await conn.send(_MsgAuthRequest(entity=self.auth.entity,
+                                            nonce=nonce, proof=proof))
+            reply = await asyncio.wait_for(fut, timeout=10.0)
+            if reply.result != 0:
+                raise PermissionError(
+                    f"auth refused for {self.auth.entity}: {reply.error}")
+            self.auth.adopt(reply.ticket_blob, reply.sealed_key,
+                            ttl_hint=getattr(reply, "ttl", 3600.0))
+        finally:
+            self._auth_waiters.pop(id(conn), None)
+            await conn.close()
+
     async def connect(self, addr: Addr) -> Connection:
         conn = self._out.get(tuple(addr))
         if conn is not None and not conn.closed:
             return conn
         reader, writer = await asyncio.open_connection(addr[0], addr[1])
         conn = Connection(self, reader, writer, peer_addr=tuple(addr))
+        if self.auth is not None:
+            # authorizer-first (reference connection handshake): present
+            # the ticket before any session traffic; the session key
+            # signs everything after
+            from ceph_tpu.cluster import auth as authmod
+
+            self.auth.ensure_ticket()
+            await conn.send(_MsgAuth(authorizer=authmod.make_authorizer(
+                self.auth.ticket_blob, self.auth.session_key)))
+            conn.session_key = self.auth.session_key
         self._out[tuple(addr)] = conn
         task = asyncio.get_event_loop().create_task(self._read_loop(conn))
         self._tasks.append(task)
@@ -257,18 +386,26 @@ class Messenger:
             msg.seq = sess.seq
             msg.sid = self.sid
             payload = pickle.dumps(msg)
-            if self.secret is not None:
-                payload += _sign(self.secret, payload)
-            frame = struct.pack("<I", len(payload)) + payload
-            sess.buffer(sess.seq, frame)
+            # buffer the UNSIGNED payload and sign at write time with the
+            # connection's key: a cephx ticket renewal mints a new session
+            # key for NEW connections, while frames replayed over a fresh
+            # connection must carry the fresh key's signature (signing at
+            # buffer time would wedge the replay after every renewal)
+            sess.buffer(sess.seq, payload)
             try:
                 conn = await self.connect(addr)
-                conn.writer.write(frame)
+                conn.writer.write(self._frame(conn, payload))
                 await conn.writer.drain()
             except (ConnectionError, OSError, RuntimeError):
                 if self._closing:
                     raise
                 await self._reconnect_replay(sess, addr)
+
+    def _frame(self, conn: Connection, payload: bytes) -> bytes:
+        key = conn._sign_key()
+        if key is not None:
+            payload = payload + _sign(key, payload)
+        return struct.pack("<I", len(payload)) + payload
 
     async def _reconnect_replay(self, sess: _Session, addr: Addr,
                                 retries: int = 3) -> None:
@@ -290,8 +427,8 @@ class Messenger:
                 await old.close()
             try:
                 conn = await self.connect(addr)
-                for f in sess.unacked.values():
-                    conn.writer.write(f)
+                for payload in sess.unacked.values():
+                    conn.writer.write(self._frame(conn, payload))
                 await conn.writer.drain()
                 return
             except (ConnectionError, OSError, RuntimeError) as e:

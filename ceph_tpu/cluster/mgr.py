@@ -29,7 +29,8 @@ class MgrDaemon(Dispatcher):
         self.config = Config(**config.show()) if config else Config()
         self.messenger = Messenger(
             EntityName("mgr", rank),
-            secret=self.config.auth_secret())
+            secret=self.config.auth_secret(),
+            auth=self.config.cephx_context(f"mgr.{rank}"))
         self.messenger.add_dispatcher(self)
         self.monc = MonTargeter(self.messenger, mon_addr)
         self.perf = PerfCounters(f"mgr.{rank}")

@@ -56,9 +56,18 @@ class Monitor(Dispatcher):
         self.osdmap = osdmap
         self.messenger = Messenger(
             EntityName("mon", rank),
-            secret=self.config.auth_secret())
+            secret=self.config.auth_secret(),
+            auth=self.config.cephx_context(f"mon.{rank}"))
         self.messenger.add_dispatcher(self)
+        # cephx ticket service (reference CephxServiceHandler): clients
+        # prove their entity key, the mon issues time-limited tickets;
+        # revoked entities are refused renewal
+        self._revoked_entities: Set[str] = set()
+        if self.messenger.auth is not None:
+            self.messenger.auth_server = self._handle_auth_request
         self.subscribers: Set[Addr] = set()
+        # subscriber bind-addr -> the connection its subscribe rode in on
+        self._sub_conns: Dict[Tuple, Connection] = {}
         self.failure_reports: Dict[int, Set[int]] = {}
         self.down_since: Dict[int, float] = {}
         # last beacon per osd (reference MOSDBeacon/last_osd_report): lets
@@ -138,6 +147,37 @@ class Monitor(Dispatcher):
         if self.store is not None:
             self.db = None
             self.store.umount()
+
+    # -- cephx ticket service ---------------------------------------------
+
+    def _handle_auth_request(self, msg):
+        """Verify the entity-key proof and issue a ticket (reference
+        CephxServiceHandler::handle_request)."""
+        import hashlib as _hl
+        import hmac as _hm
+
+        from ceph_tpu.cluster import auth as authmod
+        from ceph_tpu.cluster.messenger import SIG_LEN, _MsgAuthReply
+
+        master = self.config.auth_secret()
+        if master is None:
+            return _MsgAuthReply(result=-22, error="no cluster key")
+        if msg.entity in self.osdmap.revoked_entities or \
+                msg.entity in self._revoked_entities:
+            self.perf.inc("mon_auth_refused")
+            return _MsgAuthReply(result=-13, error="entity revoked")
+        ek = authmod.entity_key(master, msg.entity)
+        want = _hm.new(ek, b"authreq:" + msg.entity.encode() + msg.nonce,
+                       _hl.sha256).digest()[:SIG_LEN]
+        if not _hm.compare_digest(want, msg.proof):
+            self.perf.inc("mon_auth_refused")
+            return _MsgAuthReply(result=-13, error="bad key proof")
+        ttl = self.config.auth_ticket_ttl
+        blob, sealed, _ = authmod.issue_ticket(
+            master, msg.entity, authmod.default_caps_for(msg.entity), ttl)
+        self.perf.inc("mon_tickets_issued")
+        return _MsgAuthReply(result=0, ticket_blob=blob, sealed_key=sealed,
+                             ttl=ttl)
 
     # -- quorum plumbing ---------------------------------------------------
 
@@ -333,8 +373,31 @@ class Monitor(Dispatcher):
                     self.perf.inc("mon_mgr_beacons")
                     await self._commit_inc(inc)
             return True
+        if type(msg).__name__ == "MMDSBeacon":
+            # active-MDS registration (MDSMap-lite, like the mgr's)
+            if not self.is_leader:
+                if self.leader_rank is not None and \
+                        self.leader_rank != self.rank:
+                    try:
+                        await self._send_mon(self.leader_rank, msg)
+                    except (ConnectionError, OSError):
+                        pass
+                return True
+            async with self._map_mutex:
+                if self.osdmap.mds_addr != tuple(msg.addr):
+                    inc = self._new_inc()
+                    inc.new_mds_addr = tuple(msg.addr)
+                    self.perf.inc("mon_mds_beacons")
+                    await self._commit_inc(inc)
+            return True
         if isinstance(msg, M.MMonSubscribe):
             self.subscribers.add(tuple(msg.addr))
+            # remember the subscriber's OWN connection: cephx clients
+            # cannot verify daemon authorizers (they hold no master
+            # key), so pushes must ride the session the client opened —
+            # exactly the reference model, where clients never accept
+            # inbound connections
+            self._sub_conns[tuple(msg.addr)] = conn
             await self._send_map(tuple(msg.addr), since=msg.since)
             return True
         if isinstance(msg, M.MMonCommand):
@@ -405,15 +468,32 @@ class Monitor(Dispatcher):
                 self.perf.inc("mon_osd_marked_down")
                 await self._commit_inc(inc)
 
+    # commands that mutate cluster state need mon "rw" caps (MonCap)
+    _MUTATING_PREFIXES = frozenset({
+        "osd pool create", "osd out", "osd in", "injectargs",
+        "osd pool mksnap", "osd pool rmsnap",
+        "osd pool selfmanaged_snap_create",
+        "osd pool selfmanaged_snap_remove", "auth revoke"})
+
     async def _handle_command(self, conn: Connection, msg: M.MMonCommand) -> None:
         cmd = msg.cmd
         result, data = 0, None
         prefix = cmd.get("prefix")
+        caps = getattr(conn, "peer_caps", None)
+        if caps is not None and prefix in self._MUTATING_PREFIXES:
+            from ceph_tpu.cluster import auth as authmod
+
+            if not authmod.allows(caps, "mon", "rw"):
+                self.perf.inc("mon_eperm")
+                await conn.send(M.MMonCommandReply(
+                    tid=msg.tid, result=-1,
+                    data=f"EPERM: mon rw caps required for {prefix!r}"))
+                return
         mutating = prefix in (
             "osd pool create", "osd out", "osd in",
             "osd pool mksnap", "osd pool rmsnap",
             "osd pool selfmanaged_snap_create",
-            "osd pool selfmanaged_snap_remove")
+            "osd pool selfmanaged_snap_remove", "auth revoke")
         if mutating and not self.is_leader:
             # forward to the leader, relay its reply (reference
             # Monitor::forward_request_leader)
@@ -445,6 +525,17 @@ class Monitor(Dispatcher):
                             "osd pool selfmanaged_snap_create",
                             "osd pool selfmanaged_snap_remove"):
                 result, data = await self._handle_snap_command(prefix, cmd)
+            elif prefix == "auth revoke":
+                # refuse future ticket issuance/renewal for the entity
+                # (existing tickets die at their TTL); committed through
+                # Paxos so every mon enforces it and restarts keep it
+                async with self._map_mutex:
+                    inc = self._new_inc()
+                    inc.new_revoked = (cmd["entity"],)
+                    if not await self._commit_inc(inc):
+                        result, data = -11, "quorum lost"
+                    else:
+                        data = sorted(self.osdmap.revoked_entities)
             elif prefix == "osd out":
                 async with self._map_mutex:
                     inc = self._new_inc()
@@ -613,6 +704,19 @@ class Monitor(Dispatcher):
             except (ConnectionError, OSError):
                 self.subscribers.discard(addr)
 
+    async def _map_push(self, msg, addr: Addr) -> None:
+        """Deliver a map message: over the subscriber's own connection
+        when one is alive (required for cephx clients), else by dialing
+        the addr (daemon peers)."""
+        conn = self._sub_conns.get(tuple(addr))
+        if conn is not None and not conn.closed:
+            try:
+                await conn.send(msg)
+                return
+            except (ConnectionError, OSError, RuntimeError):
+                self._sub_conns.pop(tuple(addr), None)
+        await self.messenger.send_message(msg, addr)
+
     async def _send_map(self, addr: Addr, since: int = 0) -> None:
         """Send incrementals covering (since, current] when the window has
         them, else the full map (reference OSDMonitor send_incremental)."""
@@ -627,13 +731,13 @@ class Monitor(Dispatcher):
                 # complete chain (possibly empty when already current; the
                 # empty message still acks the subscriber's refresh)
                 self.perf.inc("mon_inc_maps_sent")
-                await self.messenger.send_message(
+                await self._map_push(
                     M.MOSDIncMapMsg(prev_epoch=since, epoch=epoch,
                                     inc_blobs=chain), addr)
                 return
         self.perf.inc("mon_full_maps_sent")
         blob = pickle.dumps(self.osdmap)
-        await self.messenger.send_message(
+        await self._map_push(
             M.MOSDMapMsg(epoch=epoch, osdmap_blob=blob), addr)
 
     async def _tick(self) -> None:

@@ -30,11 +30,19 @@ from ceph_tpu.utils import Config
 class Objecter(Dispatcher):
     def __init__(self, name: str, mon_addr,
                  config: Optional[Config] = None):
-        self.client_name = name
+        import secrets as _secrets
+
+        # reqid identity carries a per-incarnation nonce (reference
+        # osd_reqid_t: client gid + incarnation): a restarted client
+        # reusing a name must never collide with the OSDs' reqid dup
+        # cache from its previous life — tids restart at 1
+        self.client_name = f"{name}#{_secrets.token_hex(4)}"
+        self.display_name = name
         self.config = config or Config()
         self.messenger = Messenger(
             EntityName("client", abs(hash(name)) % 10000),
-            secret=self.config.auth_secret())
+            secret=self.config.auth_secret(),
+            auth=self.config.cephx_context(f"client.{name}"))
         self.messenger.add_dispatcher(self)
         from ceph_tpu.cluster.monclient import MonTargeter
 
@@ -48,6 +56,7 @@ class Objecter(Dispatcher):
         self._mon_tid = 0
         self._mon_inflight: Dict[int, asyncio.Future] = {}
         self._cmd_inflight: Dict[int, asyncio.Future] = {}
+        self._mds_inflight: Dict[int, asyncio.Future] = {}
         # linger ops (watches) re-registered on every map change
         # (reference Objecter::linger_register, Objecter.cc:778)
         self._cookie = 0
@@ -66,6 +75,11 @@ class Objecter(Dispatcher):
 
     async def start(self) -> None:
         addr = await self.messenger.bind()
+        auth_ctx = self.messenger.auth
+        if auth_ctx is not None and auth_ctx.master is None:
+            # cephx client: bootstrap a ticket from the mon before any
+            # session traffic (reference MonClient authenticate())
+            await self.messenger.cephx_bootstrap(self.monc.current)
         await self._mon_send(M.MMonSubscribe(what="osdmap", addr=addr))
         await asyncio.wait_for(self._map_event.wait(), timeout=10)
 
@@ -118,6 +132,12 @@ class Objecter(Dispatcher):
             return True
         if isinstance(msg, M.MCommandReply):
             fut = self._cmd_inflight.pop(msg.tid, None)
+            if fut and not fut.done():
+                fut.set_result(msg)
+            return True
+        tname = type(msg).__name__
+        if tname == "MClientReply":   # MDS replies (cluster/mds.py)
+            fut = self._mds_inflight.pop(msg.tid, None)
             if fut and not fut.done():
                 fut.set_result(msg)
             return True

@@ -65,7 +65,8 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         self.store = store or MemStore()
         self.messenger = Messenger(
             EntityName("osd", osd_id),
-            secret=self.config.auth_secret())
+            secret=self.config.auth_secret(),
+            auth=self.config.cephx_context(f"osd.{osd_id}"))
         self.messenger.add_dispatcher(self)
         # monmap failover (shared MonClient hunting, cluster/monclient.py)
         from ceph_tpu.cluster.monclient import MonTargeter

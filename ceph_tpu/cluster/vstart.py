@@ -35,6 +35,18 @@ class Cluster:
     clients: List[RadosClient] = field(default_factory=list)
     mgr: Optional[MgrDaemon] = None
     mgr_addr: Optional[tuple] = None
+    mds: Optional[object] = None       # MDSDaemon (cluster/mds.py)
+    mds_addr: Optional[tuple] = None
+
+    async def start_mds(self, meta_pool: int, data_pool: int,
+                        rank: int = 0):
+        """Start (or restart) the active MDS over existing pools."""
+        from ceph_tpu.cluster.mds import MDSDaemon
+
+        self.mds = MDSDaemon(self.mon_addr, meta_pool, data_pool,
+                             config=self.config, rank=rank)
+        self.mds_addr = await self.mds.start()
+        return self.mds
 
     @property
     def mon(self) -> Monitor:
@@ -115,6 +127,8 @@ class Cluster:
     async def stop(self) -> None:
         for c in self.clients:
             await c.shutdown()
+        if self.mds is not None:
+            await self.mds.stop()
         if self.mgr is not None:
             await self.mgr.stop()
         for osd in self.osds.values():

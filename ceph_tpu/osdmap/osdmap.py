@@ -133,6 +133,8 @@ class Incremental:
     new_primary_temp: Dict["PGid", int] = field(default_factory=dict)
     new_primary_affinity: Dict[int, int] = field(default_factory=dict)
     new_mgr_addr: object = None  # mgr registration (reference MgrMap)
+    new_mds_addr: object = None  # active MDS (MDSMap-lite)
+    new_revoked: Tuple[str, ...] = ()  # cephx entities to revoke
 
 
 class OSDMap:
@@ -144,6 +146,11 @@ class OSDMap:
         self.osd_up = [True] * self.max_osd
         self.osd_weight = [0x10000] * self.max_osd  # in/out weight
         self.mgr_addr = None  # active mgr (reference MgrMap active addr)
+        self.mds_addr = None  # active MDS (MDSMap-lite, mds beacons)
+        # cephx entities refused ticket issuance (replicated through
+        # Paxos like every map mutation, so revocation survives mon
+        # failover AND restarts via the persisted map)
+        self.revoked_entities: set = set()
         self.osd_primary_affinity: Optional[List[int]] = None
         self.pools: Dict[int, PGPool] = {}
         self.pg_upmap: Dict[PGid, List[int]] = {}
@@ -233,6 +240,10 @@ class OSDMap:
             self.set_primary_affinity(osd, aff)
         if inc.new_mgr_addr is not None:
             self.mgr_addr = tuple(inc.new_mgr_addr)
+        if inc.new_mds_addr is not None:
+            self.mds_addr = tuple(inc.new_mds_addr)
+        if inc.new_revoked:
+            self.revoked_entities |= set(inc.new_revoked)
         for pg, temp in inc.new_pg_temp.items():
             if temp:
                 self.pg_temp[pg] = list(temp)

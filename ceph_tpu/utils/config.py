@@ -67,6 +67,16 @@ OPTIONS: List[Option] = [
     # auth (reference auth_supported / cephx)
     Option("auth_shared_secret", str, "",
            "cluster HMAC signing key; empty = auth none"),
+    # "none" | "shared" (static HMAC signing) | "cephx" (mon-issued
+    # tickets, per-session keys, caps — cluster/auth.py)
+    Option("auth_supported", str, "shared"),
+    Option("auth_ticket_ttl", float, 3600.0),
+    # client-side: hex per-entity key (provisioned keyring analog);
+    # empty + cephx -> derive from auth_shared_secret when present
+    Option("auth_entity_key", str, ""),
+    # mds (MDSMap-lite + Locker caps-lite)
+    Option("mds_lease_ttl", float, 2.0),
+    Option("mds_beacon_interval", float, 1.0),
     # ec
     Option("osd_ec_batch_size", int, 64, "stripes per device dispatch"),
     Option("osd_ec_stripe_unit", int, 4096),
@@ -138,6 +148,28 @@ class Config:
         """Messenger signing key, or None for auth 'none'."""
         s = self._values.get("auth_shared_secret", "")
         return s.encode() if s else None
+
+    def cephx_context(self, entity: str):
+        """CephxContext for a daemon/client messenger when
+        auth_supported=cephx, else None (legacy shared/none modes)."""
+        if self._values.get("auth_supported") != "cephx":
+            return None
+        from ceph_tpu.cluster import auth as authmod
+
+        master = self.auth_secret()
+        ek = self._values.get("auth_entity_key", "")
+        entity_secret = bytes.fromhex(ek) if ek else None
+        kind = entity.split(".", 1)[0]
+        if kind in ("mon", "osd", "mds", "mgr"):
+            return authmod.CephxContext(
+                entity, master=master,
+                ttl=self._values.get("auth_ticket_ttl", 3600.0))
+        # clients never hold the master key — only their entity key
+        if entity_secret is None and master is not None:
+            entity_secret = authmod.entity_key(master, entity)
+        return authmod.CephxContext(
+            entity, entity_secret=entity_secret,
+            ttl=self._values.get("auth_ticket_ttl", 3600.0))
 
     def show(self) -> Dict[str, Any]:
         return dict(self._values)

@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""TPU sidecar: the process C++ erasure-code plugins delegate to.
+
+BASELINE.json's north star: "the C++ OSD reaches the TPU via a …
+sidecar that coalesces stripe requests into fixed-size device batches".
+This is that sidecar: a unix-socket server speaking a tiny length-
+prefixed binary protocol; libec_jax.cc (the native plugin shim built
+against the reference's dlopen ABI) connects here, and every
+encode/decode lands on the ceph_tpu batch engines.
+
+Coalescing: requests arriving within a small window are merged into ONE
+device dispatch per (profile, op, chunk-size) group — the fixed-size
+device batching the north star describes — then the results fan back
+out per request.
+
+Protocol (little-endian):
+  request:  u32 len | u8 op (1=encode 2=decode 3=ping) | u16 profile_len
+            | profile json | u8 k | u8 m | u8 n_erasures | u8[] erasures
+            | u32 chunk_size | chunk payloads (k for encode, k+m with
+            erased zeroed for decode)
+  reply:    u32 len | u8 status | payload (m parity chunks for encode,
+            len(erasures) chunks for decode)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import struct
+import sys
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+class Sidecar:
+    def __init__(self, coalesce_window: float = 0.002):
+        self._codecs: Dict[str, object] = {}
+        self.window = coalesce_window
+        self._queues: Dict[Tuple, List] = defaultdict(list)
+        self._flushers: Dict[Tuple, asyncio.Task] = {}
+        self.batches = 0
+        self.requests = 0
+
+    def codec(self, profile_json: str):
+        c = self._codecs.get(profile_json)
+        if c is None:
+            from ceph_tpu.ec import factory
+
+            c = factory(json.loads(profile_json))
+            self._codecs[profile_json] = c
+        return c
+
+    async def handle(self, reader, writer):
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                (n,) = struct.unpack("<I", hdr)
+                payload = await reader.readexactly(n)
+                resp = await self.dispatch(payload)
+                writer.write(struct.pack("<I", len(resp)) + resp)
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            writer.close()
+
+    async def dispatch(self, payload: bytes) -> bytes:
+        op = payload[0]
+        if op == 3:
+            return b"\x00pong"
+        (plen,) = struct.unpack_from("<H", payload, 1)
+        off = 3
+        profile = payload[off:off + plen].decode()
+        off += plen
+        k, m, ne = payload[off], payload[off + 1], payload[off + 2]
+        off += 3
+        erasures = tuple(payload[off:off + ne])
+        off += ne
+        (chunk,) = struct.unpack_from("<I", payload, off)
+        off += 4
+        nchunks = k if op == 1 else k + m
+        data = np.frombuffer(
+            payload, dtype=np.uint8, count=nchunks * chunk, offset=off
+        ).reshape(nchunks, chunk)
+        self.requests += 1
+        out = await self._submit(profile, op, erasures, data)
+        return b"\x00" + out.tobytes()
+
+    async def _submit(self, profile, op, erasures, data) -> np.ndarray:
+        """Queue into the coalescing window; one device dispatch serves
+        every request that arrived in it."""
+        key = (profile, op, erasures, data.shape[1])
+        fut = asyncio.get_event_loop().create_future()
+        self._queues[key].append((data, fut))
+        if key not in self._flushers or self._flushers[key].done():
+            self._flushers[key] = asyncio.get_event_loop().create_task(
+                self._flush(key))
+        return await fut
+
+    async def _flush(self, key) -> None:
+        await asyncio.sleep(self.window)
+        batch = self._queues.pop(key, [])
+        if not batch:
+            return
+        profile, op, erasures, _ = key
+        codec = self.codec(profile)
+        stack = np.stack([d for d, _ in batch])      # (B, nchunks, S)
+        self.batches += 1
+        try:
+            if op == 1:
+                out = np.asarray(codec.encode_batch(stack))
+            else:
+                out = np.asarray(codec.decode_batch(erasures, stack))
+            for i, (_, fut) in enumerate(batch):
+                if not fut.done():
+                    fut.set_result(out[i])
+        except Exception as e:
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(e)
+
+
+async def main(path: str) -> None:
+    sidecar = Sidecar()
+    server = await asyncio.start_unix_server(sidecar.handle, path=path)
+    print(f"sidecar listening on {path}", flush=True)
+    async with server:
+        await server.serve_forever()
+
+
+if __name__ == "__main__":
+    asyncio.run(main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/ec_jax.sock"))

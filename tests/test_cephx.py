@@ -1,0 +1,157 @@
+"""CephX-lite: tickets, session keys, caps (round-4 item 6).
+
+Reference: src/auth/cephx/CephxProtocol.h:412 (tickets/authorizers),
+CephxServiceHandler.h:23 (mon-side issuance), MonCap/OSDCap enforcement.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.cluster import auth
+from ceph_tpu.cluster.vstart import _fast_config, start_cluster
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _cephx_config():
+    cfg = _fast_config()
+    cfg.auth_shared_secret = "round4-cluster-master-key"
+    cfg.auth_supported = "cephx"
+    return cfg
+
+
+def test_cluster_end_to_end_with_cephx():
+    """The whole data path — pool create, replicated + EC I/O, snaps —
+    runs over per-session keys issued through mon tickets."""
+    async def scenario():
+        cluster = await start_cluster(3, config=_cephx_config())
+        try:
+            client = await cluster.client()
+            # the client really bootstrapped a ticket (no master key)
+            mctx = client.objecter.messenger.auth
+            assert mctx is not None and mctx.master is None
+            assert mctx.ticket_blob is not None
+            pool = await client.pool_create("authrepl", "replicated",
+                                            pg_num=8, size=3)
+            io = client.ioctx(pool)
+            await io.write_full("obj", b"signed-per-session" * 10)
+            assert await io.read("obj") == b"signed-per-session" * 10
+            ecpool = await client.pool_create(
+                "authec", "erasure", pg_num=4,
+                ec_profile={"plugin": "jerasure",
+                            "technique": "reed_sol_van",
+                            "k": "2", "m": "1"})
+            eio = client.ioctx(ecpool)
+            await eio.write_full("eobj", b"ec-under-cephx" * 100)
+            assert await eio.read("eobj") == b"ec-under-cephx" * 100
+            sid = await io.snap_create("s1")
+            await io.write_full("obj", b"after")
+            assert await io.read("obj", snapid=sid) == \
+                b"signed-per-session" * 10
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_revoked_entity_refused():
+    async def scenario():
+        cluster = await start_cluster(2, config=_cephx_config())
+        try:
+            admin = await cluster.client()
+            await admin.objecter.mon_command(
+                {"prefix": "auth revoke", "entity": "client.mallory"})
+            with pytest.raises((PermissionError, TimeoutError)):
+                await cluster.client("mallory")
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_wrong_entity_key_refused():
+    async def scenario():
+        cfg = _cephx_config()
+        cluster = await start_cluster(2, config=cfg)
+        try:
+            bad = _cephx_config()
+            bad.auth_shared_secret = ""          # no master to derive from
+            bad.auth_entity_key = "ab" * 32      # wrong key
+            with pytest.raises((PermissionError, TimeoutError)):
+                from ceph_tpu.cluster.objecter import RadosClient
+
+                c = RadosClient(cluster.mon_addr, name="admin", config=bad)
+                await c.connect()
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_expired_ticket_refused_then_renewal_works():
+    async def scenario():
+        cfg = _cephx_config()
+        cluster = await start_cluster(2, config=cfg)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("exp", "replicated",
+                                            pg_num=4, size=2)
+            io = client.ioctx(pool)
+            await io.write_full("obj", b"before-expiry")
+            # forge expiry: replace the client's ticket with one already
+            # past its TTL (sealed with the real service key, so only
+            # the expiry check can reject it)
+            master = cfg.auth_secret()
+            mctx = client.objecter.messenger.auth
+            blob, sealed, skey = auth.issue_ticket(
+                master, "client.admin",
+                auth.default_caps_for("client.admin"), ttl=-5.0)
+            mctx.ticket_blob, mctx.session_key = blob, skey
+            mctx.valid_until = 1e18    # lie so the client USES it
+            # new connections present the expired ticket -> refused
+            for m in list(client.objecter.messenger._out.values()):
+                await m.close()
+            client.objecter.messenger._out.clear()
+            with pytest.raises((IOError, TimeoutError, ConnectionError)):
+                await io.read("obj", timeout=4)
+            # renewal: bootstrap a fresh ticket, traffic flows again
+            mctx.ticket_blob = None
+            mctx.valid_until = 0.0
+            await client.objecter.messenger.cephx_bootstrap(
+                cluster.mon_addr)
+            for m in list(client.objecter.messenger._out.values()):
+                await m.close()
+            client.objecter.messenger._out.clear()
+            assert await io.read("obj", timeout=30) == b"before-expiry"
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_caps_enforced_non_admin_cannot_mutate_mon():
+    """A plain client entity gets mon 'r' caps: reads/subscriptions work
+    but pool creation is EPERM (MonCap analog)."""
+    async def scenario():
+        cluster = await start_cluster(2, config=_cephx_config())
+        try:
+            admin = await cluster.client()
+            pool = await admin.pool_create("capspool", "replicated",
+                                           pg_num=4, size=2)
+            plain = await cluster.client("plainuser")
+            # osd rw allowed for plain clients
+            pio = plain.ioctx(pool)
+            await pio.write_full("obj", b"plain-write-ok")
+            assert await pio.read("obj") == b"plain-write-ok"
+            # mon mutation refused
+            with pytest.raises(Exception) as ei:
+                await plain.pool_create("forbidden", "replicated",
+                                        pg_num=4, size=2)
+            assert "EPERM" in str(ei.value) or "-1" in str(ei.value)
+        finally:
+            await cluster.stop()
+
+    run(scenario())

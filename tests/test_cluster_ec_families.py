@@ -8,6 +8,7 @@ plugin and reads back with injected chunk deletion).
 
 import asyncio
 
+from tests._flaky import contention_retry
 import pytest
 
 from ceph_tpu.cluster.vstart import _fast_config, start_cluster
@@ -21,6 +22,7 @@ def _coll(pgid):
     return f"pg_{pgid.pool}_{pgid.seed}"
 
 
+@contention_retry()
 def test_lrc_pool_end_to_end():
     async def scenario():
         cluster = await start_cluster(8)

@@ -1,0 +1,168 @@
+"""MDS daemon: server-side metadata authority, journal replay, leases
+(round-4 item 7).
+
+Reference: MDSRank (src/mds/MDSRank.cc) request serving + boot replay,
+MDLog write-ahead journaling (src/mds/journal.cc), Locker caps/leases
+(src/mds/Locker.cc).  Single active MDS; the cls-atomic dirfrag engine
+(cluster/fs.py) stays the storage layer underneath.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.cluster.mds import JOURNAL_OID, MDSClient
+from ceph_tpu.cluster.vstart import start_cluster
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _fs_cluster():
+    cluster = await start_cluster(3)
+    admin = await cluster.client()
+    meta = await admin.pool_create("fsmeta", "replicated", pg_num=8, size=2)
+    data = await admin.pool_create("fsdata", "replicated", pg_num=8, size=2)
+    await cluster.start_mds(meta, data)
+    # wait for the MDS registration to reach the map
+    for _ in range(100):
+        await admin.objecter._refresh_map()
+        if getattr(admin.objecter.osdmap, "mds_addr", None):
+            break
+        await asyncio.sleep(0.05)
+    return cluster, admin, meta, data
+
+
+def test_mds_namespace_and_file_io():
+    async def scenario():
+        cluster, admin, meta, data = await _fs_cluster()
+        try:
+            fs = MDSClient(admin, data)
+            await fs.mkdir("/dir")
+            await fs.create("/dir/file")
+            payload = b"mds-routed-metadata, direct data" * 100
+            await fs.write("/dir/file", 0, payload)
+            assert await fs.read("/dir/file") == payload
+            st = await fs.stat("/dir/file")
+            assert st.size == len(payload)
+            assert await fs.listdir("/dir") == ["file"]
+            await fs.rename("/dir/file", "/dir/renamed")
+            assert await fs.listdir("/dir") == ["renamed"]
+            assert await fs.read("/dir/renamed") == payload
+            await fs.unlink("/dir/renamed")
+            with pytest.raises(FileNotFoundError):
+                await fs.stat("/dir/renamed")
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_two_clients_coherent_under_concurrency():
+    """Two clients hammer the same directory with creates + renames; the
+    MDS serializes them — every op lands exactly once, names never
+    duplicate or vanish (the round-4 'Done' gate for item 7)."""
+    async def scenario():
+        cluster, admin, meta, data = await _fs_cluster()
+        try:
+            c2 = await cluster.client("second")
+            fs1 = MDSClient(admin, data)
+            fs2 = MDSClient(c2, data)
+            await fs1.mkdir("/race")
+
+            async def creator(fs, tag, n):
+                made = []
+                for i in range(n):
+                    try:
+                        await fs.create(f"/race/{tag}{i}")
+                        made.append(f"{tag}{i}")
+                    except FileExistsError:
+                        pass
+                return made
+
+            made1, made2 = await asyncio.gather(
+                creator(fs1, "a", 8), creator(fs2, "b", 8))
+            # exclusive-create semantics survived concurrency
+            names = set(await fs1.listdir("/race"))
+            assert set(made1) | set(made2) <= names
+            assert len(names) == len(made1) + len(made2)
+            # concurrent rename racing a create of the same target:
+            # exactly one wins, nothing is lost
+            r1 = fs1.rename("/race/a0", "/race/target")
+            r2 = fs2.rename("/race/b0", "/race/target")
+            results = await asyncio.gather(r1, r2, return_exceptions=True)
+            fs1._lease.clear()
+            names = set(await fs1.listdir("/race"))
+            assert "target" in names
+            survivors = {"a0", "b0"} & names
+            failures = [r for r in results if isinstance(r, Exception)]
+            # one rename won; the loser either failed loudly or
+            # overwrote (last-writer-wins rename both being legal), but
+            # no name may silently duplicate
+            assert len(survivors) + 1 + len(names - {"target", "a0", "b0"}) \
+                == len(names)
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_mds_restart_replays_journal():
+    """Kill the MDS after journal append but before dirfrag apply; the
+    restarted MDS must replay the event (MDSRank boot replay)."""
+    async def scenario():
+        cluster, admin, meta, data = await _fs_cluster()
+        try:
+            fs = MDSClient(admin, data)
+            await fs.mkdir("/jd")
+            await fs.create("/jd/before")
+            # forge a journalled-but-unapplied event, as a crash between
+            # append and apply would leave it
+            mds = cluster.mds
+            import pickle
+
+            seq = mds._seq + 1
+            await mds._journal_append(seq, ("create", "/jd/orphan"))
+            await mds.stop()
+
+            await cluster.start_mds(meta, data)
+            for _ in range(100):
+                await admin.objecter._refresh_map()
+                a = getattr(admin.objecter.osdmap, "mds_addr", None)
+                if a and tuple(a) == tuple(cluster.mds_addr):
+                    break
+                await asyncio.sleep(0.05)
+            fs2 = MDSClient(admin, data)
+            names = set(await fs2.listdir("/jd"))
+            assert "orphan" in names, "journal replay missed the event"
+            assert "before" in names
+            # the replayed event is applied-through (no double replay)
+            assert cluster.mds._seq >= seq
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_mds_lease_caching():
+    """stat/listdir replies carry a lease: repeated lookups inside the
+    TTL are served from the client cache; mutations invalidate it."""
+    async def scenario():
+        cluster, admin, meta, data = await _fs_cluster()
+        try:
+            fs = MDSClient(admin, data)
+            await fs.mkdir("/ld")
+            await fs.create("/ld/f")
+            before = cluster.mds.perf.get("mds_requests")
+            for _ in range(5):
+                await fs.stat("/ld/f")     # leased: one round-trip only
+            mid = cluster.mds.perf.get("mds_requests")
+            assert mid == before + 1
+            await fs.create("/ld/g")        # mutation drops the lease
+            await fs.listdir("/ld")
+            assert cluster.mds.perf.get("mds_requests") > mid
+        finally:
+            await cluster.stop()
+
+    run(scenario())

@@ -7,6 +7,7 @@ toward idempotent handlers.
 
 import asyncio
 
+from tests._flaky import contention_retry
 import pytest
 
 from ceph_tpu.cluster.messenger import (
@@ -122,6 +123,7 @@ def test_unreachable_peer_raises_after_retries():
     run(scenario())
 
 
+@contention_retry()
 def test_ec_write_survives_connection_drops():
     """Cluster-level: EC writes while the primary's osd-osd connections
     are repeatedly hard-dropped — no silent shard divergence: every

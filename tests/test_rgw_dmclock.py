@@ -6,6 +6,7 @@ src/dmclock/ + mClock queues (reservation/weight/limit tags).
 
 import asyncio
 
+from tests._flaky import contention_retry
 import pytest
 
 from ceph_tpu.cluster.dmclock import DmClockQueue, QoSSpec
@@ -123,6 +124,7 @@ def test_dmclock_weight_proportionality():
     assert heavy > light * 1.8, (heavy, light)
 
 
+@contention_retry()
 def test_mclock_op_queue_in_osd():
     """osd_op_queue=mclock: client ops flow through the dmClock queue;
     a limited client is throttled while an unlimited one proceeds."""
