@@ -237,8 +237,8 @@ class ECBackendMixin:
             if msg.shard == -1:
                 # whole-object fetch (pull recovery): carry xattrs so the
                 # puller stores a faithful copy
-                o = self.store._colls.get(_coll(msg.pgid), {}).get(msg.oid)
-                hinfo["xattrs"] = dict(o.xattrs) if o else {}
+                hinfo["xattrs"] = dict(self.store.get_xattrs(
+                    _coll(msg.pgid), msg.oid))
             await conn.send(M.MOSDECSubOpReadReply(
                 reqid=msg.reqid, result=0, shard=shard, data=data,
                 hinfo=hinfo))

@@ -56,7 +56,13 @@ def load_map(path: str) -> CrushMap:
     blob = open(path, "rb").read()
     if blob[:1] in (b"{", b"["):
         return map_from_json(json.loads(blob))
-    return pickle.loads(blob)
+    if blob[:1] == b"\x80":
+        # pickle protocol 2+ magic: the binary map form
+        return pickle.loads(blob)
+    # anything else textual is the operator map language
+    from ceph_tpu.crush.compiler import compile_text
+
+    return compile_text(blob.decode())
 
 
 def main(argv=None) -> int:
@@ -64,9 +70,13 @@ def main(argv=None) -> int:
     ap.add_argument("-i", "--infn", help="input map (json or pickled)")
     ap.add_argument("-o", "--outfn", help="output file")
     ap.add_argument("--compile", action="store_true",
-                    help="json map -> pickled binary map")
+                    help="text/json map -> pickled binary map "
+                         "(crushtool -c)")
     ap.add_argument("--decompile", action="store_true",
-                    help="pickled binary map -> json")
+                    help="binary map -> operator TEXT map (crushtool -d; "
+                         "--json for the json form)")
+    ap.add_argument("--json", action="store_true",
+                    help="decompile to json instead of the text language")
     ap.add_argument("--test", action="store_true",
                     help="batch placement test (CrushTester)")
     ap.add_argument("--rule", type=int, default=0)
@@ -86,7 +96,12 @@ def main(argv=None) -> int:
             pickle.dump(cmap, f)
         return 0
     if args.decompile:
-        out = json.dumps(map_to_json(cmap), indent=2)
+        if args.json:
+            out = json.dumps(map_to_json(cmap), indent=2)
+        else:
+            from ceph_tpu.crush.compiler import decompile
+
+            out = decompile(cmap)
         if args.outfn:
             open(args.outfn, "w").write(out)
         else:

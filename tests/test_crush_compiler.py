@@ -1,0 +1,132 @@
+"""CRUSH text-map compiler/decompiler (round-4, VERDICT r3 missing #8).
+
+Reference: src/crush/CrushCompiler.cc — the `crushtool -c`/`-d`
+operator map language.  Round-trip fidelity is the gate: decompile ->
+compile must reproduce identical PLACEMENTS (the semantics operators
+care about), and a hand-written text map must compile and place.
+"""
+
+import subprocess
+import sys
+
+import pytest
+
+from ceph_tpu.crush.compiler import compile_text, decompile
+from ceph_tpu.crush.scalar import ScalarMapper
+from ceph_tpu.crush.types import build_hierarchy
+
+TEXT_MAP = """
+# begin crush map
+tunable choose_total_tries 50
+tunable chooseleaf_descend_once 1
+tunable chooseleaf_vary_r 1
+tunable chooseleaf_stable 1
+
+# devices
+device 0 osd.0
+device 1 osd.1 class ssd
+device 2 osd.2
+device 3 osd.3 class ssd
+
+# types
+type 0 osd
+type 1 host
+type 3 root
+
+# buckets
+host host0 {
+    id -1
+    alg straw2
+    hash 0
+    item osd.0 weight 1.000
+    item osd.1 weight 1.000
+}
+host host1 {
+    id -2
+    alg straw2
+    hash 0
+    item osd.2 weight 1.000
+    item osd.3 weight 2.000
+}
+root default {
+    id -3
+    alg straw2
+    hash 0
+    item host0 weight 2.000
+    item host1 weight 3.000
+}
+
+# rules
+rule replicated_rule {
+    ruleset 0
+    type replicated
+    min_size 1
+    max_size 10
+    step take default
+    step chooseleaf firstn 0 type host
+    step emit
+}
+# end crush map
+"""
+
+
+def _placements(cmap, ruleno=0, n=200, numrep=2):
+    sm = ScalarMapper(cmap)
+    w = [0x10000] * cmap.max_devices
+    return [sm.do_rule(ruleno, x, numrep, w) for x in range(n)]
+
+
+def test_compile_hand_written_text_map():
+    cmap = compile_text(TEXT_MAP)
+    assert cmap.max_devices == 4
+    assert cmap.device_class == {1: "ssd", 3: "ssd"}
+    assert cmap.tunables.choose_total_tries == 50
+    assert set(cmap.item_names.values()) == {"host0", "host1", "default"}
+    assert cmap.buckets[-2].weights == [0x10000, 0x20000]
+    maps = _placements(cmap)
+    for m in maps:
+        assert len(m) == 2
+        # chooseleaf host: replicas land on distinct hosts
+        assert ({m[0]} <= {0, 1}) != ({m[1]} <= {0, 1})
+
+
+def test_round_trip_preserves_placements():
+    cmap, ruleno = build_hierarchy(n_hosts=6, osds_per_host=3, numrep=3)
+    text = decompile(cmap)
+    cmap2 = compile_text(text)
+    sm1 = _placements(cmap, ruleno, 300, 3)
+    sm2 = _placements(cmap2, ruleno, 300, 3)
+    assert sm1 == sm2, "round-tripped map changed placements"
+    # and the text itself is stable across a second round trip
+    assert decompile(cmap2) == text
+
+
+def test_compile_rejects_bad_maps():
+    with pytest.raises(ValueError):
+        compile_text("tunable bogus_knob 1\n")
+    with pytest.raises(ValueError):
+        compile_text(TEXT_MAP.replace("step take default",
+                                      "step take nonexistent"))
+    with pytest.raises(ValueError):
+        compile_text(TEXT_MAP.replace("alg straw2", "alg quantum"))
+
+
+def test_crushtool_text_cli(tmp_path):
+    src = tmp_path / "map.txt"
+    src.write_text(TEXT_MAP)
+    binfn = tmp_path / "map.bin"
+    out = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.tools.crushtool",
+         "-i", str(src), "-o", str(binfn), "--compile"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    out = subprocess.run(
+        [sys.executable, "-m", "ceph_tpu.tools.crushtool",
+         "-i", str(binfn), "--decompile"],
+        capture_output=True, text=True, timeout=300)
+    assert out.returncode == 0, out.stderr
+    assert "step chooseleaf firstn 0 type host" in out.stdout
+    assert "device 1 osd.1 class ssd" in out.stdout
+    # the decompiled text recompiles to the same placements
+    cmap2 = compile_text(out.stdout)
+    assert _placements(cmap2) == _placements(compile_text(TEXT_MAP))
