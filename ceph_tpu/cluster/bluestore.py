@@ -95,7 +95,9 @@ class BlueStore(ObjectStore):
                  checkpoint_every: int = 512, fsync: bool = False):
         self.path = path
         self.device_size = size
-        self.n_blocks = size // BLOCK
+        # the superblock region is reserved: allocatable blocks must all
+        # land INSIDE the declared device size
+        self.n_blocks = max(0, size // BLOCK - SUPER_BLOCKS)
         self.fsync = fsync
         self.checkpoint_every = checkpoint_every
         self._onodes: Dict[str, Dict[str, Onode]] = {}   # coll -> oid -> onode
@@ -223,6 +225,13 @@ class BlueStore(ObjectStore):
         if not self._mounted:
             raise RuntimeError("BlueStore not mounted")
         with self._lock:
+            # up-front capacity check: a mid-transaction ENOSPC would
+            # leave half-applied onode state with no rollback, which the
+            # next checkpoint would bless as committed truth
+            need = self._txn_block_cost(txn)
+            if need > self.alloc.n_free:
+                raise OSError(28, f"ENOSPC: txn needs {need} blocks, "
+                                  f"{self.alloc.n_free} free")
             # apply (COW into fresh blocks) then WAL-commit the txn;
             # crash replay re-applies idempotently over fresh blocks
             for op in txn.ops:
@@ -236,6 +245,24 @@ class BlueStore(ObjectStore):
         self._since_ckpt += 1
         if self._since_ckpt >= self.checkpoint_every:
             self.checkpoint()
+
+    def _txn_block_cost(self, txn: Transaction) -> int:
+        """Worst-case fresh-block demand of a transaction (write ops COW
+        every touched block; clones copy the whole source)."""
+        need = 0
+        for op in txn.ops:
+            if op[0] == "write":
+                _, _, _, offset, data = op
+                if data:
+                    need += (offset + len(data) - 1) // BLOCK \
+                        - offset // BLOCK + 1
+            elif op[0] == "truncate":
+                need += 1                       # partial-tail rewrite
+            elif op[0] == "clone":
+                src = self._onodes.get(op[1], {}).get(op[2])
+                if src is not None:
+                    need += sum(1 for b in src.blocks if b >= 0)
+        return need
 
     def _coll(self, coll: str) -> Dict[str, Onode]:
         return self._onodes.setdefault(coll, {})
@@ -271,7 +298,17 @@ class BlueStore(ObjectStore):
         elif kind == "rb_capture":
             _, coll, oid, rb_oid, key = op
             o = self._coll(coll).get(oid)
-            data = self._read_all(coll, oid, o) if o is not None else b""
+            try:
+                data = self._read_all(coll, oid, o) if o is not None \
+                    else b""
+            except IOError:
+                if not replay:
+                    raise
+                # replay over blocks a later pre-crash txn reused: the
+                # record is unrecoverable, but a dead rollback record
+                # must not make the store unmountable
+                data = b""
+                o = None
             rec = {
                 "oid": oid, "existed": o is not None, "chunk_off": 0,
                 "old_range": data,

@@ -152,14 +152,12 @@ class Monitor(Dispatcher):
     def _placement_path(m) -> str:
         """'batched' when the map's shape runs on the TensorMapper, else
         'scalar_fallback(<why>)' — the operator-visible answer to "is my
-        1M-PG map silently a Python loop?"."""
-        try:
-            _ = m.tensor_mapper
-            return "batched"
-        except (NotImplementedError, AssertionError) as e:
-            return f"scalar_fallback({e})"
-        except Exception as e:  # device init failure etc.
-            return f"unknown({type(e).__name__})"
+        1M-PG map silently a Python loop?".  Uses the cheap shape probe:
+        status must never build device tables inside the mon loop."""
+        from ceph_tpu.crush.mapper import TensorMapper
+
+        why = TensorMapper.unsupported_reason(m.crush)
+        return "batched" if why is None else f"scalar_fallback({why})"
 
     # -- cephx ticket service ---------------------------------------------
 

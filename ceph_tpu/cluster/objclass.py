@@ -177,3 +177,30 @@ def _dirfrag_link(ctx: MethodContext, indata: bytes) -> bytes:
         raise ClsError(-17, "dentry exists")  # EEXIST
     ctx.omap_set({req["name"]: req["value"]})
     return b""
+
+@register("rbd_journal", "append")
+def _rbd_journal_append(ctx: MethodContext, indata: bytes) -> bytes:
+    """Atomic journal append (reference cls_journal): allocate the next
+    sequence under PG serialization and store the event at it, so two
+    racing writers can never claim the same journal slot."""
+    import pickle as _p
+
+    omap = ctx.omap_get()
+    seq = int(omap.get("_head", b"0")) + 1
+    ctx.omap_set({"_head": str(seq).encode(),
+                  f"{seq:016d}": indata})
+    return str(seq).encode()
+
+
+@register("rbd_journal", "trim")
+def _rbd_journal_trim(ctx: MethodContext, indata: bytes) -> bytes:
+    """Drop entries at or below the committed position (reference
+    cls_journal client-commit + trim)."""
+    upto = int(indata)
+    omap = ctx.omap_get()
+    dead = [k for k in omap
+            if not k.startswith("_") and int(k) <= upto]
+    if dead:
+        ctx.omap_rmkeys(dead)
+    return str(len(dead)).encode()
+

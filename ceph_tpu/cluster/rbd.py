@@ -39,6 +39,10 @@ class ImageHeader:
     # clone parentage (librbd parent_info): (parent image, parent snapid);
     # reads of unwritten child extents fall through to the parent snap
     parent: Optional[tuple] = None
+    # journaling feature (reference RBD_FEATURE_JOURNALING,
+    # src/journal/): mutations append to the image journal BEFORE the
+    # data write, so rbd-mirror can replay them elsewhere
+    journaling: bool = False
 
 
 class RBD:
@@ -54,12 +58,14 @@ class RBD:
     async def create(self, name: str, size: int,
                      stripe_unit: int = 1 << 20,
                      stripe_count: int = 1,
-                     object_size: int = 1 << 22) -> None:
+                     object_size: int = 1 << 22,
+                     journaling: bool = False) -> None:
         layout = FileLayout(stripe_unit=stripe_unit,
                             stripe_count=stripe_count,
                             object_size=object_size)
         layout.validate()
-        hdr = ImageHeader(name=name, size=size, layout=layout)
+        hdr = ImageHeader(name=name, size=size, layout=layout,
+                          journaling=journaling)
         try:
             await self.ioctx.stat(self._header_oid(name))
             raise FileExistsError(name)
@@ -71,6 +77,13 @@ class RBD:
     async def remove(self, name: str) -> None:
         img = await self.open(name)
         await img._remove_data()
+        try:
+            # the image journal dies with the image, or a recreated
+            # same-name image would inherit (and mirrors would replay)
+            # the dead image's events
+            await self.ioctx.remove(f"rbd_journal.{name}")
+        except FileNotFoundError:
+            pass
         await self.ioctx.remove(self._header_oid(name))
 
     async def list(self) -> List[str]:
@@ -136,6 +149,24 @@ class Image:
         else:
             self._io._snapc = None
 
+    # -- image journal (reference src/journal JournalRecorder) -------------
+
+    @property
+    def _journal_oid(self) -> str:
+        return f"rbd_journal.{self.header.name}"
+
+    async def _journal_event(self, event: tuple) -> None:
+        """Append one replayable event BEFORE applying it (the librbd
+        journaling contract: the journal is authoritative for replay)."""
+        if not self.header.journaling:
+            return
+        reply = await self._io.objecter.op_submit(
+            self._io.pool_id, self._journal_oid,
+            [("exec", {"cls": "rbd_journal", "method": "append",
+                       "indata": pickle.dumps(event)})])
+        if reply.result != 0:
+            raise IOError(f"journal append -> {reply.result}")
+
     async def _get_parent(self) -> Optional["Image"]:
         if self.header.parent is None:
             return None
@@ -157,6 +188,7 @@ class Image:
         """Grow or shrink; shrinking removes whole dead OBJECT SETS and
         zeroes the partially-live tail, so a later grow reads zeros, not
         resurrected bytes (librbd resize + trim)."""
+        await self._journal_event(("resize", new_size))
         old = self.header.size
         if new_size < old:
             layout = self.header.layout
@@ -167,7 +199,8 @@ class Image:
             tail_end = min(old, live_sets * period)
             if tail_end > new_size:
                 zeros = b"\0" * (tail_end - new_size)
-                await self.write(new_size, zeros, _size_check=old)
+                await self.write(new_size, zeros, _size_check=old,
+                                 _journal=False)
             # drop every object of fully-dead sets (through the snapc io:
             # a snapshotted image's shrink must clone-on-write, so snaps
             # keep reading the pre-shrink bytes)
@@ -207,10 +240,17 @@ class Image:
     # -- data path ----------------------------------------------------------
 
     async def write(self, offset: int, data: bytes,
-                    _size_check: int = None) -> None:
+                    _size_check: int = None,
+                    _journal: bool = True) -> None:
         limit = self.header.size if _size_check is None else _size_check
         if offset + len(data) > limit:
             raise ValueError("write past end of image")
+        if _journal:
+            # internal writes (resize tail-zeroing) must NOT journal:
+            # they are implied by the journaled resize event, and their
+            # pre-shrink offsets would make the mirror re-grow the
+            # secondary past the shrunken size
+            await self._journal_event(("write", offset, bytes(data)))
         extents = file_to_extents(self._fmt, self.header.layout,
                                   offset, len(data))
         per_object = StripedReader.scatter(extents, data)

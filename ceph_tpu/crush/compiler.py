@@ -170,27 +170,30 @@ def compile_text(text: str) -> CrushMap:
             type_by_name[tname] = int(tid)
             i += 1
         elif line.startswith("rule ") and line.endswith("{"):
-            body = []
-            i += 1
-            while lines[i] != "}":
-                body.append(lines[i])
-                i += 1
-            i += 1
+            body, i = _block(lines, i, line)
             _parse_rule(cmap, body, name_to_id)
         else:
             m = re.match(r"^(\S+)\s+(\S+)\s*\{$", line)
             if m is None:
                 raise ValueError(f"cannot parse line: {line!r}")
             tname, bname = m.group(1), m.group(2)
-            body = []
-            i += 1
-            while lines[i] != "}":
-                body.append(lines[i])
-                i += 1
-            i += 1
+            body, i = _block(lines, i, line)
             _parse_bucket(cmap, tname, bname, body, name_to_id,
                           type_by_name)
     return cmap
+
+
+def _block(lines: List[str], i: int, opener: str) -> Tuple[List[str], int]:
+    """Collect the body of a { } block; a hand-edited map missing its
+    closing brace must fail as a parse error, not an IndexError."""
+    body: List[str] = []
+    i += 1
+    while i < len(lines) and lines[i] != "}":
+        body.append(lines[i])
+        i += 1
+    if i >= len(lines):
+        raise ValueError(f"unterminated block: {opener!r} has no '}}'")
+    return body, i + 1
 
 
 def _parse_bucket(cmap, tname, bname, body, name_to_id, type_by_name):
@@ -262,7 +265,11 @@ def _parse_rule(cmap, body, name_to_id):
                 mode = parts[2]          # firstn | indep
                 n = int(parts[3])
                 tname = parts[5]         # "type" at parts[4]
-                tid = {v: k for k, v in cmap.type_names.items()}[tname]
+                by_name = {v: k for k, v in cmap.type_names.items()}
+                if tname not in by_name:
+                    raise ValueError(
+                        f"step references undeclared type {tname!r}")
+                tid = by_name[tname]
                 op = {
                     ("choose", "firstn"): RULE_CHOOSE_FIRSTN,
                     ("choose", "indep"): RULE_CHOOSE_INDEP,

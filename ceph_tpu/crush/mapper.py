@@ -56,6 +56,23 @@ def _split_u64(vals) -> Tuple[np.ndarray, np.ndarray]:
 
 
 class TensorMapper:
+    @staticmethod
+    def unsupported_reason(cmap: CrushMap):
+        """Cheap shape probe: None when this map can run vectorized,
+        else the rejection reason — the SAME conditions __init__
+        enforces, minus the array/device construction (mon `status`
+        answers placement_path with this, not a full build)."""
+        t = cmap.tunables
+        if t.choose_local_tries or t.choose_local_fallback_tries:
+            return "legacy tunables (local retries)"
+        ids = sorted(cmap.buckets, reverse=True)
+        if ids != [-1 - i for i in range(len(ids))]:
+            return "sparse bucket ids"
+        for b in cmap.buckets.values():
+            if b.alg != "straw2":
+                return f"non-straw2 bucket ({b.alg})"
+        return None
+
     def __init__(self, cmap: CrushMap, chunk: int = 1 << 16):
         self.map = cmap
         self.chunk = chunk

@@ -1,0 +1,112 @@
+"""RBD journaling + rbd-mirror replication (round-4, VERDICT r3
+missing #10; reference src/journal/ + src/tools/rbd_mirror/)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.cluster.rbd import RBD
+from ceph_tpu.cluster.rbd_mirror import MirrorDaemon
+from ceph_tpu.cluster.vstart import start_cluster
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _pools(cluster):
+    client = await cluster.client()
+    a = await client.pool_create("site_a", "replicated", pg_num=8, size=2)
+    b = await client.pool_create("site_b", "replicated", pg_num=8, size=2)
+    return client, a, b
+
+
+def test_journal_records_and_mirror_replays():
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client, a, b = await _pools(cluster)
+            rbd_a = RBD(client.ioctx(a))
+            await rbd_a.create("img", size=1 << 20, journaling=True)
+            img = await rbd_a.open("img")
+            blob1 = bytes(range(256)) * 64
+            await img.write(4096, blob1)
+            await img.write(100_000, b"tail" * 50)
+
+            mirror = MirrorDaemon(client.ioctx(a), client.ioctx(b))
+            applied = await mirror.sync_once()
+            assert applied == 2
+            rbd_b = RBD(client.ioctx(b))
+            mirrored = await rbd_b.open("img")
+            assert await mirrored.read(4096, len(blob1)) == blob1
+            assert await mirrored.read(100_000, 200) == b"tail" * 50
+            # committed position trimmed the source journal
+            omap = await client.ioctx(a).omap_get("rbd_journal.img")
+            assert [k for k in omap if not k.startswith("_")] == []
+            # idempotent: nothing new -> nothing replayed
+            assert await mirror.sync_once() == 0
+
+            # continuous replication incl. resize
+            await img.resize(2 << 20)
+            await img.write((1 << 20) + 5000, b"grown!" * 10)
+            assert await mirror.sync_once() == 2
+            mirrored = await rbd_b.open("img")
+            assert mirrored.size() == 2 << 20
+            assert await mirrored.read((1 << 20) + 5000, 60) == \
+                b"grown!" * 10
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_mirror_daemon_background_catchup():
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client, a, b = await _pools(cluster)
+            rbd_a = RBD(client.ioctx(a))
+            await rbd_a.create("live", size=1 << 20, journaling=True)
+            img = await rbd_a.open("live")
+            mirror = MirrorDaemon(client.ioctx(a), client.ioctx(b),
+                                  poll_interval=0.05)
+            mirror.start()
+            payloads = []
+            for i in range(5):
+                p = f"gen{i}-".encode() * 100
+                await img.write(i * 10_000, p)
+                payloads.append((i * 10_000, p))
+                await asyncio.sleep(0.02)
+            # the daemon catches up on its own
+            for _ in range(100):
+                if mirror.replayed >= 5:
+                    break
+                await asyncio.sleep(0.05)
+            await mirror.stop()
+            rbd_b = RBD(client.ioctx(b))
+            mirrored = await rbd_b.open("live")
+            for off, p in payloads:
+                assert await mirrored.read(off, len(p)) == p, off
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_unjournaled_image_not_mirrored():
+    async def scenario():
+        cluster = await start_cluster(2)
+        try:
+            client, a, b = await _pools(cluster)
+            rbd_a = RBD(client.ioctx(a))
+            await rbd_a.create("plain", size=1 << 20)   # no journaling
+            img = await rbd_a.open("plain")
+            await img.write(0, b"local-only")
+            mirror = MirrorDaemon(client.ioctx(a), client.ioctx(b))
+            assert await mirror.sync_once() == 0
+            with pytest.raises(FileNotFoundError):
+                await RBD(client.ioctx(b)).open("plain")
+        finally:
+            await cluster.stop()
+
+    run(scenario())
