@@ -356,12 +356,17 @@ class BitmatrixCodec(MatrixCodec):
 
     def _encode_bits(self) -> np.ndarray:
         """(m*w, k*w) GF(2) encode matrix."""
-        return gf8.expand_bitmatrix(self.engine.coding)
+        if self.w == 8:
+            return gf8.expand_bitmatrix(self.engine.coding)
+        return gfw.expand_bitmatrix_w(self.engine.coding, self.w)
 
     def _decode_bits(self, src: Tuple[int, ...],
                      out: Tuple[int, ...]) -> np.ndarray:
         """(len(out)*w, k*w) GF(2) recovery matrix over the src chunks."""
-        return gf8.expand_bitmatrix(self.engine.decode_matrix(src, out))
+        rows = self.engine.decode_matrix(src, out)
+        if self.w == 8:
+            return gf8.expand_bitmatrix(rows)
+        return gfw.expand_bitmatrix_w(rows, self.w)
 
     # -- packet layout ------------------------------------------------------
 

@@ -8,7 +8,8 @@ selection by profile, per-technique alignment/chunk-size rules
 
 Techniques: reed_sol_van, reed_sol_r6_op (bytewise matrix codes, w in
 {8, 16, 32} over gf-complete's default polynomials), cauchy_orig,
-cauchy_good (packet-interleaved bit-matrix codes, w=8), liberation,
+cauchy_good (packet-interleaved bit-matrix codes, w in {8,16,32}),
+liberation,
 blaum_roth, liber8tion (native minimal-density GF(2) bit-matrices with
 packetsize semantics — see ceph_tpu.ec.liberation for the constructions
 and the liber8tion byte-compat caveat).
@@ -135,8 +136,9 @@ class Cauchy(BitmatrixCodec, ErasureCodeJerasure):
         self.per_chunk_alignment = self.to_bool(
             "jerasure-per-chunk-alignment", profile, "false"
         )
-        if self.w != 8:
-            raise NotImplementedError("tpu cauchy supports w=8")
+        if self.w not in (8, 16, 32):
+            raise ECError(errno.EINVAL,
+                          "tpu cauchy supports w in {8, 16, 32}")
         if self.packetsize <= 0 or self.packetsize % 4:
             raise ECError(errno.EINVAL, "packetsize must be a positive multiple of 4")
 
@@ -160,14 +162,19 @@ class CauchyOrig(Cauchy):
     variant = "orig"
 
     def build_coding_matrix(self) -> np.ndarray:
-        return matrices.cauchy_original_coding_matrix(self.k, self.m)
+        if self.w == 8:
+            return matrices.cauchy_original_coding_matrix(self.k, self.m)
+        return matrices.cauchy_original_coding_matrix_w(
+            self.k, self.m, self.w)
 
 
 class CauchyGood(Cauchy):
     variant = "good"
 
     def build_coding_matrix(self) -> np.ndarray:
-        return matrices.cauchy_good_coding_matrix(self.k, self.m)
+        if self.w == 8:
+            return matrices.cauchy_good_coding_matrix(self.k, self.m)
+        return matrices.cauchy_good_coding_matrix_w(self.k, self.m, self.w)
 
 
 def _is_prime(n: int) -> bool:

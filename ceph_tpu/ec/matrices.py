@@ -144,6 +144,54 @@ def cauchy_good_coding_matrix(k: int, m: int) -> np.ndarray:
     return mat
 
 
+def cauchy_original_coding_matrix_w(k: int, m: int, w: int) -> np.ndarray:
+    """Wide-field cauchy_orig: matrix[i][j] = 1/(i ^ (m+j)) over GF(2^w)
+    (jerasure cauchy.c, any w)."""
+    from ceph_tpu.ops import gfw
+
+    if k + m > (1 << w):
+        raise ValueError(f"k+m must be <= 2^{w}")
+    f = gfw.field(w)
+    mat = np.zeros((m, k), dtype=np.uint64)
+    for i in range(m):
+        for j in range(k):
+            mat[i, j] = f.inv(i ^ (m + j))
+    return mat
+
+
+def cauchy_good_coding_matrix_w(k: int, m: int, w: int) -> np.ndarray:
+    """Wide-field cauchy_good: the SAME ones-minimization as the w=8
+    version, counted over the w x w bit-matrices."""
+    from ceph_tpu.ops import gfw
+
+    f = gfw.field(w)
+
+    def n_ones(x: int) -> int:
+        return int(f.bitmat(int(x)).sum())
+
+    mat = cauchy_original_coding_matrix_w(k, m, w)
+    for j in range(k):
+        if mat[0, j] != 1:
+            inv = f.inv(int(mat[0, j]))
+            for i in range(m):
+                mat[i, j] = f.mul(int(mat[i, j]), inv)
+    for i in range(1, m):
+        best = sum(n_ones(int(e)) for e in mat[i])
+        best_j = -1
+        for j in range(k):
+            if mat[i, j] != 1:
+                inv = f.inv(int(mat[i, j]))
+                total = sum(n_ones(f.mul(int(e), inv)) for e in mat[i])
+                if total < best:
+                    best = total
+                    best_j = j
+        if best_j != -1:
+            inv = f.inv(int(mat[i, best_j]))
+            for j in range(k):
+                mat[i, j] = f.mul(int(mat[i, j]), inv)
+    return mat
+
+
 def isa_rs_matrix(k: int, m: int) -> np.ndarray:
     """(m, k) parity rows of ISA-L gf_gen_rs_matrix: row r = [g^0..g^(k-1)],
     g = 2^r.  Row 0 is all ones (the XOR special case the reference keeps,

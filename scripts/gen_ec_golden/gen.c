@@ -286,6 +286,78 @@ static void reed_sol_r6_matrix_w(int k, int w, uint64_t *coding) {
     }
 }
 
+/* wide-field cauchy (jerasure cauchy.c over GF(2^w)) */
+static void cauchy_orig_matrix_w(int k, int m, int w, uint64_t *coding) {
+    int i, j;
+    for (i = 0; i < m; i++)
+        for (j = 0; j < k; j++)
+            coding[i * k + j] = gfw_inv(w, (uint64_t)(i ^ (m + j)));
+}
+
+static int n_ones_w(int w, uint64_t a) {
+    int u, t, n = 0;
+    for (u = 0; u < w; u++) {
+        uint64_t col = gfw_mul(w, a, (uint64_t)1 << u);
+        for (t = 0; t < w; t++) n += (int)((col >> t) & 1);
+    }
+    return n;
+}
+
+static void cauchy_good_matrix_w(int k, int m, int w, uint64_t *coding) {
+    int i, j;
+    cauchy_orig_matrix_w(k, m, w, coding);
+    for (j = 0; j < k; j++) {
+        if (coding[0 * k + j] != 1) {
+            uint64_t inv = gfw_inv(w, coding[0 * k + j]);
+            for (i = 0; i < m; i++)
+                coding[i * k + j] = gfw_mul(w, coding[i * k + j], inv);
+        }
+    }
+    for (i = 1; i < m; i++) {
+        int best = 0, best_j = -1;
+        for (j = 0; j < k; j++) best += n_ones_w(w, coding[i * k + j]);
+        for (j = 0; j < k; j++) {
+            if (coding[i * k + j] != 1) {
+                uint64_t inv = gfw_inv(w, coding[i * k + j]);
+                int total = 0, jj;
+                for (jj = 0; jj < k; jj++)
+                    total += n_ones_w(
+                        w, gfw_mul(w, coding[i * k + jj], inv));
+                if (total < best) { best = total; best_j = j; }
+            }
+        }
+        if (best_j != -1) {
+            uint64_t inv = gfw_inv(w, coding[i * k + best_j]);
+            for (j = 0; j < k; j++)
+                coding[i * k + j] = gfw_mul(w, coding[i * k + j], inv);
+        }
+    }
+}
+
+/* packet-interleaved bit-matrix encode from a GF(2^w) word matrix */
+static void bitmatrix_encode_ww(const uint64_t *mat, int k, int m, int w,
+                                int ps, uint8_t **data, uint8_t **parity,
+                                int size) {
+    int sb = w * ps;
+    int ns = size / sb;
+    int i, t, j, u, s, b;
+    for (i = 0; i < m; i++)
+        for (t = 0; t < w; t++)
+            for (s = 0; s < ns; s++) {
+                uint8_t *out = parity[i] + s * sb + t * ps;
+                memset(out, 0, ps);
+                for (j = 0; j < k; j++)
+                    for (u = 0; u < w; u++) {
+                        uint64_t col = gfw_mul(w, mat[i * k + j],
+                                               (uint64_t)1 << u);
+                        if ((col >> t) & 1) {
+                            const uint8_t *in = data[j] + s * sb + u * ps;
+                            for (b = 0; b < ps; b++) out[b] ^= in[b];
+                        }
+                    }
+            }
+}
+
 /* ---------------- native GF(2) bit-matrices (liberation family) --------- */
 
 /* Plank's Liberation construction (w prime, k <= w, m=2): row 0 block =
@@ -484,6 +556,10 @@ static const Cfg CONFIGS[] = {
     {"jerasure", "liberation", 4, 2, 7, 4, 896, 14},
     {"jerasure", "blaum_roth", 4, 2, 6, 4, 1152, 15},
     {"jerasure", "liber8tion", 5, 2, 8, 4, 1920, 16},
+    /* wide-field cauchy (round 4: w-coverage parity with reed_sol) */
+    {"jerasure", "cauchy_orig", 4, 2, 16, 4, 4096, 17},
+    {"jerasure", "cauchy_good", 4, 2, 16, 4, 4096, 18},
+    {"jerasure", "cauchy_good", 4, 2, 32, 4, 8192, 19},
 };
 
 static int is_native_bitmatrix(const Cfg *c) {
@@ -519,10 +595,20 @@ int main(void) {
             else l8_bitmatrix(k, bm);
             bitmatrix01_encode(bm, k, m, w, c->packetsize, data, parity, chunk);
         } else if (w != 8) {
-            if (!strcmp(c->technique, "reed_sol_van"))
+            if (!strcmp(c->technique, "reed_sol_van")) {
                 reed_sol_van_matrix_w(k, m, w, matw);
-            else reed_sol_r6_matrix_w(k, w, matw);
-            matrix_encode_w(matw, k, m, w, data, parity, chunk);
+                matrix_encode_w(matw, k, m, w, data, parity, chunk);
+            } else if (!strcmp(c->technique, "reed_sol_r6_op")) {
+                reed_sol_r6_matrix_w(k, w, matw);
+                matrix_encode_w(matw, k, m, w, data, parity, chunk);
+            } else {
+                /* wide-field cauchy: packet bit-matrix encode */
+                if (!strcmp(c->technique, "cauchy_orig"))
+                    cauchy_orig_matrix_w(k, m, w, matw);
+                else cauchy_good_matrix_w(k, m, w, matw);
+                bitmatrix_encode_ww(matw, k, m, w, c->packetsize,
+                                    data, parity, chunk);
+            }
         } else {
             if (!strcmp(c->plugin, "jerasure")) {
                 if (!strcmp(c->technique, "reed_sol_van")) reed_sol_van_matrix(k, m, mat);
