@@ -44,6 +44,43 @@ class ReplicatedBackendMixin:
            .set_version(_coll(st.pgid), oid, version[1])
         return await self._replicate_txn(st, txn, "modify", oid, version)
 
+    def _head_size(self, pool: PGPool, st: PGState, oid: str,
+                   missing=0):
+        """Logical object size (EC pools: the 'size' xattr, the shard
+        stat would be 1/k of it); ``missing`` for absent objects."""
+        coll = _coll(st.pgid)
+        if pool.is_erasure():
+            sa = self.store.getattr(coll, oid, "size")
+            if sa is not None:
+                return int(sa)
+            return missing if self.store.stat(coll, oid) is None else 0
+        s = self.store.stat(coll, oid)
+        return missing if s is None else s
+
+    async def _op_truncate(self, pool: PGPool, st: PGState, oid: str,
+                           size: int, snapc=None) -> int:
+        """CEPH_OSD_OP_TRUNCATE.  Replicated: a store truncate in the
+        replicated txn.  EC: re-encode the surviving prefix (the
+        reference routes EC truncates through the RMW machinery too)."""
+        if pool.is_erasure():
+            cur = self._head_size(pool, st, oid)
+            if size == cur:
+                return 0
+            if size < cur:
+                head = await self._op_read(pool, st, oid, 0, size)
+                head = head.ljust(size, b"\0")
+            else:
+                head = (await self._op_read(pool, st, oid, 0, cur)
+                        ).ljust(size, b"\0")
+            return await self._ec_write(pool, st, oid, head, offset=None,
+                                        snapc=snapc)
+        coll = _coll(st.pgid)
+        version = self._next_version(st)
+        txn = self._snap_pre_txn(st, oid, snapc)
+        txn.truncate(coll, oid, size) \
+           .set_version(coll, oid, version[1])
+        return await self._replicate_txn(st, txn, "modify", oid, version)
+
     def _cow_pre_ops(self, st: PGState, oid: str, snapc,
                      erasure: bool) -> list:
         """Clone-on-write pre-ops for a mutation (make_writeable,

@@ -124,7 +124,8 @@ class ClientOpsMixin:
     # dup detection, PGLog dups / osd_pg_log_dups_tracked)
     _MUTATING_OPS = frozenset({
         "write_full", "write", "delete", "setxattr", "rmxattr",
-        "omap_set", "omap_rmkeys", "exec"})
+        "omap_set", "omap_rmkeys", "exec",
+        "append", "truncate", "zero", "create"})
     _REQID_DUPS_TRACKED = 3000
 
     async def _dispatch_client_op(self, conn, msg, m, pool, st) -> None:
@@ -256,6 +257,52 @@ class ClientOpsMixin:
                                               snapc=msg.snapc)
                 await conn.send(M.MOSDOpReply(
                     reqid=msg.reqid, result=r, epoch=m.epoch))
+            elif opname == "append":
+                # CEPH_OSD_OP_APPEND: a write at the CURRENT size,
+                # atomic under the PG lock (do_osd_ops:4917 case)
+                async with st.lock:
+                    size = self._head_size(pool, st, msg.oid)
+                    r = await self._op_write(pool, st, msg.oid,
+                                             size, args["data"],
+                                             snapc=msg.snapc)
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=r, data=size, epoch=m.epoch))
+            elif opname == "truncate":
+                async with st.lock:
+                    r = await self._op_truncate(pool, st, msg.oid,
+                                                args["size"],
+                                                snapc=msg.snapc)
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=r, epoch=m.epoch))
+            elif opname == "zero":
+                # CEPH_OSD_OP_ZERO: write zeros over the range
+                async with st.lock:
+                    r = await self._op_write(pool, st, msg.oid,
+                                             args["offset"],
+                                             b"\0" * args["length"],
+                                             snapc=msg.snapc)
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=r, epoch=m.epoch))
+            elif opname == "create":
+                # exclusive create (CEPH_OSD_OP_CREATE + EXCL flag)
+                async with st.lock:
+                    if self._head_size(pool, st, msg.oid, missing=None) \
+                            is not None:
+                        r = -17  # EEXIST
+                    else:
+                        r = await self._op_write_full(
+                            pool, st, msg.oid, b"", snapc=msg.snapc)
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=r, epoch=m.epoch))
+            elif opname == "cmpxattr":
+                # CEPH_OSD_OP_CMPXATTR (eq): gate for compound client
+                # ops; mismatch -> -ECANCELED like the reference
+                cur = self.store.getattr(_coll(st.pgid), msg.oid,
+                                         "_" + args["name"])
+                ok = cur == args["value"]
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=(0 if ok else -125),
+                    epoch=m.epoch))
             elif opname == "stat":
                 try:
                     oid = self._snap_read_oid(pool, st, msg.oid, msg.snapid)

@@ -435,6 +435,52 @@ class IoCtx:
         if reply.result != 0:
             raise IOError(f"remove({oid}) -> {reply.result}")
 
+    async def append(self, oid: str, data: bytes) -> int:
+        """Atomic append; returns the offset the data landed at
+        (reference rados_append)."""
+        reply = await self.objecter.op_submit(
+            self.pool_id, oid, [("append", {"data": bytes(data)})],
+            snapc=self._write_snapc())
+        if reply.result != 0:
+            raise IOError(f"append({oid}) -> {reply.result}")
+        return reply.data
+
+    async def truncate(self, oid: str, size: int) -> None:
+        reply = await self.objecter.op_submit(
+            self.pool_id, oid, [("truncate", {"size": size})],
+            snapc=self._write_snapc())
+        if reply.result != 0:
+            raise IOError(f"truncate({oid}) -> {reply.result}")
+
+    async def zero(self, oid: str, offset: int, length: int) -> None:
+        reply = await self.objecter.op_submit(
+            self.pool_id, oid,
+            [("zero", {"offset": offset, "length": length})],
+            snapc=self._write_snapc())
+        if reply.result != 0:
+            raise IOError(f"zero({oid}) -> {reply.result}")
+
+    async def create(self, oid: str, exclusive: bool = True) -> None:
+        """Exclusive object create (rados_write_op create + EXCL)."""
+        reply = await self.objecter.op_submit(
+            self.pool_id, oid, [("create", {})],
+            snapc=self._write_snapc())
+        if reply.result == -17:
+            raise FileExistsError(oid)
+        if reply.result != 0:
+            raise IOError(f"create({oid}) -> {reply.result}")
+
+    async def cmpxattr(self, oid: str, name: str, value: bytes) -> bool:
+        """Equality xattr guard; False on mismatch (-ECANCELED)."""
+        reply = await self.objecter.op_submit(
+            self.pool_id, oid,
+            [("cmpxattr", {"name": name, "value": bytes(value)})])
+        if reply.result == -125:
+            return False
+        if reply.result != 0:
+            raise IOError(f"cmpxattr({oid}) -> {reply.result}")
+        return True
+
     async def stat(self, oid: str, snapid: int = None) -> int:
         reply = await self.objecter.op_submit(
             self.pool_id, oid, [("stat", {})],

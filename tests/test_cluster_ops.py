@@ -134,3 +134,55 @@ def test_watch_notify():
             await cluster.stop()
 
     run(scenario())
+
+
+def test_extended_osd_verbs_replicated_and_ec():
+    """Round-4 widening of the do_osd_ops interpreter: append, truncate,
+    zero, exclusive create, cmpxattr (reference PrimaryLogPG.cc:4917
+    cases) on BOTH pool types."""
+    import asyncio
+
+    from ceph_tpu.cluster.vstart import start_cluster
+
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            pools = []
+            pools.append(await client.pool_create(
+                "verbs_r", "replicated", pg_num=8, size=2))
+            pools.append(await client.pool_create(
+                "verbs_e", "erasure", pg_num=4,
+                ec_profile={"plugin": "jerasure",
+                            "technique": "reed_sol_van",
+                            "k": "2", "m": "1"}))
+            for pool in pools:
+                io = client.ioctx(pool)
+                # append: atomic, returns the landing offset
+                off0 = await io.append("log", b"one")
+                off1 = await io.append("log", b"two")
+                assert (off0, off1) == (0, 3)
+                assert await io.read("log") == b"onetwo"
+                # truncate shrink + grow (zero-extended)
+                await io.write_full("t", b"0123456789" * 40)
+                await io.truncate("t", 5)
+                assert await io.read("t") == b"01234"
+                await io.truncate("t", 8)
+                assert await io.read("t") == b"01234\0\0\0"
+                # zero a range
+                await io.write_full("z", b"Z" * 64)
+                await io.zero("z", 8, 16)
+                got = await io.read("z")
+                assert got[8:24] == b"\0" * 16 and got[:8] == b"Z" * 8
+                # exclusive create
+                await io.create("fresh")
+                with __import__("pytest").raises(FileExistsError):
+                    await io.create("fresh")
+                # cmpxattr guard
+                await io.setxattr("fresh", "tag", b"v1")
+                assert await io.cmpxattr("fresh", "tag", b"v1")
+                assert not await io.cmpxattr("fresh", "tag", b"v2")
+        finally:
+            await cluster.stop()
+
+    asyncio.run(scenario())
