@@ -9,6 +9,7 @@ by an allocator, kv onode metadata, checksum verification on every read
 import os
 import pickle
 
+from tests._flaky import contention_retry
 import pytest
 
 from ceph_tpu.cluster.bluestore import BLOCK, BlueStore
@@ -133,6 +134,7 @@ def test_wal_replay_never_clobbers_checkpointed_blocks(tmp_path):
     s2.umount()
 
 
+@contention_retry()
 def test_full_cluster_on_bluestore(tmp_path):
     """vstart --bluestore analog: the whole cluster on BlueStore,
     including a full-cluster restart resume (the FileStore restart test's

@@ -6,6 +6,7 @@ CephxServiceHandler.h:23 (mon-side issuance), MonCap/OSDCap enforcement.
 
 import asyncio
 
+from tests._flaky import contention_retry
 import pytest
 
 from ceph_tpu.cluster import auth
@@ -23,6 +24,7 @@ def _cephx_config():
     return cfg
 
 
+@contention_retry()
 def test_cluster_end_to_end_with_cephx():
     """The whole data path — pool create, replicated + EC I/O, snaps —
     runs over per-session keys issued through mon tickets."""
@@ -91,6 +93,7 @@ def test_wrong_entity_key_refused():
     run(scenario())
 
 
+@contention_retry()
 def test_expired_ticket_refused_then_renewal_works():
     async def scenario():
         cfg = _cephx_config()

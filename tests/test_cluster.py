@@ -12,6 +12,7 @@ failure detection (MPing, MOSDFailure), and recovery (MOSDPGPush/Reply).
 
 import asyncio
 
+from tests._flaky import contention_retry
 import pytest
 
 from ceph_tpu.cluster.osd import OSDDaemon
@@ -116,6 +117,7 @@ def test_ec_read_with_dead_shard():
     run(scenario())
 
 
+@contention_retry()
 def test_failure_detection_marks_down():
     async def scenario():
         cluster = await start_cluster(3)
@@ -132,6 +134,7 @@ def test_failure_detection_marks_down():
     run(scenario())
 
 
+@contention_retry()
 def test_down_out_rebalance_and_recovery():
     """Down OSD is auto-outed by the mon tick; replicated PGs remap and the
     new acting set is backfilled by primary-driven recovery."""
@@ -170,6 +173,7 @@ def test_down_out_rebalance_and_recovery():
     run(scenario())
 
 
+@contention_retry()
 def test_ec_recovery_rebuilds_lost_shards():
     """Kill an OSD holding shards, revive it empty: primary-driven EC
     recovery re-encodes and pushes the missing shard back
@@ -231,6 +235,7 @@ def test_mon_status_and_perf_dump():
     run(scenario())
 
 
+@contention_retry()
 def test_client_misdirect_resend():
     """Write through a client whose map predates a pool's remap: the OSD
     replies -EAGAIN-style misdirect and the client refreshes + resends."""
@@ -297,6 +302,7 @@ def test_ec_partial_write_rmw():
     run(scenario())
 
 
+@contention_retry()
 def test_ec_rmw_survives_shard_loss():
     """RMW then kill an OSD: the modified object decodes correctly from the
     survivors (stripe-consistent shards)."""

@@ -9,6 +9,7 @@ MDLog write-ahead journaling (src/mds/journal.cc), Locker caps/leases
 
 import asyncio
 
+from tests._flaky import contention_retry
 import pytest
 
 from ceph_tpu.cluster.mds import JOURNAL_OID, MDSClient
@@ -59,6 +60,7 @@ def test_mds_namespace_and_file_io():
     run(scenario())
 
 
+@contention_retry()
 def test_two_clients_coherent_under_concurrency():
     """Two clients hammer the same directory with creates + renames; the
     MDS serializes them — every op lands exactly once, names never
@@ -108,6 +110,7 @@ def test_two_clients_coherent_under_concurrency():
     run(scenario())
 
 
+@contention_retry()
 def test_mds_restart_replays_journal():
     """Kill the MDS after journal append but before dirfrag apply; the
     restarted MDS must replay the event (MDSRank boot replay)."""
@@ -145,6 +148,7 @@ def test_mds_restart_replays_journal():
     run(scenario())
 
 
+@contention_retry()
 def test_mds_lease_caching():
     """stat/listdir replies carry a lease: repeated lookups inside the
     TTL are served from the client cache; mutations invalidate it."""

@@ -3,6 +3,7 @@ missing #10; reference src/journal/ + src/tools/rbd_mirror/)."""
 
 import asyncio
 
+from tests._flaky import contention_retry
 import pytest
 
 from ceph_tpu.cluster.rbd import RBD
@@ -60,6 +61,7 @@ def test_journal_records_and_mirror_replays():
     run(scenario())
 
 
+@contention_retry()
 def test_mirror_daemon_background_catchup():
     async def scenario():
         cluster = await start_cluster(3)

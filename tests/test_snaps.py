@@ -10,6 +10,7 @@ resolution in find_object_context, snap trimming
 
 import asyncio
 
+from tests._flaky import contention_retry
 import pytest
 
 from ceph_tpu.cluster.snaps import (
@@ -160,6 +161,7 @@ def test_delete_after_snap_keeps_snap_readable():
     run(scenario())
 
 
+@contention_retry()
 def test_snap_trim_removes_clone_objects():
     async def scenario():
         cluster = await start_cluster(3)
@@ -197,6 +199,7 @@ def test_snap_trim_removes_clone_objects():
     run(scenario())
 
 
+@contention_retry()
 def test_ec_snap_survives_shard_loss():
     """Snap reads ride the same decode path as head reads: kill one OSD
     and the clone must still reconstruct."""

@@ -9,6 +9,7 @@ every object readable with its last-acknowledged contents.
 import asyncio
 import random
 
+from tests._flaky import contention_retry
 import pytest
 
 from ceph_tpu.cluster.osd import OSDDaemon
@@ -19,6 +20,7 @@ def run(coro):
     return asyncio.run(coro)
 
 
+@contention_retry()
 def test_thrash_osds_replicated():
     async def scenario():
         rng = random.Random(42)
@@ -103,6 +105,7 @@ def test_thrash_osds_replicated():
     run(scenario())
 
 
+@contention_retry()
 def test_thrash_osds_with_snapshots():
     """Thrash with pool snapshots in the mix (round-4 item 1 gate): after
     bounces + recovery, every snap reads back the contents recorded at
