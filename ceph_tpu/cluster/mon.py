@@ -484,7 +484,8 @@ class Monitor(Dispatcher):
         "osd pool create", "osd out", "osd in", "injectargs",
         "osd pool mksnap", "osd pool rmsnap",
         "osd pool selfmanaged_snap_create",
-        "osd pool selfmanaged_snap_remove", "auth revoke"})
+        "osd pool selfmanaged_snap_remove", "auth revoke",
+        "osd pool delete", "osd pool rename", "osd pool set"})
 
     async def _handle_command(self, conn: Connection, msg: M.MMonCommand) -> None:
         cmd = msg.cmd
@@ -504,7 +505,8 @@ class Monitor(Dispatcher):
             "osd pool create", "osd out", "osd in",
             "osd pool mksnap", "osd pool rmsnap",
             "osd pool selfmanaged_snap_create",
-            "osd pool selfmanaged_snap_remove", "auth revoke")
+            "osd pool selfmanaged_snap_remove", "auth revoke",
+            "osd pool delete", "osd pool rename", "osd pool set")
         if mutating and not self.is_leader:
             # forward to the leader, relay its reply (reference
             # Monitor::forward_request_leader)
@@ -536,6 +538,68 @@ class Monitor(Dispatcher):
                             "osd pool selfmanaged_snap_create",
                             "osd pool selfmanaged_snap_remove"):
                 result, data = await self._handle_snap_command(prefix, cmd)
+            elif prefix == "osd pool delete":
+                # reference OSDMonitor: name must repeat + the sure flag
+                pid = next((p for p, po in self.osdmap.pools.items()
+                            if po.name == cmd["pool"] or p == cmd["pool"]),
+                           None)
+                if pid is None:
+                    result, data = -2, f"pool {cmd['pool']!r} not found"
+                elif cmd.get("pool2") != cmd["pool"] or \
+                        not cmd.get("sure"):
+                    result, data = -1, (
+                        "EPERM: pass the pool name twice and sure=True "
+                        "to really delete (this is irreversible)")
+                else:
+                    async with self._map_mutex:
+                        inc = self._new_inc()
+                        inc.old_pools = (pid,)
+                        if not await self._commit_inc(inc):
+                            result, data = -11, "quorum lost"
+                        else:
+                            data = pid
+            elif prefix == "osd pool rename":
+                pid = next((p for p, po in self.osdmap.pools.items()
+                            if po.name == cmd["srcpool"]), None)
+                if pid is None:
+                    result, data = -2, "source pool not found"
+                elif any(po.name == cmd["destpool"]
+                         for po in self.osdmap.pools.values()):
+                    result, data = -17, "destination name exists"
+                else:
+                    import dataclasses as _dc
+
+                    async with self._map_mutex:
+                        inc = self._new_inc()
+                        inc.new_pools[pid] = _dc.replace(
+                            self.osdmap.pools[pid],
+                            name=cmd["destpool"])
+                        if not await self._commit_inc(inc):
+                            result, data = -11, "quorum lost"
+                        else:
+                            data = pid
+            elif prefix == "osd pool set":
+                pid = next((p for p, po in self.osdmap.pools.items()
+                            if po.name == cmd["pool"] or p == cmd["pool"]),
+                           None)
+                var, val = cmd.get("var"), cmd.get("val")
+                if pid is None:
+                    result, data = -2, f"pool {cmd['pool']!r} not found"
+                elif var not in ("size", "min_size"):
+                    # pg_num changes imply PG splitting — unimplemented,
+                    # refused loudly rather than silently misplacing
+                    result, data = -22, f"cannot set {var!r}"
+                else:
+                    import dataclasses as _dc
+
+                    async with self._map_mutex:
+                        inc = self._new_inc()
+                        inc.new_pools[pid] = _dc.replace(
+                            self.osdmap.pools[pid], **{var: int(val)})
+                        if not await self._commit_inc(inc):
+                            result, data = -11, "quorum lost"
+                        else:
+                            data = int(val)
             elif prefix == "auth revoke":
                 # refuse future ticket issuance/renewal for the entity
                 # (existing tickets die at their TTL); committed through

@@ -616,5 +616,27 @@ class RadosClient:
     async def status(self):
         return await self.objecter.mon_command({"prefix": "status"})
 
+    async def pool_delete(self, name: str, sure: bool = False) -> None:
+        """Irreversible; mirrors the reference's name-twice + sure gate."""
+        await self.objecter.mon_command({
+            "prefix": "osd pool delete", "pool": name, "pool2": name,
+            "sure": sure})
+        await self.objecter._refresh_map()
+
+    async def pool_rename(self, src: str, dst: str) -> None:
+        await self.objecter.mon_command({
+            "prefix": "osd pool rename", "srcpool": src, "destpool": dst})
+        await self.objecter._refresh_map()
+
+    async def pool_set(self, name: str, var: str, val) -> None:
+        await self.objecter.mon_command({
+            "prefix": "osd pool set", "pool": name, "var": var,
+            "val": val})
+        await self.objecter._refresh_map()
+
+    def pool_list(self):
+        m = self.objecter.osdmap
+        return {p.name or pid: pid for pid, p in m.pools.items()}
+
     def ioctx(self, pool_id: int) -> IoCtx:
         return IoCtx(self.objecter, pool_id)

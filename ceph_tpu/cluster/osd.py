@@ -463,6 +463,24 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                 elif old is not None:
                     del self.pgs[pgid]
                     changed = True
+        # pools deleted from the map: drop their PGs AND their data
+        # (reference: pool deletion queues PG removal + collection nuke).
+        # Sweep by STORE collection, not just live PGState — collections
+        # from past intervals must die too.
+        for pgid in [p for p in self.pgs if p.pool not in m.pools]:
+            del self.pgs[pgid]
+            changed = True
+        for coll in self.store.list_collections():
+            if not coll.startswith("pg_"):
+                continue
+            try:
+                pool_id = int(coll.split("_")[1])
+            except (IndexError, ValueError):
+                continue
+            if pool_id not in m.pools:
+                self.store.queue_transaction(
+                    Transaction().remove_collection(coll))
+                self.perf.inc("osd_pgs_removed")
         return changed
 
     def _pool_memberships(self, m: OSDMap, pool_id: int, pool: PGPool):

@@ -135,6 +135,7 @@ class Incremental:
     new_mgr_addr: object = None  # mgr registration (reference MgrMap)
     new_mds_addr: object = None  # active MDS (MDSMap-lite)
     new_revoked: Tuple[str, ...] = ()  # cephx entities to revoke
+    old_pools: Tuple[int, ...] = ()    # pool deletions
 
 
 class OSDMap:
@@ -260,6 +261,13 @@ class OSDMap:
             self.invalidate_mappers()
         for pool_id, pool in inc.new_pools.items():
             self.pools[pool_id] = pool
+        for pool_id in inc.old_pools:
+            self.pools.pop(pool_id, None)
+            for pg in [p for p in self.pg_upmap if p.pool == pool_id]:
+                del self.pg_upmap[pg]
+            for pg in [p for p in self.pg_upmap_items
+                       if p.pool == pool_id]:
+                del self.pg_upmap_items[pg]
         self.epoch = inc.epoch
 
     @property

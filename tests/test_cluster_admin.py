@@ -132,3 +132,47 @@ def test_mgr_receives_perf_streams():
             await cluster.stop()
 
     run(scenario())
+
+
+def test_pool_delete_rename_set():
+    """Pool lifecycle admin (reference OSDMonitor pool ops): rename,
+    set size/min_size, guarded delete that really removes the data."""
+    import asyncio
+
+    import pytest
+
+    from ceph_tpu.cluster.vstart import start_cluster
+
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("adm", "replicated",
+                                            pg_num=8, size=3)
+            io = client.ioctx(pool)
+            await io.write_full("obj", b"data")
+            # rename
+            await client.pool_rename("adm", "renamed")
+            assert "renamed" in client.pool_list()
+            assert "adm" not in client.pool_list()
+            # set size
+            await client.pool_set("renamed", "size", 2)
+            assert client.objecter.osdmap.pools[pool].size == 2
+            with pytest.raises(RuntimeError):
+                await client.pool_set("renamed", "pg_num", 16)
+            # delete requires the sure gate
+            with pytest.raises(RuntimeError):
+                await client.pool_delete("renamed")
+            await client.pool_delete("renamed", sure=True)
+            assert "renamed" not in client.pool_list()
+            # the data is gone from every OSD store
+            await asyncio.sleep(0.3)
+            for osd in cluster.osds.values():
+                assert not [c for c in osd.store.list_collections()
+                            if c.startswith(f"pg_{pool}_")], \
+                    f"osd.{osd.osd_id} kept deleted pool data"
+                assert not [p for p in osd.pgs if p.pool == pool]
+        finally:
+            await cluster.stop()
+
+    asyncio.run(scenario())
