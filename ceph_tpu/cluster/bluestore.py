@@ -493,3 +493,9 @@ class BlueStore(ObjectStore):
     def list_collections(self) -> List[str]:
         with self._lock:
             return sorted(self._onodes)
+
+    def statfs(self) -> Tuple[int, int]:
+        """O(1) from the allocator (BlueStore::statfs)."""
+        with self._lock:
+            used = (self.n_blocks - self.alloc.n_free) * BLOCK
+            return (self.device_size, used)

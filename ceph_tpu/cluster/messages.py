@@ -33,7 +33,10 @@ class MOSDFailure(Message):
 
 @dataclass
 class MOSDAlive(Message):
+    """OSD beacon (reference MOSDBeacon): liveness + store usage."""
+
     osd_id: int = -1
+    statfs: Optional[Tuple[int, int]] = None   # (total_bytes, used_bytes)
 
 
 @dataclass

@@ -74,6 +74,8 @@ class Monitor(Dispatcher):
         # the tick mark OSDs down even when no reporters remain (e.g. the
         # whole cluster stopped at once)
         self.last_beacon: Dict[int, float] = {}
+        # per-osd (total, used) bytes from beacons ('ceph df' feed)
+        self.osd_statfs: Dict[int, Tuple[int, int]] = {}
         self.perf = PerfCounters("mon")
         self._tick_task: Optional[asyncio.Task] = None
         self._log: List[Tuple[str, object]] = []  # committed proposal log
@@ -353,6 +355,8 @@ class Monitor(Dispatcher):
                 await self._handle_failure(msg)
             elif 0 <= msg.osd_id < self.osdmap.max_osd:
                 self.last_beacon[msg.osd_id] = time.monotonic()
+                if getattr(msg, "statfs", None) is not None:
+                    self.osd_statfs[msg.osd_id] = tuple(msg.statfs)
             return True
         if isinstance(msg, M.MOSDMapMsg):
             newmap = pickle.loads(msg.osdmap_blob)
@@ -658,6 +662,37 @@ class Monitor(Dispatcher):
                     # even though batched placement runs in tools/OSDs,
                     # not in this process
                     "placement_path": self._placement_path(m),
+                }
+            elif prefix == "health":
+                # reference health checks (OSD_DOWN, OSD_OUT, MON_DOWN)
+                m = self.osdmap
+                checks = {}
+                down = [o for o in range(m.max_osd)
+                        if m.osd_exists[o] and not m.osd_up[o]]
+                out = [o for o in range(m.max_osd)
+                       if m.osd_exists[o] and m.osd_weight[o] == 0]
+                if down:
+                    checks["OSD_DOWN"] = f"{len(down)} osds down: {down}"
+                if out:
+                    checks["OSD_OUT"] = f"{len(out)} osds out: {out}"
+                full = [o for o, (tot, used) in self.osd_statfs.items()
+                        if tot and used / tot > 0.95]
+                if full:
+                    checks["OSD_FULL"] = f"osds near full: {full}"
+                status = "HEALTH_OK" if not checks else (
+                    "HEALTH_ERR" if full or len(down) >= m.max_osd
+                    else "HEALTH_WARN")
+                data = {"status": status, "checks": checks}
+            elif prefix == "df":
+                # 'ceph df' analog from beacon statfs
+                per = {o: {"total": t, "used": u, "avail": t - u}
+                       for o, (t, u) in sorted(self.osd_statfs.items())}
+                data = {
+                    "total_bytes": sum(t for t, _ in
+                                       self.osd_statfs.values()),
+                    "used_bytes": sum(u for _, u in
+                                      self.osd_statfs.values()),
+                    "osds": per,
                 }
             elif prefix == "perf dump":
                 data = self.perf.dump()

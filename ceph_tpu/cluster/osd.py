@@ -523,7 +523,8 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
             # us down even when no peer reporters survive; never let a
             # transport hiccup kill the heartbeat task
             try:
-                await self._mon_send(M.MOSDAlive(osd_id=self.osd_id))
+                await self._mon_send(M.MOSDAlive(
+                    osd_id=self.osd_id, statfs=self.store.statfs()))
             except Exception:
                 pass
             # perf-counter stream to the active mgr (MgrClient::send_report)

@@ -104,6 +104,10 @@ class ObjectStore:
 
     def umount(self) -> None: ...
 
+    def statfs(self) -> Tuple[int, int]:
+        """(total_bytes, used_bytes) — reference ObjectStore::statfs."""
+        return (0, 0)
+
     def queue_transaction(self, txn: Transaction) -> None:
         raise NotImplementedError
 
@@ -116,9 +120,11 @@ class ObjectStore:
 
 
 class MemStore(ObjectStore):
-    def __init__(self):
+    def __init__(self, device_bytes: int = 1 << 30):
         self._colls: Dict[str, Dict[str, Obj]] = {}
         self._lock = threading.RLock()
+        # advertised capacity (memstore_device_bytes analog) for statfs
+        self.device_bytes = device_bytes
 
     # -- transaction application (atomic under lock) -----------------------
 
@@ -244,3 +250,9 @@ class MemStore(ObjectStore):
     def list_collections(self) -> List[str]:
         with self._lock:
             return sorted(self._colls)
+
+    def statfs(self) -> Tuple[int, int]:
+        with self._lock:
+            used = sum(len(o.data)
+                       for c in self._colls.values() for o in c.values())
+            return (self.device_bytes, used)

@@ -176,3 +176,44 @@ def test_pool_delete_rename_set():
             await cluster.stop()
 
     asyncio.run(scenario())
+
+
+def test_health_and_df_commands():
+    """'ceph health' / 'ceph df' analogs: health checks from the map,
+    usage aggregated from OSD beacon statfs."""
+    import asyncio
+
+    from ceph_tpu.cluster.vstart import start_cluster
+
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            h = await client.objecter.mon_command({"prefix": "health"})
+            assert h["status"] == "HEALTH_OK", h
+            pool = await client.pool_create("hdf", "replicated",
+                                            pg_num=8, size=2)
+            io = client.ioctx(pool)
+            await io.write_full("obj", b"x" * 100_000)
+            # wait for a beacon cycle to carry statfs
+            for _ in range(100):
+                df = await client.objecter.mon_command({"prefix": "df"})
+                if df["used_bytes"] > 0 and len(df["osds"]) == 3:
+                    break
+                await asyncio.sleep(0.1)
+            assert df["total_bytes"] > 0
+            assert df["used_bytes"] >= 100_000  # replicated x2 somewhere
+            # kill an OSD -> health degrades
+            victim = next(iter(cluster.osds))
+            await cluster.osds.pop(victim).stop()
+            for _ in range(100):
+                h = await client.objecter.mon_command({"prefix": "health"})
+                if h["status"] != "HEALTH_OK":
+                    break
+                await asyncio.sleep(0.1)
+            assert h["status"] in ("HEALTH_WARN", "HEALTH_ERR")
+            assert "OSD_DOWN" in h["checks"]
+        finally:
+            await cluster.stop()
+
+    asyncio.run(scenario())
