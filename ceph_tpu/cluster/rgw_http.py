@@ -168,6 +168,8 @@ class RGWFrontend:
     # -- REST dispatch (rgw_rest_s3.cc op table) ---------------------------
 
     async def _dispatch(self, req: S3Request):
+        if req.path.startswith("/swift/v1"):
+            return await self._dispatch_swift(req)
         err = self._authenticate(req)
         if err is not None:
             return "403 Forbidden", {}, self._error_xml(
@@ -186,6 +188,103 @@ class RGWFrontend:
         except Exception as e:  # noqa: BLE001 — 500 with the error body
             return ("500 Internal Server Error", {},
                     self._error_xml("InternalError", repr(e)))
+
+    # -- Swift API (the reference gateway's second protocol,
+    #    rgw_rest_swift.cc: same RGW core, container/object dialect) ----
+
+    def _swift_auth(self, req: S3Request) -> Optional[str]:
+        """Swift tempauth-lite: X-Auth-Token = '<access>:<hmac(secret,
+        access)>' (the reference's tempauth token possession proof)."""
+        if self.accounts is None:
+            return None
+        token = req.headers.get("x-auth-token", "")
+        try:
+            access, proof = token.split(":", 1)
+        except ValueError:
+            return "missing or malformed X-Auth-Token"
+        secret = self.accounts.get(access)
+        if secret is None:
+            return "unknown account"
+        want = hmac.new(secret.encode(), access.encode(),
+                        hashlib.sha256).hexdigest()
+        if not hmac.compare_digest(want, proof):
+            return "bad token"
+        return None
+
+    @staticmethod
+    def swift_token(access: str, secret: str) -> str:
+        return access + ":" + hmac.new(
+            secret.encode(), access.encode(), hashlib.sha256).hexdigest()
+
+    async def _dispatch_swift(self, req: S3Request):
+        err = self._swift_auth(req)
+        if err is not None:
+            return "401 Unauthorized", {}, err.encode()
+        rest = req.path[len("/swift/v1"):].strip("/")
+        parts = rest.split("/", 1)
+        container = parts[0]
+        obj = parts[1] if len(parts) > 1 else ""
+        try:
+            if not container:
+                # account GET: newline-separated container listing
+                names = await self.rgw.list_buckets()
+                return ("200 OK", {"Content-Type": "text/plain"},
+                        ("\n".join(names) + "\n").encode()
+                        if names else b"")
+            if not obj:
+                if req.method == "PUT":
+                    try:
+                        await self.rgw.create_bucket(container)
+                        return "201 Created", {}, b""
+                    except FileExistsError:
+                        return "202 Accepted", {}, b""
+                if req.method == "DELETE":
+                    await self.rgw.delete_bucket(container)
+                    return "204 No Content", {}, b""
+                if req.method in ("GET", "HEAD"):
+                    res = await self.rgw.list_objects(
+                        container,
+                        prefix=req.query.get("prefix", ""),
+                        marker=req.query.get("marker", ""),
+                        max_keys=int(req.query.get("limit", "10000")))
+                    body = ("\n".join(m.key for m in res.keys)
+                            + ("\n" if res.keys else "")).encode()
+                    hdrs = {"Content-Type": "text/plain",
+                            "X-Container-Object-Count":
+                                str(len(res.keys))}
+                    return "200 OK", hdrs, (b"" if req.method == "HEAD"
+                                            else body)
+                return "405 Method Not Allowed", {}, b""
+            # object ops share the S3 core verbatim
+            if req.method == "PUT":
+                user_meta = {k[len("x-object-meta-"):]: v
+                             for k, v in req.headers.items()
+                             if k.startswith("x-object-meta-")}
+                etag = await self.rgw.put_object(
+                    container, obj, req.body,
+                    content_type=req.headers.get(
+                        "content-type", "application/octet-stream"),
+                    user_meta=user_meta)
+                return "201 Created", {"ETag": etag}, b""
+            if req.method in ("GET", "HEAD"):
+                meta = await self.rgw.head_object(container, obj)
+                hdrs = {"ETag": meta.etag,
+                        "Content-Type": meta.content_type}
+                for k, v in meta.user_meta.items():
+                    hdrs[f"X-Object-Meta-{k}"] = v
+                if req.method == "HEAD":
+                    hdrs["Content-Length"] = str(meta.size)
+                    return "200 OK", hdrs, b""
+                _, data = await self.rgw.get_object(container, obj)
+                return "200 OK", hdrs, data
+            if req.method == "DELETE":
+                await self.rgw.delete_object(container, obj)
+                return "204 No Content", {}, b""
+            return "405 Method Not Allowed", {}, b""
+        except FileNotFoundError as e:
+            return "404 Not Found", {}, str(e).encode()
+        except Exception as e:  # noqa: BLE001
+            return "500 Internal Server Error", {}, repr(e).encode()
 
     @staticmethod
     def _error_xml(code: str, msg: str) -> bytes:
