@@ -168,7 +168,9 @@ class RGWFrontend:
     # -- REST dispatch (rgw_rest_s3.cc op table) ---------------------------
 
     async def _dispatch(self, req: S3Request):
-        if req.path.startswith("/swift/v1"):
+        if req.path == "/swift/v1" or req.path.startswith("/swift/v1/"):
+            # exact-prefix guard: an S3 bucket named 'swift' with key
+            # 'v1.txt' must stay on the S3 path (and its auth)
             return await self._dispatch_swift(req)
         err = self._authenticate(req)
         if err is not None:
@@ -220,71 +222,108 @@ class RGWFrontend:
         err = self._swift_auth(req)
         if err is not None:
             return "401 Unauthorized", {}, err.encode()
-        rest = req.path[len("/swift/v1"):].strip("/")
+        # strip the prefix + ONE leading slash: a trailing '/' is part of
+        # the object name (Swift pseudo-directory markers)
+        rest = req.path[len("/swift/v1"):]
+        if rest.startswith("/"):
+            rest = rest[1:]
         parts = rest.split("/", 1)
         container = parts[0]
         obj = parts[1] if len(parts) > 1 else ""
         try:
             if not container:
+                if req.method != "GET":
+                    return "405 Method Not Allowed", {}, b""
                 # account GET: newline-separated container listing
                 names = await self.rgw.list_buckets()
                 return ("200 OK", {"Content-Type": "text/plain"},
                         ("\n".join(names) + "\n").encode()
                         if names else b"")
             if not obj:
-                if req.method == "PUT":
-                    try:
-                        await self.rgw.create_bucket(container)
-                        return "201 Created", {}, b""
-                    except FileExistsError:
-                        return "202 Accepted", {}, b""
-                if req.method == "DELETE":
-                    await self.rgw.delete_bucket(container)
-                    return "204 No Content", {}, b""
-                if req.method in ("GET", "HEAD"):
-                    res = await self.rgw.list_objects(
-                        container,
-                        prefix=req.query.get("prefix", ""),
-                        marker=req.query.get("marker", ""),
-                        max_keys=int(req.query.get("limit", "10000")))
-                    body = ("\n".join(m.key for m in res.keys)
-                            + ("\n" if res.keys else "")).encode()
-                    hdrs = {"Content-Type": "text/plain",
-                            "X-Container-Object-Count":
-                                str(len(res.keys))}
-                    return "200 OK", hdrs, (b"" if req.method == "HEAD"
-                                            else body)
-                return "405 Method Not Allowed", {}, b""
-            # object ops share the S3 core verbatim
-            if req.method == "PUT":
-                user_meta = {k[len("x-object-meta-"):]: v
-                             for k, v in req.headers.items()
-                             if k.startswith("x-object-meta-")}
-                etag = await self.rgw.put_object(
-                    container, obj, req.body,
-                    content_type=req.headers.get(
-                        "content-type", "application/octet-stream"),
-                    user_meta=user_meta)
-                return "201 Created", {"ETag": etag}, b""
-            if req.method in ("GET", "HEAD"):
-                meta = await self.rgw.head_object(container, obj)
-                hdrs = {"ETag": meta.etag,
-                        "Content-Type": meta.content_type}
-                for k, v in meta.user_meta.items():
-                    hdrs[f"X-Object-Meta-{k}"] = v
-                if req.method == "HEAD":
-                    hdrs["Content-Length"] = str(meta.size)
-                    return "200 OK", hdrs, b""
-                _, data = await self.rgw.get_object(container, obj)
-                return "200 OK", hdrs, data
-            if req.method == "DELETE":
-                await self.rgw.delete_object(container, obj)
-                return "204 No Content", {}, b""
-            return "405 Method Not Allowed", {}, b""
+                return await self._swift_container_op(req, container)
+            return await self._object_core(
+                req, container, obj, meta_prefix="x-object-meta-",
+                created_status="201 Created", quote_etag=False)
         except FileNotFoundError as e:
             return "404 Not Found", {}, str(e).encode()
+        except ValueError as e:
+            return "412 Precondition Failed", {}, str(e).encode()
+        except OSError as e:
+            if e.errno == 39:   # ENOTEMPTY: Swift's delete-conflict
+                return "409 Conflict", {}, b"container not empty"
+            raise
         except Exception as e:  # noqa: BLE001
             return "500 Internal Server Error", {}, repr(e).encode()
+
+    async def _swift_container_op(self, req: S3Request, container: str):
+        if req.method == "PUT":
+            try:
+                await self.rgw.create_bucket(container)
+                return "201 Created", {}, b""
+            except FileExistsError:
+                return "202 Accepted", {}, b""
+        if req.method == "DELETE":
+            await self.rgw.delete_bucket(container)
+            return "204 No Content", {}, b""
+        if req.method in ("GET", "HEAD"):
+            try:
+                limit = int(req.query.get("limit", "10000"))
+            except ValueError:
+                raise ValueError("limit must be an integer")
+            res = await self.rgw.list_objects(
+                container,
+                prefix=req.query.get("prefix", ""),
+                marker=req.query.get("marker", ""),
+                max_keys=limit)
+            # the header is the container's TOTAL object count, not the
+            # returned page's
+            total = len((await self.rgw._index(container)))
+            body = ("\n".join(m.key for m in res.keys)
+                    + ("\n" if res.keys else "")).encode()
+            hdrs = {"Content-Type": "text/plain",
+                    "X-Container-Object-Count": str(total)}
+            return "200 OK", hdrs, (b"" if req.method == "HEAD" else body)
+        return "405 Method Not Allowed", {}, b""
+
+    async def _object_core(self, req: S3Request, bucket: str, key: str,
+                           meta_prefix: str, created_status: str,
+                           quote_etag: bool):
+        """Object verbs shared by BOTH protocol dialects (the reference
+        routes S3 and Swift into the same RGWPutObj/RGWGetObj ops);
+        dialects differ only in meta-header prefix, ETag quoting, and
+        the created status line."""
+        def etag_hdr(e):
+            return f'"{e}"' if quote_etag else e
+
+        if req.method == "PUT":
+            user_meta = {k[len(meta_prefix):]: v
+                         for k, v in req.headers.items()
+                         if k.startswith(meta_prefix)}
+            etag = await self.rgw.put_object(
+                bucket, key, req.body,
+                content_type=req.headers.get(
+                    "content-type", "application/octet-stream"),
+                user_meta=user_meta)
+            return created_status, {"ETag": etag_hdr(etag)}, b""
+        if req.method in ("GET", "HEAD"):
+            meta = await self.rgw.head_object(bucket, key)
+            hdrs = {
+                "ETag": etag_hdr(meta.etag),
+                "Content-Type": meta.content_type,
+                "Last-Modified": time.strftime(
+                    "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(meta.mtime)),
+            }
+            for k, v in meta.user_meta.items():
+                hdrs[meta_prefix.title().rstrip("-") + "-" + k] = v
+            if req.method == "HEAD":
+                hdrs["Content-Length"] = str(meta.size)
+                return "200 OK", hdrs, b""
+            _, data = await self.rgw.get_object(bucket, key)
+            return "200 OK", hdrs, data
+        if req.method == "DELETE":
+            await self.rgw.delete_object(bucket, key)
+            return "204 No Content", {}, b""
+        return "405 Method Not Allowed", {}, b""
 
     @staticmethod
     def _error_xml(code: str, msg: str) -> bytes:
@@ -343,36 +382,9 @@ class RGWFrontend:
             return await self._multipart_op(req, bucket, key,
                                             req.query["uploadId"])
 
-        if req.method == "PUT":
-            user_meta = {k[len("x-amz-meta-"):]: v
-                         for k, v in req.headers.items()
-                         if k.startswith("x-amz-meta-")}
-            etag = await self.rgw.put_object(
-                bucket, key, req.body,
-                content_type=req.headers.get("content-type",
-                                             "application/octet-stream"),
-                user_meta=user_meta)
-            return "200 OK", {"ETag": f'"{etag}"'}, b""
-        if req.method in ("GET", "HEAD"):
-            meta = await self.rgw.head_object(bucket, key)
-            headers = {
-                "ETag": f'"{meta.etag}"',
-                "Content-Type": meta.content_type,
-                "Last-Modified": time.strftime(
-                    "%a, %d %b %Y %H:%M:%S GMT",
-                    time.gmtime(meta.mtime)),
-            }
-            for k, v in meta.user_meta.items():
-                headers[f"x-amz-meta-{k}"] = v
-            if req.method == "HEAD":
-                headers["Content-Length"] = str(meta.size)
-                return "200 OK", headers, b""
-            _, data = await self.rgw.get_object(bucket, key)
-            return "200 OK", headers, data
-        if req.method == "DELETE":
-            await self.rgw.delete_object(bucket, key)
-            return "204 No Content", {}, b""
-        return "405 Method Not Allowed", {}, b""
+        return await self._object_core(
+            req, bucket, key, meta_prefix="x-amz-meta-",
+            created_status="200 OK", quote_etag=True)
 
     # -- multipart ---------------------------------------------------------
 

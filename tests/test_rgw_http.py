@@ -197,9 +197,8 @@ def test_swift_api_surface():
             st, _, body = await _http(addr, "GET", "/swift/v1",
                                       headers=tok)
             assert st == 200 and b"cont" in body
-            # cross-protocol: the S3 side (no auth configured for S3 in
-            # this server? accounts apply to S3 too) sees the object
-            import hashlib as _hl
+            # cross-protocol: the same accounts sign S3 requests, and
+            # the S3 side sees the Swift-written object
             sig = {"Authorization": RGWFrontend.sign(
                 "GET", "/cont/obj.txt", "now", "swifty", "s3cr3t"),
                 "x-amz-date": "now"}
@@ -213,6 +212,49 @@ def test_swift_api_surface():
             st, _, _ = await _http(addr, "GET", "/swift/v1/cont/obj.txt",
                                    headers=tok)
             assert st == 404
+            await fe.stop()
+        finally:
+            await cluster.stop()
+
+    asyncio.run(scenario())
+
+
+def test_swift_edge_semantics():
+    """Round-4 review fixes: prefix guard, directory markers, 409 on
+    non-empty delete, 412 on bad limit, total object count header."""
+    async def scenario():
+        cluster = await start_cluster(2)
+        try:
+            fe, addr = await _gateway(cluster)
+            # an S3 bucket literally named 'swift' stays on the S3 path
+            st, _, _ = await _http(addr, "PUT", "/swift")
+            assert st == 200
+            st, _, _ = await _http(addr, "PUT", "/swift/v1.txt", b"s3!")
+            assert st == 200
+            st, _, body = await _http(addr, "GET", "/swift/v1.txt")
+            assert st == 200 and body == b"s3!"
+            # swift proper (no accounts -> open)
+            st, _, _ = await _http(addr, "PUT", "/swift/v1/c")
+            assert st == 201
+            # pseudo-directory marker keeps its trailing slash
+            st, _, _ = await _http(addr, "PUT", "/swift/v1/c/dir/", b"")
+            assert st == 201
+            st, _, _ = await _http(addr, "PUT", "/swift/v1/c/dir", b"real")
+            assert st == 201
+            st, _, listing = await _http(addr, "GET", "/swift/v1/c")
+            assert set(listing.decode().split()) == {"dir", "dir/"}
+            # total count header, independent of the page limit
+            st, h, _ = await _http(addr, "GET", "/swift/v1/c?limit=1")
+            assert h["x-container-object-count"] == "2"
+            # bad limit -> 412, not 500
+            st, _, _ = await _http(addr, "GET", "/swift/v1/c?limit=abc")
+            assert st == 412
+            # delete non-empty -> 409
+            st, _, _ = await _http(addr, "DELETE", "/swift/v1/c")
+            assert st == 409
+            # account endpoint refuses mutations
+            st, _, _ = await _http(addr, "DELETE", "/swift/v1")
+            assert st == 405
             await fe.stop()
         finally:
             await cluster.stop()
