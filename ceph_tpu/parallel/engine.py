@@ -54,6 +54,19 @@ class MeshECEngine:
         self._chunk_sh = NamedSharding(mesh, P("data", "shard", None))
         self._repl = NamedSharding(mesh, P())
 
+    @staticmethod
+    def _put(x, sharding):
+        """Place ``x`` on the mesh WITHOUT touching the default backend.
+
+        jax.device_put takes host numpy directly; routing through
+        jnp.asarray first would commit the array to the *default*
+        platform (the real TPU under axon) before the mesh placement —
+        the exact failure that turned the round-4 multichip dryrun red
+        (MULTICHIP_r04) and the closure-poison lesson in transfer form."""
+        if not isinstance(x, jax.Array):
+            x = np.asarray(x)
+        return jax.device_put(x, sharding)
+
     # -- encode ------------------------------------------------------------
 
     def _build_encode(self):
@@ -61,9 +74,13 @@ class MeshECEngine:
         enc = self._enc_bitmat
 
         def step(data):
+            # ``enc`` stays host numpy: it lifts into the jaxpr as a
+            # constant during tracing.  jnp.asarray here would eagerly
+            # commit it to the DEFAULT backend mid-trace — a real-TPU
+            # touch even when the mesh is the virtual CPU one.
             b, _, chunk = data.shape
             cols = data.transpose(1, 0, 2).reshape(k, b * chunk)
-            parity = gf8.bitmatrix_matmul(jnp.asarray(enc), cols)
+            parity = gf8.bitmatrix_matmul(enc, cols)
             return parity.reshape(m, b, chunk).transpose(1, 0, 2)
 
         return jax.jit(step, in_shardings=(self._data_sh,),
@@ -73,7 +90,7 @@ class MeshECEngine:
         """(B, k, S) -> (B, m, S) parity, stripes sharded over 'data'."""
         if not self._enc_jit:
             self._enc_jit["fn"] = self._build_encode()
-        data = jax.device_put(jnp.asarray(data), self._data_sh)
+        data = self._put(data, self._data_sh)
         return self._enc_jit["fn"](data)
 
     # -- decode (arbitrary erasure pattern) --------------------------------
@@ -108,7 +125,7 @@ class MeshECEngine:
             b, _, chunk = chunks.shape
             survivors = chunks[:, src_arr, :]
             cols = survivors.transpose(1, 0, 2).reshape(k, b * chunk)
-            out = gf8.bitmatrix_matmul(jnp.asarray(bitmat), cols)
+            out = gf8.bitmatrix_matmul(bitmat, cols)
             return out.reshape(len(want), b, chunk).transpose(1, 0, 2)
 
         return jax.jit(step, in_shardings=(self._chunk_sh,),
@@ -128,7 +145,7 @@ class MeshECEngine:
         key = (src, want)
         if key not in self._dec_jit:
             self._dec_jit[key] = self._build_decode(src, want)
-        chunks = jax.device_put(jnp.asarray(chunks), self._chunk_sh)
+        chunks = self._put(chunks, self._chunk_sh)
         return self._dec_jit[key](chunks)
 
     # -- RMW (delta parity update) -----------------------------------------
@@ -148,7 +165,7 @@ class MeshECEngine:
                 chunks[:, :k, :], col_start, width, axis=2)
             delta = old ^ update
             dcols = delta.transpose(1, 0, 2).reshape(k, b * width)
-            pdelta = gf8.bitmatrix_matmul(jnp.asarray(enc), dcols)
+            pdelta = gf8.bitmatrix_matmul(enc, dcols)
             pdelta = pdelta.reshape(m, b, width).transpose(1, 0, 2)
             new_data = jax.lax.dynamic_update_slice_in_dim(
                 chunks[:, :k, :], update, col_start, axis=2)
@@ -165,13 +182,14 @@ class MeshECEngine:
         """Partial-stripe overwrite: replace data columns
         [col_start, col_start+len) with ``update`` (B, k, width) and
         delta-update the parity in place."""
-        update = jnp.asarray(update)
+        if not isinstance(update, jax.Array):
+            update = np.asarray(update)
         width = update.shape[2]
         key = (col_start, width)
         if key not in self._rmw_jit:
             self._rmw_jit[key] = self._build_rmw(col_start, width)
-        chunks = jax.device_put(jnp.asarray(chunks), self._chunk_sh)
-        update = jax.device_put(update, self._data_sh)
+        chunks = self._put(chunks, self._chunk_sh)
+        update = self._put(update, self._data_sh)
         return self._rmw_jit[key](chunks, update)
 
 
@@ -245,29 +263,44 @@ def wrap_codec_for_mesh(codec, n_devices: int = 0):
     return MeshCodecAdapter(codec, mesh_for_codec(codec, n_devices))
 
 
+_CRUSH_SHARDED_CACHE: Dict[Tuple, Tuple] = {}
+
+
 def crush_batch_sharded(mesh: Mesh, mapper, ruleno: int, xs, result_max: int,
                         weights):
     """Whole-map CRUSH placement sharded over every mesh device: the
     per-x rule VM is embarrassingly parallel, so sharding xs over the
     flattened mesh scales placement linearly with chips (reference
     crush_do_rule is a per-x scalar loop, src/crush/mapper.c:883)."""
-    import jax.numpy as jnp
-
     n_dev = mesh.devices.size
     xs = np.asarray(xs, dtype=np.uint32)
     pad = (-len(xs)) % n_dev
     if pad:
         xs = np.concatenate([xs, np.zeros(pad, dtype=np.uint32)])
-    fn, tensors = mapper.compiled_rule(ruleno, result_max)
     x_sh = NamedSharding(mesh, P(("data", "shard")))
-    sharded = jax.jit(
-        lambda x, w, t: fn(x, w, t),
-        in_shardings=(x_sh, NamedSharding(mesh, P()), None),
-        out_shardings=(NamedSharding(mesh, P(("data", "shard"), None)),
-                       x_sh),
-    )
+    w_sh = NamedSharding(mesh, P())
+    # cache the sharded wrapper + the mesh-replicated map tensors so
+    # repeat placement calls (rebalance loops, tester sweeps) hit XLA's
+    # jit cache instead of retracing + re-transferring the whole map
+    key = (id(mapper), ruleno, result_max, mesh)
+    if key not in _CRUSH_SHARDED_CACHE:
+        fn, tensors = mapper.compiled_rule(ruleno, result_max)
+        # the mapper's map tensors live on the DEFAULT backend (mapper.py
+        # builds them with jnp.asarray); replicate them onto the mesh so
+        # the sharded dispatch never mixes backends
+        tensors = jax.device_put(tensors, w_sh)
+        sharded = jax.jit(
+            lambda x, w, t: fn(x, w, t),
+            in_shardings=(x_sh, w_sh, None),
+            out_shardings=(NamedSharding(mesh, P(("data", "shard"), None)),
+                           x_sh),
+        )
+        _CRUSH_SHARDED_CACHE[key] = (sharded, tensors)
+    sharded, tensors = _CRUSH_SHARDED_CACHE[key]
     res, lens = sharded(jax.device_put(xs, x_sh),
-                        jnp.asarray(weights, dtype=jnp.uint32), tensors)
+                        jax.device_put(
+                            np.asarray(weights, dtype=np.uint32), w_sh),
+                        tensors)
     if pad:
         res, lens = res[:-pad], lens[:-pad]
     return res, lens

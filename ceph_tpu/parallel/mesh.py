@@ -81,8 +81,11 @@ def distributed_ec_step(mesh: Mesh, k: int, m: int, batch: int, chunk: int):
     def step(data):
         # data: (batch, k, chunk) uint8, sharded over the stripe batch
         b = data.shape[0]
+        # enc_bitmat/rec_bitmat stay host numpy: they lift into the jaxpr
+        # as constants; jnp.asarray here would eagerly commit them to the
+        # default backend mid-trace (see MeshECEngine._put).
         cols = data.transpose(1, 0, 2).reshape(k, b * chunk)
-        parity = gf8.bitmatrix_matmul(jnp.asarray(enc_bitmat), cols)
+        parity = gf8.bitmatrix_matmul(enc_bitmat, cols)
         parity = parity.reshape(m, b, chunk).transpose(1, 0, 2)
         chunks = jnp.concatenate([data, parity], axis=1)
         # distribute shards over the shard axis (Ceph: shards to distinct OSDs)
@@ -90,7 +93,7 @@ def distributed_ec_step(mesh: Mesh, k: int, m: int, batch: int, chunk: int):
         # reconstruct shard 0 from k survivors (XLA gathers across 'shard')
         survivors = chunks[:, 1 : k + 1, :]
         scols = survivors.transpose(1, 0, 2).reshape(k, b * chunk)
-        recon = gf8.bitmatrix_matmul(jnp.asarray(rec_bitmat), scols).reshape(b, chunk)
+        recon = gf8.bitmatrix_matmul(rec_bitmat, scols).reshape(b, chunk)
         mismatches = jnp.sum((recon != chunks[:, 0, :]).astype(jnp.int32))
         return mismatches, chunks
 

@@ -1,0 +1,108 @@
+"""The mesh data plane must NEVER commit host data to the default backend.
+
+Round-4 regression (MULTICHIP_r04 RED): ``jnp.asarray(host_data)`` before
+``jax.device_put`` commits the array to the *default* platform — under the
+driver that is the real TPU, and a skewed libtpu made the touch fatal even
+though the mesh was the virtual CPU one.  The only allowed placement path
+is ``jax.device_put(numpy, mesh_sharding)`` (MeshECEngine._put).
+
+Enforcement: rebind the ``jnp`` global of the parallel modules to a proxy
+whose ``asarray``/``array`` raise on host (non-Array, non-Tracer) input,
+then exercise the full engine surface.  Any reintroduced eager commit —
+including a trace-time constant commit — trips the proxy.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as real_jnp
+
+from ceph_tpu.ec import matrices
+from ceph_tpu.parallel import (MeshECEngine, crush_batch_sharded,
+                               distributed_ec_step, make_mesh)
+from ceph_tpu.parallel import engine as engine_mod
+from ceph_tpu.parallel import mesh as mesh_mod
+
+
+class _NoHostCommitJnp:
+    """jnp proxy: forbids asarray/array on host data."""
+
+    def _guard(self, name, x):
+        if not isinstance(x, (jax.Array, jax.core.Tracer)):
+            raise AssertionError(
+                f"jnp.{name} called on host data of type {type(x).__name__}"
+                " — this commits to the DEFAULT backend; use"
+                " jax.device_put(numpy, mesh_sharding) instead"
+            )
+
+    def asarray(self, x, *a, **kw):
+        self._guard("asarray", x)
+        return real_jnp.asarray(x, *a, **kw)
+
+    def array(self, x, *a, **kw):
+        self._guard("array", x)
+        return real_jnp.array(x, *a, **kw)
+
+    def __getattr__(self, name):
+        return getattr(real_jnp, name)
+
+
+@pytest.fixture
+def forbid_host_commits(monkeypatch):
+    proxy = _NoHostCommitJnp()
+    monkeypatch.setattr(engine_mod, "jnp", proxy)
+    monkeypatch.setattr(mesh_mod, "jnp", proxy)
+
+
+def _assert_on_mesh(arr, mesh):
+    mesh_devs = set(mesh.devices.flatten().tolist())
+    assert set(arr.devices()) <= mesh_devs, (
+        f"array landed on {arr.devices()} outside the mesh")
+
+
+def test_engine_surface_never_touches_default_backend(forbid_host_commits):
+    mesh = make_mesh(8)
+    k, m = 8, 4
+    eng = MeshECEngine(mesh, k, m, matrices.isa_rs_matrix(k, m))
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, (8, k, 128), dtype=np.uint8)
+
+    parity = eng.encode_batch(data)
+    _assert_on_mesh(parity, mesh)
+    chunks = np.concatenate([data, np.asarray(parity)], axis=1)
+
+    update = rng.integers(0, 256, (8, k, 32), dtype=np.uint8)
+    new_chunks = eng.rmw_batch(chunks, update, col_start=16)
+    _assert_on_mesh(new_chunks, mesh)
+
+    got = eng.decode_batch((0, 5, 9), np.asarray(new_chunks))
+    _assert_on_mesh(got, mesh)
+    assert np.array_equal(np.asarray(got),
+                          np.asarray(new_chunks)[:, [0, 5, 9], :])
+
+
+def test_distributed_step_never_touches_default_backend(forbid_host_commits):
+    mesh = make_mesh(8)
+    fn, args = distributed_ec_step(mesh, k=8, m=4, batch=8, chunk=128)
+    _assert_on_mesh(args[0], mesh)
+    mismatches, chunks = fn(*args)
+    assert int(mismatches) == 0
+    _assert_on_mesh(chunks, mesh)
+
+
+def test_crush_batch_sharded_never_touches_default_backend(
+        forbid_host_commits):
+    from ceph_tpu.crush.mapper import TensorMapper
+    from ceph_tpu.crush.types import build_hierarchy
+
+    cmap, rule = build_hierarchy(n_hosts=4, osds_per_host=2, numrep=3)
+    mapper = TensorMapper(cmap)
+    weights = np.full(cmap.max_devices, 0x10000, dtype=np.uint32)
+    xs = np.arange(64, dtype=np.uint32)
+    mesh = make_mesh(8)
+    res, lens = crush_batch_sharded(mesh, mapper, rule, xs, 3, weights)
+    _assert_on_mesh(res, mesh)
+    single = np.asarray(
+        mapper.do_rule_batch(rule, xs, result_max=3, weights=weights)[0])
+    assert np.array_equal(np.asarray(res), single)
