@@ -138,9 +138,127 @@ class TensorMapper:
         ln_neg = [0x1000000000000 - crush_ln(u) for u in range(0x10000)]
         lnn_hi, lnn_lo = _split_u64(ln_neg)
         self._lnn = (jnp.asarray(lnn_hi), jnp.asarray(lnn_lo))
+        self._build_fast_straw2(items, weights, sizes, ln_neg)
+        # per-bucket scalar metadata as ONE row-gathered tensor: element
+        # gathers (sizes[bno], btypes[bno], ...) scalarize on TPU (~0.5 ms
+        # per 64 Ki lanes) while row gathers vectorize (~76 us); packing
+        # [size, type, wbase, rep] into one (nb, 4) row costs one row
+        # gather where four element gathers used to run
+        meta = np.zeros((self.nb, 4), dtype=np.int32)
+        meta[:, 0] = sizes
+        meta[:, 1] = btypes
+        if self._fast:
+            meta[:, 2] = (self._wclass_np.astype(np.int64) << 17).astype(
+                np.int32)
+            meta[:, 3] = np.asarray(self._rep)[self._wclass_np]
+        self._meta = jnp.asarray(meta)
         # bound per-dispatch memory: lanes * max_bucket_size * ~32 u32 temps
         self.chunk = max(512, min(chunk, (1 << 24) // max(max_sz, 1)))
         self._compiled: Dict = {}
+
+    # ------------------------------------------------- fast straw2 tables
+
+    _MAX_WEIGHT_CLASSES = 64
+
+    def _build_fast_straw2(self, items, weights, sizes, ln_neg):
+        """Precompute the gather-free straw2 path (round 5).
+
+        The honest (on-device-loop) benchmark showed the per-(lane, item)
+        gathers from the 64 Ki |ln| table scalarize on TPU and cost ~37 ms
+        per straw2 call at 64 Ki lanes — ~100% of rule runtime.  For
+        buckets whose item weights are UNIFORM, the winning item can be
+        found without evaluating draws at all: draw = div64_s64(ln, w) is
+        a non-decreasing function g of u = hash & 0xffff (crush_ln is
+        non-decreasing except at the single u = 65535 table anomaly), so
+        "first item with draw == max draw" (mapper.c:322-367 keeps the
+        first strict maximum) equals "first item whose u lies in the top
+        plateau of g".  Host-side, per distinct bucket weight, we build
+        the plateau-start table on a doubled domain u' = 2u (u = 65535
+        maps to an odd/even representative that is order-isomorphic to
+        g(65535), preserving exact tie semantics with the anomaly), and
+        the device does: u'max = max(u'), T = P2[u'max], winner = first
+        item with u' >= T — ONE lane-sized gather instead of two
+        (lane x item)-sized ones.  Bit-exact vs the C semantics by
+        construction; golden tests cover it.
+
+        Maps with any non-uniform bucket (e.g. balancer weight_set
+        overrides) keep the general |ln|-gather path.
+        """
+        nb = items.shape[0]
+        self._fast = False
+        self._wclass_np = None
+        # placeholders so _tensor_args stays total on non-fast maps
+        self._p2flat = jnp.zeros(1, dtype=I32)
+        self._wclass = jnp.zeros(1, dtype=I32)
+        self._rep = jnp.zeros(1, dtype=I32)
+        # uniform check per bucket (over the first `size` items)
+        class_weights = []
+        wclass = np.zeros(nb, dtype=np.int32)
+        for row in range(nb):
+            sz = int(sizes[row])
+            ws = weights[row, :sz]
+            if sz == 0:
+                wclass[row] = 0 if class_weights else -1
+                continue
+            w0 = int(ws[0])
+            if w0 == 0 or not np.all(ws == w0):
+                return  # non-uniform bucket: general path for this map
+            if w0 not in class_weights:
+                class_weights.append(w0)
+            wclass[row] = class_weights.index(w0)
+        if not class_weights or len(class_weights) > self._MAX_WEIGHT_CLASSES:
+            return
+        # empty buckets with no class yet: point at class 0 (never drawn)
+        wclass[wclass < 0] = 0
+        lnn = np.array(ln_neg, dtype=np.uint64)
+        # the construction below relies on crush_ln being non-decreasing on
+        # [0, 65534] (the single decreasing site is 65534 -> 65535)
+        assert np.all(np.diff(lnn[:65535].astype(np.int64)) <= 0)
+        p2_all = np.zeros((len(class_weights), 1 << 17), dtype=np.int32)
+        rep_all = np.zeros(len(class_weights), dtype=np.int32)
+        for ci, w in enumerate(class_weights):
+            # g(u) = -draw = ln_neg[u] // w, non-increasing on [0, 65534]
+            g = (lnn // np.uint64(w)).astype(np.int64)
+            body, g_last = g[:65535], int(g[65535])
+            # plateau starts on the monotone body (g non-increasing)
+            change = np.empty(65535, dtype=bool)
+            change[0] = True
+            change[1:] = body[1:] != body[:-1]
+            starts = np.maximum.accumulate(
+                np.where(change, np.arange(65535), 0))
+            p2 = np.zeros(1 << 17, dtype=np.int32)
+            p2[0::2][:65535] = 2 * starts
+            p2[1::2] = np.arange(1, 1 << 17, 2)  # odd slots: own plateau
+            # u = 65535 anomaly: place g_last order-exactly among the body
+            # (body is DEscending in u; draws AScend).  Find its plateau.
+            asc = body[::-1]  # ascending g
+            import bisect
+
+            lo = bisect.bisect_left(asc, g_last)
+            hi_i = bisect.bisect_right(asc, g_last)
+            if lo != hi_i:
+                # ties an existing plateau [a, b] (in u-domain)
+                a = 65534 - (hi_i - 1)
+                b = 65534 - lo
+                rep = 2 * b        # behaves as the plateau's largest u
+                p2[rep] = 2 * a    # plateau start covers the anomaly rep
+                rep_all[ci] = rep
+                p2[2 * 65535] = 2 * a  # if u'max==2*65535 slot ever read
+            else:
+                # unique value: sits between two plateaus; `lo` entries of
+                # the body have g < g_last (draw greater), and they occupy
+                # the largest u values, so the first such u-index is:
+                a = 65535 - lo
+                rep = 2 * a - 1 if a > 0 else -1
+                rep_all[ci] = rep
+                if rep >= 0:
+                    p2[rep] = rep  # its own (singleton) plateau
+            p2_all[ci] = p2
+        self._fast = True
+        self._wclass_np = wclass
+        self._p2flat = jnp.asarray(p2_all.reshape(-1))
+        self._wclass = jnp.asarray(wclass)
+        self._rep = jnp.asarray(rep_all)
 
     # ------------------------------------------------------------------ ln
 
@@ -223,16 +341,31 @@ class TensorMapper:
         """bucket_straw2_choose (mapper.c:322-367) over a lane batch.
 
         bno (L,), x (L,) uint32, r (L,) int32 -> chosen item (L,) int32.
+
+        Uniform-weight maps take the gather-free plateau path (see
+        _build_fast_straw2); others evaluate |ln| draws via table gather.
         """
         it = self.items[bno]                      # (L, S)
-        wt = self.iweights[bno]
-        sz = self.sizes[bno]
+        meta = self._meta[bno]                    # (L, 4) row gather
+        sz = meta[:, 0]
         u = jenkins.hash3(x[:, None], it.astype(U32), r.astype(U32)[:, None]) & 0xFFFF
+        pos = jnp.arange(it.shape[1], dtype=I32)
+        if self._fast:
+            # uniform weights are nonzero by construction: invalid = padding
+            invalid = pos[None, :] >= sz[:, None]
+            u2 = jnp.where(u == 65535, meta[:, 3:4], (2 * u).astype(I32))
+            u2 = jnp.where(invalid, I32(-1), u2)
+            umax = u2.max(axis=1)
+            tidx = meta[:, 2] + jnp.clip(umax, 0)
+            thresh = self._p2flat[tidx]           # (L,) gather
+            win = u2 >= thresh[:, None]
+            idx = jnp.argmax(win, axis=1)
+            return jnp.take_along_axis(it, idx[:, None], axis=1)[:, 0]
+        wt = self.iweights[bno]
+        invalid = (wt == 0) | (pos[None, :] >= sz[:, None])
         n = (self._lnn[0][u], self._lnn[1][u])
         qh, ql = u64pair.div_by_recip(
             n, wt, self.recip_hi[bno], self.recip_lo[bno])
-        pos = jnp.arange(it.shape[1], dtype=I32)
-        invalid = (wt == 0) | (pos[None, :] >= sz[:, None])
         qh = jnp.where(invalid, jnp.uint32(0xFFFFFFFF), qh)
         ql = jnp.where(invalid, jnp.uint32(0xFFFFFFFF), ql)
         # first-occurrence two-level argmin (draw > high_draw semantics)
@@ -265,8 +398,9 @@ class TensorMapper:
         for _ in range(self.max_depth):
             is_b = cur < 0
             bno = jnp.clip(-1 - cur, 0, self.nb - 1)
-            need = is_b & (self.btypes[bno] != type_)
-            empty = need & (self.sizes[bno] == 0)
+            meta = self._meta[bno]
+            need = is_b & (meta[:, 1] != type_)
+            empty = need & (meta[:, 0] == 0)
             hit_empty = hit_empty | empty
             nxt = self._straw2(bno, x, r)
             cur = jnp.where(need & ~empty, nxt, cur)
@@ -274,7 +408,7 @@ class TensorMapper:
 
     def _bad_item(self, cur, type_):
         bno = jnp.clip(-1 - cur, 0, self.nb - 1)
-        wrong_bucket = (cur < 0) & (self.btypes[bno] != type_)
+        wrong_bucket = (cur < 0) & (self._meta[bno][:, 1] != type_)
         wrong_dev = (cur >= 0) & ((type_ != 0) | (cur >= self.max_devices))
         return wrong_bucket | wrong_dev
 
@@ -454,7 +588,8 @@ class TensorMapper:
     # over a device-resident array permanently degrades every subsequent
     # dispatch in the process on the axon platform (~150x slowdown).
     _TENSOR_ATTRS = ("items", "iweights", "sizes", "btypes", "recip_hi",
-                     "recip_lo", "_rh", "_lh", "_ll", "_lnn")
+                     "recip_lo", "_rh", "_lh", "_ll", "_lnn",
+                     "_p2flat", "_meta")
 
     def _tensor_args(self):
         return {a: getattr(self, a) for a in self._TENSOR_ATTRS}

@@ -4,18 +4,34 @@ Fuses unpack -> MXU int8 matmul -> parity mask -> pack inside VMEM, one
 grid program per column tile, with the (small) bit-matrix resident in
 VMEM (see /opt/skills/guides/pallas_guide.md for the kernel model).
 
-MEASURED VERDICT (v5e, ISA k=8,m=4 headline shape, round 3): the XLA
-path sustains ~1,136 GB/s; this kernel reaches ~167 GB/s at tile 2048
-and does NOT improve with larger tiles (130 GB/s at 8k-32k).  Root
-cause: Mosaic only supports minor-dim-inserting reshapes on 32-bit
-types, so the in-kernel unpack must widen the payload 4x through int32
-VMEM before the int8 MXU feed, while XLA's fusion pipelines the bit
-expansion straight into the matmul operand without that inflation.  The
-production engines therefore keep the XLA path; this kernel stays as a
-validated, benchmarked alternative (bit-exact vs gf8.bitmatrix_matmul
-on the real device) and the measurement record for why hand-scheduling
-loses to the compiler here — exactly the "profile, iterate" loop the
-scaling playbook prescribes.
+Round-5 redesign (bit-major layout): the v1 kernel reshaped the unpacked
+bits through int32 VMEM (Mosaic only supports minor-dim-inserting
+reshapes on 32-bit types), inflating VMEM traffic 4x.  v2 permutes the
+bit-matrix rows/columns to BIT-MAJOR order host-side (row' = b*r + j,
+col' = b*k + i), so the in-kernel unpack is a plain concatenate of eight
+(k, TN) bit slabs and the pack is eight shift-or folds — no reshapes at
+all.
+
+MEASURED VERDICT (v5e, ISA k=8,m=4 headline shape, round-5 HONEST
+harness — on-device scan loop with slope timing, see BENCH_NOTES.md; the
+round-3 numbers comparing 1,136 vs 167 GB/s were both artifacts of
+`block_until_ready` not waiting for completion on the axon tunnel):
+
+    XLA fused path        337-414 us / 16.7 MB step
+    this kernel (v2)      307-309 us (TN >= 8192)
+    v1 kernel (chunk-major, int32 reshapes)  490 us
+
+The kernel wins ~25% on the pre-transposed (k, N) column layout, but the
+end-to-end batch path needs the (B,k,S) <-> (k,N) transposes either way
+(doing the transpose in-kernel measured 477 us — VMEM int32 transposes
+lose to XLA's HBM transpose), which makes the full path a wash.  The
+production engines therefore keep the XLA path; this kernel stays as the
+validated, benchmarked alternative (bit-exact vs gf8.bitmatrix_matmul on
+the real device) and the measurement record.  Both paths sit near two
+simultaneous walls: HBM traffic of the materialized bit planes and the
+MXU shape-padding floor (K=64, M=32 occupies 1/8 of the 128x128 array —
+block-diagonal stacking measured no gain).  Going materially faster
+requires bit-planar shard storage end-to-end (future work).
 """
 
 from __future__ import annotations
@@ -26,30 +42,39 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-_TILE_N = 2048
+_TILE_N = 16384
 
 
-def _kernel(bitmat_ref, data_ref, out_ref, *, k: int, r: int):
-    # stay in 32-bit for the shape manipulation (Mosaic only supports
-    # minor-dim-inserting reshapes on 32-bit types), drop to int8 at the
-    # MXU boundary
-    tn = data_ref.shape[-1]
-    data = data_ref[:].astype(jnp.int32)                   # (k, TN)
-    shifts = jnp.arange(8, dtype=jnp.int32)
-    bits = ((data[:, None, :] >> shifts[None, :, None]) & 1)
-    bits = bits.reshape(k * 8, tn).astype(jnp.int8)
+@functools.lru_cache(maxsize=64)
+def _bitmajor_perm(r8: int, k8: int):
+    """Row/col permutations taking a chunk-major bit-matrix (row = j*8+b,
+    col = i*8+b, from gf8.expand_bitmatrix) to bit-major order."""
+    r, k = r8 // 8, k8 // 8
+    rowp = [j * 8 + b for b in range(8) for j in range(r)]
+    colp = [i * 8 + b for b in range(8) for i in range(k)]
+    return np.asarray(rowp), np.asarray(colp)
+
+
+def _kernel(bm_ref, d_ref, o_ref, *, k: int, r: int):
+    tn = d_ref.shape[-1]
+    d32 = d_ref[:].astype(jnp.int32)                      # (k, TN)
+    # bit-major unpack: slab b holds bit b of every chunk row — no
+    # reshape needed because the matrix columns were permuted to match
+    bits = jnp.concatenate(
+        [((d32 >> b) & 1).astype(jnp.int8) for b in range(8)], axis=0)
     acc = jax.lax.dot_general(
-        bitmat_ref[:].astype(jnp.int8), bits,
+        bm_ref[:], bits,
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32,
-    ) & 1                                                  # (r*8, TN)
-    acc = acc.reshape(r, 8, tn)
-    weights = (1 << jnp.arange(8, dtype=jnp.int32))[None, :, None]
-    out_ref[:] = jnp.sum(acc * weights, axis=1).astype(jnp.uint8)
+    )                                                      # (8r, TN)
+    out = jnp.zeros((r, tn), jnp.int32)
+    for b in range(8):
+        out = out | ((acc[b * r:(b + 1) * r] & 1) << b)
+    o_ref[:] = out.astype(jnp.uint8)
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3))
-def _matmul_tiled(bitmat, data, k: int, r: int):
+def _matmul_tiled(bitmat_bm, data, k: int, r: int):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -69,7 +94,7 @@ def _matmul_tiled(bitmat, data, k: int, r: int):
             out_specs=pl.BlockSpec((r, _TILE_N), lambda i: (0, i),
                                    memory_space=pltpu.VMEM),
         ),
-    )(bitmat, data)
+    )(bitmat_bm, data)
 
 
 def bitmatrix_matmul(bitmat, data):
@@ -77,15 +102,18 @@ def bitmatrix_matmul(bitmat, data):
     ragged tail (n % TILE) falls back to the XLA path and concatenates."""
     from ceph_tpu.ops import gf8
 
-    bitmat = jnp.asarray(bitmat)
     data = jnp.asarray(data)
     rw, kw = bitmat.shape
     k, r = kw // 8, rw // 8
+    rowp, colp = _bitmajor_perm(rw, kw)
+    # permute with jnp indexing so device arrays and tracers work without
+    # a host round-trip (the matrix is tiny; the gather is trace-safe)
+    bm_bm = jnp.asarray(bitmat)[rowp][:, colp].astype(jnp.int8)
     n = data.shape[1]
     main = (n // _TILE_N) * _TILE_N
     parts = []
     if main:
-        parts.append(_matmul_tiled(bitmat, data[:, :main], k, r))
+        parts.append(_matmul_tiled(bm_bm, data[:, :main], k, r))
     if main < n:
         parts.append(gf8.bitmatrix_matmul(bitmat, data[:, main:]))
     return parts[0] if len(parts) == 1 else \
@@ -98,7 +126,7 @@ def available() -> bool:
     try:
         if jax.default_backend() not in ("tpu", "axon"):
             return False
-        bm = jnp.asarray(np.eye(8, dtype=np.uint8))
+        bm = jnp.asarray(np.eye(8, dtype=np.int8))
         d = jnp.zeros((1, _TILE_N), dtype=jnp.uint8)
         out = _matmul_tiled(bm, d, 1, 1)
         jax.block_until_ready(out)

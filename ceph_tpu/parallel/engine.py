@@ -263,9 +263,6 @@ def wrap_codec_for_mesh(codec, n_devices: int = 0):
     return MeshCodecAdapter(codec, mesh_for_codec(codec, n_devices))
 
 
-_CRUSH_SHARDED_CACHE: Dict[Tuple, Tuple] = {}
-
-
 def crush_batch_sharded(mesh: Mesh, mapper, ruleno: int, xs, result_max: int,
                         weights):
     """Whole-map CRUSH placement sharded over every mesh device: the
@@ -279,11 +276,16 @@ def crush_batch_sharded(mesh: Mesh, mapper, ruleno: int, xs, result_max: int,
         xs = np.concatenate([xs, np.zeros(pad, dtype=np.uint32)])
     x_sh = NamedSharding(mesh, P(("data", "shard")))
     w_sh = NamedSharding(mesh, P())
-    # cache the sharded wrapper + the mesh-replicated map tensors so
-    # repeat placement calls (rebalance loops, tester sweeps) hit XLA's
-    # jit cache instead of retracing + re-transferring the whole map
-    key = (id(mapper), ruleno, result_max, mesh)
-    if key not in _CRUSH_SHARDED_CACHE:
+    # cache the sharded wrapper + the mesh-replicated map tensors ON the
+    # mapper (so the cache dies with the map epoch and an id() reuse can
+    # never serve a stale map), keyed by rule/result/mesh — repeat
+    # placement calls hit XLA's jit cache instead of retracing +
+    # re-transferring the whole map
+    cache = getattr(mapper, "_sharded_cache", None)
+    if cache is None:
+        cache = mapper._sharded_cache = {}
+    key = (ruleno, result_max, mesh)
+    if key not in cache:
         fn, tensors = mapper.compiled_rule(ruleno, result_max)
         # the mapper's map tensors live on the DEFAULT backend (mapper.py
         # builds them with jnp.asarray); replicate them onto the mesh so
@@ -295,8 +297,8 @@ def crush_batch_sharded(mesh: Mesh, mapper, ruleno: int, xs, result_max: int,
             out_shardings=(NamedSharding(mesh, P(("data", "shard"), None)),
                            x_sh),
         )
-        _CRUSH_SHARDED_CACHE[key] = (sharded, tensors)
-    sharded, tensors = _CRUSH_SHARDED_CACHE[key]
+        cache[key] = (sharded, tensors)
+    sharded, tensors = cache[key]
     res, lens = sharded(jax.device_put(xs, x_sh),
                         jax.device_put(
                             np.asarray(weights, dtype=np.uint32), w_sh),
