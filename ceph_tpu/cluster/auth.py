@@ -25,6 +25,7 @@ import hashlib
 import hmac
 import os
 import pickle
+import struct
 import time
 from dataclasses import dataclass, field
 from typing import Dict, Optional, Tuple
@@ -123,21 +124,32 @@ def validate_ticket(master: bytes, blob: bytes) -> Ticket:
 # -- authorizers (per-connection proof of the session key) ------------------
 
 def make_authorizer(ticket_blob: bytes, session_key: bytes) -> bytes:
+    """Fixed binary layout (u32 ticket_len | ticket | nonce16 | proof16):
+    an authorizer arrives on an UNauthenticated connection, so its outer
+    framing must be parseable without a deserializer; the only pickled
+    content sits inside the sealed ticket, whose MAC `unseal` verifies
+    before decoding."""
     nonce = os.urandom(16)
     proof = hmac.new(session_key, b"authorizer:" + nonce,
                      hashlib.sha256).digest()[:SIG_LEN]
-    return pickle.dumps({"ticket": ticket_blob, "nonce": nonce,
-                         "proof": proof})
+    return struct.pack("<I", len(ticket_blob)) + ticket_blob + nonce + proof
 
 
 def verify_authorizer(master: bytes, authorizer: bytes) -> Ticket:
     """Service side: validate the ticket, then the possession proof.
     Returns the ticket (entity + caps + session key) on success."""
-    d = pickle.loads(authorizer)
-    t = validate_ticket(master, d["ticket"])
-    want = hmac.new(t.session_key, b"authorizer:" + d["nonce"],
+    if len(authorizer) < 4:
+        raise ValueError("short authorizer")
+    (tl,) = struct.unpack_from("<I", authorizer)
+    if len(authorizer) != 4 + tl + 16 + SIG_LEN:
+        raise ValueError("malformed authorizer")
+    ticket = authorizer[4:4 + tl]
+    nonce = authorizer[4 + tl:4 + tl + 16]
+    proof = authorizer[4 + tl + 16:]
+    t = validate_ticket(master, ticket)
+    want = hmac.new(t.session_key, b"authorizer:" + nonce,
                     hashlib.sha256).digest()[:SIG_LEN]
-    if not hmac.compare_digest(d["proof"], want):
+    if not hmac.compare_digest(proof, want):
         raise ValueError("authorizer proof mismatch")
     return t
 

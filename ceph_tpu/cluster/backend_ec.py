@@ -325,6 +325,20 @@ class ECBackendMixin:
                 break
         if chosen is None and viable:
             chosen = viable[0]  # only un-acked state exists (new object)
+        # ADVICE r4: if an ACKED version exists but lacks k same-version
+        # shards, serving an older group would be a silent stale read —
+        # fail the read (EIO/unfound) so recovery repairs the object
+        # instead (reference serves committed object_info state or
+        # returns unfound, never silently older bytes)
+        acked_newest = max((v for v in versions if v <= committed_seq),
+                           default=None)
+        if (acked_newest is not None and chosen is not None
+                and chosen[0] < acked_newest):
+            have = sum(1 for _, ver, _ in got.values()
+                       if ver == acked_newest)
+            raise IOError(
+                f"{oid}: acked version {acked_newest} has only {have} "
+                f"of {need_k} shards; refusing stale read")
         if chosen is not None:
             v, shards = chosen
             size = max(sz for _, ver, sz in got.values() if ver == v)

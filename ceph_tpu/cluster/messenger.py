@@ -5,9 +5,12 @@ Messenger.h, Dispatcher.h; AsyncMessenger event loops): entity-named
 endpoints, per-peer Connections with ordered delivery and reconnect,
 dispatchers receiving typed messages.  Transport is asyncio TCP on
 loopback (the reference's tier-3 standalone tests run the same way:
-N daemons x 1 host over real sockets).  Frames are length-prefixed
-pickles — an internal trust boundary, like the reference's cephx-signed
-native encoding is within a cluster.
+N daemons x 1 host over real sockets).  Frames are length-prefixed and
+typed: ordinary messages are pickles — an internal trust boundary, like
+the reference's cephx-signed native encoding is within a cluster —
+while the cephx handshake frames use FIXED struct encodings so that no
+unauthenticated byte ever reaches the deserializer (in cephx mode, data
+frames on a connection without a session key are rejected outright).
 
 Integrity (reference cephx message signing, src/auth/cephx/): when the
 messenger holds a cluster secret, every frame carries a truncated
@@ -157,12 +160,17 @@ class Connection:
         async with self._send_lock:
             self._seq += 1
             msg.seq = self._seq
-            payload = pickle.dumps(msg)
-            secret = self._sign_key()
-            if secret is not None:
-                payload += _sign(secret, payload)
+            hs = _encode_hs(msg)
+            if hs is not None:
+                frame = hs  # handshake: fixed struct, pre-session, unsigned
+            else:
+                payload = pickle.dumps(msg)
+                secret = self._sign_key()
+                if secret is not None:
+                    payload += _sign(secret, payload)
+                frame = bytes([_FT_MSG]) + payload
             try:
-                self.writer.write(struct.pack("<I", len(payload)) + payload)
+                self.writer.write(struct.pack("<I", len(frame)) + frame)
                 await self.writer.drain()
             except (ConnectionError, RuntimeError):
                 self.closed = True
@@ -188,9 +196,70 @@ class Dispatcher:
 
 SIG_LEN = 16
 
+# frame-type bytes: every frame is <u32 len><type><body>.  Type 0 is a
+# pickled Message (signed when a key is bound); types 1-3 are the cephx
+# handshake in FIXED struct encodings, so no unauthenticated byte ever
+# reaches the pickle deserializer (the r4 advisor's high finding: the
+# old handshake pickled first and authenticated after).
+_FT_MSG, _FT_AUTH, _FT_AUTH_REQ, _FT_AUTH_REPLY = 0, 1, 2, 3
+
 
 def _sign(secret: bytes, payload: bytes) -> bytes:
     return _hmac.new(secret, payload, hashlib.sha256).digest()[:SIG_LEN]
+
+
+def _encode_hs(msg: Message) -> Optional[bytes]:
+    """Handshake frame body (type byte + fixed struct), or None for
+    ordinary messages."""
+    if isinstance(msg, _MsgAuth):
+        return bytes([_FT_AUTH]) + msg.authorizer
+    if isinstance(msg, _MsgAuthRequest):
+        e = msg.entity.encode()
+        return (bytes([_FT_AUTH_REQ]) + struct.pack("<H", len(e)) + e +
+                struct.pack("<B", len(msg.nonce)) + msg.nonce +
+                struct.pack("<B", len(msg.proof)) + msg.proof)
+    if isinstance(msg, _MsgAuthReply):
+        err = msg.error.encode()
+        return (bytes([_FT_AUTH_REPLY]) +
+                struct.pack("<idII", msg.result, msg.ttl,
+                            len(msg.ticket_blob), len(msg.sealed_key)) +
+                msg.ticket_blob + msg.sealed_key +
+                struct.pack("<H", len(err)) + err)
+    return None
+
+
+def _decode_hs(ftype: int, body: bytes) -> Message:
+    try:
+        if ftype == _FT_AUTH:
+            return _MsgAuth(authorizer=body)
+        if ftype == _FT_AUTH_REQ:
+            (el,) = struct.unpack_from("<H", body)
+            off = 2
+            entity = body[off:off + el].decode()
+            off += el
+            nl = body[off]
+            nonce = body[off + 1:off + 1 + nl]
+            off += 1 + nl
+            pl = body[off]
+            proof = body[off + 1:off + 1 + pl]
+            if off + 1 + pl != len(body):
+                raise ValueError("trailing bytes")
+            return _MsgAuthRequest(entity=entity, nonce=nonce, proof=proof)
+        if ftype == _FT_AUTH_REPLY:
+            result, ttl, tl, kl = struct.unpack_from("<idII", body)
+            off = struct.calcsize("<idII")
+            blob = body[off:off + tl]
+            key = body[off + tl:off + tl + kl]
+            off += tl + kl
+            (el,) = struct.unpack_from("<H", body, off)
+            err = body[off + 2:off + 2 + el].decode()
+            if off + 2 + el != len(body) or len(blob) != tl or len(key) != kl:
+                raise ValueError("trailing bytes")
+            return _MsgAuthReply(result=result, ttl=ttl, ticket_blob=blob,
+                                 sealed_key=key, error=err)
+    except (struct.error, IndexError, UnicodeDecodeError, ValueError) as e:
+        raise ConnectionError(f"malformed handshake frame: {e}")
+    raise ConnectionError(f"unknown frame type {ftype}")
 
 
 class Messenger:
@@ -243,13 +312,29 @@ class Messenger:
             while True:
                 hdr = await conn.reader.readexactly(4)
                 (n,) = struct.unpack("<I", hdr)
-                payload = await conn.reader.readexactly(n)
+                if n < 1:
+                    raise ConnectionError("empty frame")
+                frame = await conn.reader.readexactly(n)
+                ftype, payload = frame[0], frame[1:]
+                if ftype != _FT_MSG:
+                    # handshake frames: fixed struct decode, no pickle
+                    msg = _decode_hs(ftype, payload)
+                    if self.auth is None or not await \
+                            self._handle_auth_frame(conn, msg):
+                        raise ConnectionError(
+                            f"unexpected handshake frame type {ftype}")
+                    continue
+                if self.auth is not None and conn.session_key is None:
+                    # cephx mode: nothing but the handshake may ride an
+                    # unauthenticated connection — reject BEFORE any
+                    # deserialization
+                    raise ConnectionError("unauthenticated data frame")
                 verify_key = conn.session_key if conn.session_key \
                     is not None else self.secret
                 if verify_key is not None:
                     # verify BEFORE unpickling: unauthenticated bytes
                     # must never reach the deserializer
-                    if n < SIG_LEN or not _hmac.compare_digest(
+                    if len(payload) < SIG_LEN or not _hmac.compare_digest(
                             _sign(verify_key, payload[:-SIG_LEN]),
                             payload[-SIG_LEN:]):
                         raise ConnectionError("bad message signature")
@@ -257,15 +342,6 @@ class Messenger:
                 msg = pickle.loads(payload)
                 if conn.peer is None:
                     conn.peer = msg.src
-                if self.auth is not None and await self._handle_auth_frame(
-                        conn, msg):
-                    continue
-                if self.auth is not None and conn.session_key is None:
-                    # cephx mode: nothing but the handshake may ride an
-                    # unauthenticated connection
-                    raise ConnectionError(
-                        f"unauthenticated {type(msg).__name__} from "
-                        f"{msg.src}")
                 if isinstance(msg, _MsgAck):
                     sess = self._sessions.get(conn.peer_addr)
                     if sess is not None:
@@ -294,9 +370,9 @@ class Messenger:
                     pass
 
     async def _handle_auth_frame(self, conn: Connection, msg) -> bool:
-        """cephx transport frames (handshake-time unpickling is the one
-        unauthenticated-deserialization exception — the reference's
-        banner exchange sits at the same trust point)."""
+        """cephx transport frames (already struct-decoded — the pickle
+        deserializer never sees unauthenticated bytes; the authorizer's
+        pickled interior sits behind the sealed ticket's MAC)."""
         from ceph_tpu.cluster import auth as authmod
 
         if isinstance(msg, _MsgAuth):
@@ -405,7 +481,8 @@ class Messenger:
         key = conn._sign_key()
         if key is not None:
             payload = payload + _sign(key, payload)
-        return struct.pack("<I", len(payload)) + payload
+        frame = bytes([_FT_MSG]) + payload
+        return struct.pack("<I", len(frame)) + frame
 
     async def _reconnect_replay(self, sess: _Session, addr: Addr,
                                 retries: int = 3) -> None:

@@ -596,14 +596,30 @@ class Monitor(Dispatcher):
                 else:
                     import dataclasses as _dc
 
-                    async with self._map_mutex:
-                        inc = self._new_inc()
-                        inc.new_pools[pid] = _dc.replace(
-                            self.osdmap.pools[pid], **{var: int(val)})
-                        if not await self._commit_inc(inc):
-                            result, data = -11, "quorum lost"
-                        else:
-                            data = int(val)
+                    # validate like the reference OSDMonitor: size >= 1
+                    # and 1 <= min_size <= size, else committing through
+                    # Paxos can wedge every write on the pool
+                    po = self.osdmap.pools[pid]
+                    try:
+                        ival = int(val)
+                    except (TypeError, ValueError):
+                        ival = -1
+                    new_size = ival if var == "size" else po.size
+                    new_min = ival if var == "min_size" else po.min_size
+                    if ival < 1 or new_min > new_size:
+                        result, data = -22, (
+                            f"invalid {var}={val!r}: need size >= 1 and "
+                            f"1 <= min_size <= size "
+                            f"(size={new_size}, min_size={new_min})")
+                    else:
+                        async with self._map_mutex:
+                            inc = self._new_inc()
+                            inc.new_pools[pid] = _dc.replace(
+                                po, **{var: ival})
+                            if not await self._commit_inc(inc):
+                                result, data = -11, "quorum lost"
+                            else:
+                                data = ival
             elif prefix == "auth revoke":
                 # refuse future ticket issuance/renewal for the entity
                 # (existing tickets die at their TTL); committed through

@@ -134,6 +134,26 @@ class RGWFrontend:
 
     # -- auth (signature-v2-lite) ------------------------------------------
 
+    # replay window for x-amz-date (the reference allows 15 min skew,
+    # rgw_auth_s3.cc RGW_AUTH_GRACE)
+    AUTH_GRACE_SECS = 900.0
+
+    @staticmethod
+    def _string_to_sign(method: str, path: str, query: Dict[str, str],
+                        date: str, body: bytes) -> str:
+        """Binds method, path, the FULL query string, the date, and a
+        body digest (ADVICE r4: signing only method/path/date let one
+        captured PUT signature replay forever with arbitrary content)."""
+        # percent-encode keys/values so distinct query dicts can never
+        # collide to one canonical string (e.g. {"a": "1&b=2"} vs
+        # {"a": "1", "b": "2"})
+        canon_q = "&".join(
+            f"{urllib.parse.quote(k, safe='')}="
+            f"{urllib.parse.quote(v, safe='')}"
+            for k, v in sorted(query.items()))
+        return "\n".join([method, path, canon_q, date,
+                          hashlib.sha256(body).hexdigest()])
+
     def _authenticate(self, req: S3Request) -> Optional[str]:
         """-> error string, or None when authorized."""
         if self.accounts is None:
@@ -148,26 +168,43 @@ class RGWFrontend:
         secret = self.accounts.get(access)
         if secret is None:
             return "unknown access key"
-        string_to_sign = "\n".join([
-            req.method, req.path, req.headers.get("x-amz-date", "")])
-        want = hmac.new(secret.encode(), string_to_sign.encode(),
-                        hashlib.sha256).hexdigest()
+        date = req.headers.get("x-amz-date", "")
+        try:
+            skew = abs(time.time() - float(date))
+        except ValueError:
+            return "bad x-amz-date"
+        if skew > self.AUTH_GRACE_SECS:
+            return "request time too skewed"
+        want = hmac.new(
+            secret.encode(),
+            self._string_to_sign(req.method, req.path, req.query,
+                                 date, req.body).encode(),
+            hashlib.sha256).hexdigest()
         if not hmac.compare_digest(want, sig):
             return "signature mismatch"
         return None
 
-    @staticmethod
-    def sign(method: str, path: str, date: str, access: str,
-             secret: str) -> str:
+    @classmethod
+    def sign(cls, method: str, path: str, date: str, access: str,
+             secret: str, body: bytes = b"",
+             query: Optional[Dict[str, str]] = None) -> str:
         """Client-side signer (the boto analog for tests/tools)."""
-        sig = hmac.new(secret.encode(),
-                       "\n".join([method, path, date]).encode(),
-                       hashlib.sha256).hexdigest()
+        sig = hmac.new(
+            secret.encode(),
+            cls._string_to_sign(method, path, query or {}, date,
+                                body).encode(),
+            hashlib.sha256).hexdigest()
         return f"AWS {access}:{sig}"
 
     # -- REST dispatch (rgw_rest_s3.cc op table) ---------------------------
 
     async def _dispatch(self, req: S3Request):
+        if req.path == "/swift/auth" and "x-auth-user" in req.headers:
+            # tempauth's GET /auth/v1.0: X-Auth-User/X-Auth-Key in,
+            # time-limited X-Auth-Token out.  Conditional on the tempauth
+            # header so an S3 object at bucket 'swift', key 'auth' stays
+            # reachable through the S3 path
+            return self._swift_issue_token(req)
         if req.path == "/swift/v1" or req.path.startswith("/swift/v1/"):
             # exact-prefix guard: an S3 bucket named 'swift' with key
             # 'v1.txt' must stay on the S3 path (and its auth)
@@ -195,28 +232,52 @@ class RGWFrontend:
     #    rgw_rest_swift.cc: same RGW core, container/object dialect) ----
 
     def _swift_auth(self, req: S3Request) -> Optional[str]:
-        """Swift tempauth-lite: X-Auth-Token = '<access>:<hmac(secret,
-        access)>' (the reference's tempauth token possession proof)."""
+        """Swift tempauth-lite: X-Auth-Token =
+        '<access>:<expiry>:<hmac(secret, access:expiry)>' — time-limited
+        (ADVICE r4: the old static per-account token was valid forever).
+        Tokens come from GET /swift/auth (tempauth's /auth/v1.0) or the
+        swift_token helper."""
         if self.accounts is None:
             return None
         token = req.headers.get("x-auth-token", "")
-        try:
-            access, proof = token.split(":", 1)
-        except ValueError:
+        parts = token.split(":")
+        if len(parts) != 3:
             return "missing or malformed X-Auth-Token"
+        access, expiry, proof = parts
         secret = self.accounts.get(access)
         if secret is None:
             return "unknown account"
-        want = hmac.new(secret.encode(), access.encode(),
+        try:
+            if float(expiry) < time.time():
+                return "token expired"
+        except ValueError:
+            return "malformed token expiry"
+        want = hmac.new(secret.encode(),
+                        f"{access}:{expiry}".encode(),
                         hashlib.sha256).hexdigest()
         if not hmac.compare_digest(want, proof):
             return "bad token"
         return None
 
     @staticmethod
-    def swift_token(access: str, secret: str) -> str:
-        return access + ":" + hmac.new(
-            secret.encode(), access.encode(), hashlib.sha256).hexdigest()
+    def swift_token(access: str, secret: str, ttl: float = 3600.0) -> str:
+        expiry = f"{time.time() + ttl:.0f}"
+        proof = hmac.new(secret.encode(), f"{access}:{expiry}".encode(),
+                         hashlib.sha256).hexdigest()
+        return f"{access}:{expiry}:{proof}"
+
+    def _swift_issue_token(self, req: S3Request):
+        user = req.headers.get("x-auth-user", "")
+        key = req.headers.get("x-auth-key", "")
+        secret = (self.accounts or {}).get(user)
+        # compare as bytes: str compare_digest raises on non-ASCII input
+        if secret is None or not hmac.compare_digest(
+                secret.encode(), key.encode()):
+            return "401 Unauthorized", {}, b"bad credentials"
+        return "200 OK", {
+            "X-Auth-Token": self.swift_token(user, secret),
+            "X-Storage-Url": "/swift/v1",
+        }, b""
 
     async def _dispatch_swift(self, req: S3Request):
         err = self._swift_auth(req)

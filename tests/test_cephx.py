@@ -158,3 +158,55 @@ def test_caps_enforced_non_admin_cannot_mutate_mon():
             await cluster.stop()
 
     run(scenario())
+
+
+def test_preauth_bytes_never_reach_deserializer():
+    """ADVICE r4 (high): in cephx mode an unauthenticated peer must not
+    be able to drive pickle.loads.  A raw socket sends (a) a pickled
+    data frame with no handshake and (b) garbage handshake frames; the
+    daemon must reset the connection without deserializing either, and
+    stay healthy for real clients afterwards."""
+    import pickle
+    import struct
+
+    async def scenario():
+        cluster = await start_cluster(2, config=_cephx_config())
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("sec", "replicated",
+                                            pg_num=4, size=2)
+            io = client.ioctx(pool)
+            await io.write_full("obj", b"payload")
+
+            executed = []
+
+            class Evil:
+                def __reduce__(self):
+                    # the callable runs at pickle.LOADS time only
+                    return (executed.append, ("deserialized",))
+
+            some_osd = next(iter(cluster.osds.values()))
+            addr = some_osd.messenger.my_addr
+            # (a) pickled data frame, no handshake
+            reader, writer = await asyncio.open_connection(addr[0], addr[1])
+            evil = b"\x00" + pickle.dumps(Evil())
+            writer.write(struct.pack("<I", len(evil)) + evil)
+            await writer.drain()
+            assert await reader.read(64) == b""  # peer reset, no reply
+            writer.close()
+            # (b) malformed handshake frames (types 1-3, junk bodies)
+            for t in (1, 2, 3, 77):
+                reader, writer = await asyncio.open_connection(
+                    addr[0], addr[1])
+                junk = bytes([t]) + b"\xff" * 11
+                writer.write(struct.pack("<I", len(junk)) + junk)
+                await writer.drain()
+                assert await reader.read(64) == b""
+                writer.close()
+            # daemon still healthy for authenticated traffic
+            assert await io.read("obj", timeout=30) == b"payload"
+            assert not executed, "unauthenticated pickle was deserialized"
+        finally:
+            await cluster.stop()
+
+    run(scenario())

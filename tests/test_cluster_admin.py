@@ -160,6 +160,14 @@ def test_pool_delete_rename_set():
             assert client.objecter.osdmap.pools[pool].size == 2
             with pytest.raises(RuntimeError):
                 await client.pool_set("renamed", "pg_num", 16)
+            # ADVICE r4: invalid size/min_size must be EINVAL, never
+            # committed (they would wedge all writes on the pool)
+            for var, val in (("size", 0), ("size", -1), ("min_size", 0),
+                             ("min_size", 3), ("size", "garbage")):
+                with pytest.raises(RuntimeError):
+                    await client.pool_set("renamed", var, val)
+            assert client.objecter.osdmap.pools[pool].size == 2
+            assert 1 <= client.objecter.osdmap.pools[pool].min_size <= 2
             # delete requires the sure gate
             with pytest.raises(RuntimeError):
                 await client.pool_delete("renamed")

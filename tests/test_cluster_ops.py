@@ -186,3 +186,57 @@ def test_extended_osd_verbs_replicated_and_ec():
             await cluster.stop()
 
     asyncio.run(scenario())
+
+
+def test_compound_op_vector_gates_on_first_error():
+    """ADVICE r4: the op vector must stop at the FIRST failing op (the
+    reference do_osd_ops `while (!bp.end() && !result)`) and return one
+    terminal reply — a cmpxattr mismatch really gates the writes behind
+    it."""
+    async def scenario():
+        cluster = await start_cluster(2)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("gate", "replicated",
+                                            pg_num=4, size=2)
+            io = client.ioctx(pool)
+            await io.write_full("obj", b"original")
+            await io.setxattr("obj", "user.state", b"ready")
+            # matching gate: the write lands
+            r = await client.objecter.op_submit(pool, "obj", [
+                ("cmpxattr", {"name": "user.state", "value": b"ready"}),
+                ("write_full", {"data": b"updated"})])
+            assert r.result == 0
+            assert await io.read("obj") == b"updated"
+            # mismatching gate: -ECANCELED and the write must NOT land
+            r = await client.objecter.op_submit(pool, "obj", [
+                ("cmpxattr", {"name": "user.state", "value": b"WRONG"}),
+                ("write_full", {"data": b"MUST-NOT-LAND"})])
+            assert r.result == -125
+            assert await io.read("obj") == b"updated"
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_mutation_never_lands_before_failing_guard():
+    """Reference atomicity approximation: a mutation placed BEFORE a
+    failing guard in the vector must not land (guards run first)."""
+    async def scenario():
+        cluster = await start_cluster(2)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("gate2", "replicated",
+                                            pg_num=4, size=2)
+            io = client.ioctx(pool)
+            await io.write_full("obj", b"original")
+            r = await client.objecter.op_submit(pool, "obj", [
+                ("write_full", {"data": b"MUST-NOT-LAND"}),
+                ("cmpxattr", {"name": "user.absent", "value": b"x"})])
+            assert r.result == -125
+            assert await io.read("obj") == b"original"
+        finally:
+            await cluster.stop()
+
+    run(scenario())

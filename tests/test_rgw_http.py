@@ -4,6 +4,7 @@ rgw_auth_s3.cc / multipart ops in rgw_op.cc)."""
 
 import asyncio
 import re
+import time
 
 import pytest
 
@@ -94,25 +95,40 @@ def test_s3_auth_required_and_enforced():
             st, _, body = await _http(addr, "PUT", "/locked")
             assert st == 403 and b"AccessDenied" in body
             # bad signature -> 403
+            now = str(time.time())
             st, _, _ = await _http(addr, "PUT", "/locked", headers={
                 "Authorization": "AWS AKIDEMO:deadbeef",
-                "x-amz-date": "now"})
+                "x-amz-date": now})
             assert st == 403
             # good signature -> 200, and the whole surface works signed
-            def signed(method, path):
+            def signed(method, path, body=b""):
+                date = str(time.time())
                 return {"Authorization": RGWFrontend.sign(
-                    method, path, "now", "AKIDEMO", "sekrit"),
-                    "x-amz-date": "now"}
+                    method, path, date, "AKIDEMO", "sekrit", body=body),
+                    "x-amz-date": date}
 
             st, _, _ = await _http(addr, "PUT", "/locked",
                                    headers=signed("PUT", "/locked"))
             assert st == 200
             st, _, _ = await _http(addr, "PUT", "/locked/k", b"v",
-                                   signed("PUT", "/locked/k"))
+                                   signed("PUT", "/locked/k", b"v"))
             assert st == 200
             st, _, body = await _http(addr, "GET", "/locked/k",
                                       headers=signed("GET", "/locked/k"))
             assert st == 200 and body == b"v"
+            # ADVICE r4: a captured signature must not authorize a
+            # DIFFERENT body (body digest is signed)...
+            cap = signed("PUT", "/locked/k", b"v")
+            st, _, _ = await _http(addr, "PUT", "/locked/k", b"EVIL", cap)
+            assert st == 403
+            # ...and a stale date is rejected (replay window)
+            old = str(time.time() - 3600)
+            st, _, _ = await _http(addr, "PUT", "/locked/k", b"v", {
+                "Authorization": RGWFrontend.sign(
+                    "PUT", "/locked/k", old, "AKIDEMO", "sekrit",
+                    body=b"v"),
+                "x-amz-date": old})
+            assert st == 403
             await fe.stop()
         finally:
             await cluster.stop()
@@ -197,11 +213,26 @@ def test_swift_api_surface():
             st, _, body = await _http(addr, "GET", "/swift/v1",
                                       headers=tok)
             assert st == 200 and b"cont" in body
+            # expired token refused
+            st, _, _ = await _http(
+                addr, "GET", "/swift/v1/cont",
+                headers={"X-Auth-Token": RGWFrontend.swift_token(
+                    "swifty", "s3cr3t", ttl=-5)})
+            assert st == 401
+            # token issuance endpoint (tempauth /auth/v1.0 analog)
+            st, h, _ = await _http(addr, "GET", "/swift/auth", headers={
+                "X-Auth-User": "swifty", "X-Auth-Key": "s3cr3t"})
+            assert st == 200 and h.get("x-auth-token")
+            st, _, _ = await _http(
+                addr, "GET", "/swift/v1/cont",
+                headers={"X-Auth-Token": h["x-auth-token"]})
+            assert st == 200
             # cross-protocol: the same accounts sign S3 requests, and
             # the S3 side sees the Swift-written object
+            date = str(time.time())
             sig = {"Authorization": RGWFrontend.sign(
-                "GET", "/cont/obj.txt", "now", "swifty", "s3cr3t"),
-                "x-amz-date": "now"}
+                "GET", "/cont/obj.txt", date, "swifty", "s3cr3t"),
+                "x-amz-date": date}
             st, _, body = await _http(addr, "GET", "/cont/obj.txt",
                                       headers=sig)
             assert st == 200 and body == b"swift-body"
