@@ -127,6 +127,8 @@ class ClientOpsMixin:
         "omap_set", "omap_rmkeys", "exec",
         "append", "truncate", "zero", "create"})
     _REQID_DUPS_TRACKED = 3000
+    # ops that gate the rest of their vector (CEPH_OSD_OP_CMPXATTR etc.)
+    _GUARD_OPS = frozenset({"cmpxattr"})
 
     async def _dispatch_client_op(self, conn, msg, m, pool, st) -> None:
         caps = getattr(conn, "peer_caps", None)
@@ -169,6 +171,18 @@ class ClientOpsMixin:
             for reply in cached:
                 await conn.send(reply)
             return
+        # the in-memory cache is primary-local; the pg log is not.  A
+        # resend that survived a primary change finds its reqid in the
+        # replicated log entries (reference pg_log_entry_t::reqid dups)
+        # and must NOT re-execute — reply success (the recorded effect is
+        # applied; per-op out data is not reconstructible from the log).
+        if any(getattr(e, "client_reqid", None) == reqid
+               for e in st.log.entries):
+            self.perf.inc("osd_dup_ops_from_log")
+            top.mark("dup_refused_from_log")
+            await conn.send(M.MOSDOpReply(
+                reqid=msg.reqid, result=0, epoch=m.epoch))
+            return
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         st.reqid_inflight[reqid] = fut
 
@@ -187,6 +201,9 @@ class ClientOpsMixin:
                 sent.append(reply)
                 await self._inner.send(reply)
 
+        from ceph_tpu.cluster.pg import CURRENT_CLIENT_REQID
+
+        token = CURRENT_CLIENT_REQID.set(reqid)
         try:
             await self._execute_client_ops(
                 _RecordingConn(conn), msg, m, pool, st, top)
@@ -194,6 +211,7 @@ class ClientOpsMixin:
             while len(st.reqid_replies) > self._REQID_DUPS_TRACKED:
                 st.reqid_replies.popitem(last=False)
         finally:
+            CURRENT_CLIENT_REQID.reset(token)
             st.reqid_inflight.pop(reqid, None)
             if not fut.done():
                 fut.set_result(None)
@@ -256,21 +274,21 @@ class ClientOpsMixin:
                 asyncio.get_event_loop().create_task(_notify_bg()))
             return
         # two-phase, approximating the reference's discard-txn-on-error
-        # atomicity: every non-mutating op (guards/reads) runs first in
-        # vector order; mutations run only after ALL guards passed, so a
-        # mutation can never land ahead of a failing guard regardless of
-        # its position in the vector.  (A guard placed after a mutation
-        # observes pre-mutation state — the gate patterns the reference
-        # APIs generate put guards first.)  Mutations still apply
-        # sequentially: a failure mid-way leaves earlier mutations of the
-        # same vector applied, reported via the terminal result.
+        # atomicity: GUARD ops run first (in their vector order), the rest
+        # of the vector runs second in order — so a mutation can never
+        # land ahead of a failing guard, while read/write ordering within
+        # the vector is preserved.  (librados vectors are read-ops OR
+        # write-ops, never mixed, so guards-first matches the patterns the
+        # reference APIs generate.)  Mutations still apply sequentially: a
+        # failure mid-way leaves earlier mutations of the same vector
+        # applied, reported via the terminal result.
         result = 0
         outs: List = [None] * len(msg.ops)
         phases = (
             [(i, o) for i, o in enumerate(msg.ops)
-             if o[0] not in self._MUTATING_OPS],
+             if o[0] in self._GUARD_OPS],
             [(i, o) for i, o in enumerate(msg.ops)
-             if o[0] in self._MUTATING_OPS],
+             if o[0] not in self._GUARD_OPS],
         )
         for phase in phases:
             for i, (opname, args) in phase:

@@ -376,10 +376,16 @@ class Messenger:
         from ceph_tpu.cluster import auth as authmod
 
         if isinstance(msg, _MsgAuth):
-            t = authmod.verify_authorizer(self.auth.master, msg.authorizer) \
-                if self.auth.master is not None else None
-            if t is None:
+            if self.auth.master is None:
                 raise ConnectionError("no master key to verify authorizer")
+            try:
+                t = authmod.verify_authorizer(self.auth.master,
+                                              msg.authorizer)
+            except ValueError as e:
+                # malformed/forged authorizer must tear the connection
+                # down through the normal reset path (close +
+                # ms_handle_reset), not kill the read-loop task
+                raise ConnectionError(f"bad authorizer: {e}")
             conn.session_key = t.session_key
             conn.peer_entity = t.entity
             conn.peer_caps = t.caps

@@ -8,6 +8,7 @@ recovery-time full rewrite/load paths."""
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import pickle
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -18,6 +19,13 @@ from ceph_tpu.cluster import pglog
 from ceph_tpu.cluster.pglog import LogEntry, PGInfo, PGLog
 from ceph_tpu.cluster.store import Transaction
 from ceph_tpu.osdmap.osdmap import PGid
+
+# the client reqid whose op vector is currently executing (set around
+# _execute_client_ops by the mutation-dedup wrapper); _log_mutation stamps
+# it into primary-minted log entries so dup protection replicates with
+# the log.  A ContextVar so interleaved client tasks can't cross-stamp.
+CURRENT_CLIENT_REQID: contextvars.ContextVar = contextvars.ContextVar(
+    "ceph_tpu_current_client_reqid", default=None)
 
 
 # the per-PG metadata object holding the persisted log + last_update
@@ -110,7 +118,8 @@ class PGLogMixin:
         if entry is None:
             entry = LogEntry(op=op, oid=oid, version=version,
                              prior_version=st.last_update,
-                             committed=st.last_complete)
+                             committed=st.last_complete,
+                             client_reqid=CURRENT_CLIENT_REQID.get())
         st.log.append(entry)
         st.last_update = version
         dropped = st.log.trim()

@@ -39,6 +39,11 @@ class LogEntry:
     # watermark from the entry stream and prune their rollback journal
     # up to it (reference min_last_complete_ondisk piggybacking)
     committed: Eversion = ZERO
+    # originating client reqid (reference pg_log_entry_t::reqid): entries
+    # replicate to peers, so a NEW primary can refuse to re-execute a
+    # resent non-idempotent op whose effect its log already records —
+    # the in-memory reqid_replies cache is primary-local and dies with it
+    client_reqid: Optional[Tuple] = None
 
 
 @dataclass

@@ -173,7 +173,8 @@ class RGWFrontend:
             skew = abs(time.time() - float(date))
         except ValueError:
             return "bad x-amz-date"
-        if skew > self.AUTH_GRACE_SECS:
+        # inverted comparison so a NaN date can never pass the window
+        if not (skew <= self.AUTH_GRACE_SECS):
             return "request time too skewed"
         want = hmac.new(
             secret.encode(),

@@ -27,12 +27,20 @@ import numpy as np
 from ceph_tpu.ec import matrices
 from ceph_tpu.ec.codec import MatrixCodec
 from ceph_tpu.ec.interface import ECError, ErasureCodeProfile
-from ceph_tpu.ops import gf8
+from ceph_tpu.ops import gf8, gfw
 
 MULTIPLE = 0
 SINGLE = 1
 
 LARGEST_VECTOR_WORDSIZE = 16
+
+
+def gfw_invert(mat: np.ndarray, w: int) -> np.ndarray:
+    """gfw inversion with the gf8 SingularMatrixError contract."""
+    try:
+        return gfw.gfw_invert_matrix(mat, w)
+    except ValueError as e:
+        raise gf8.SingularMatrixError(str(e))
 
 
 def _calc_recovery_efficiency1(k: int, m1: int, m2: int, c1: int, c2: int) -> float:
@@ -69,10 +77,11 @@ def _calc_recovery_efficiency1(k: int, m1: int, m2: int, c1: int, c2: int) -> fl
     return r_e1 / (k + m1 + m2)
 
 
-def shec_coding_matrix(k: int, m: int, c: int, technique: int) -> np.ndarray:
+def shec_coding_matrix(k: int, m: int, c: int, technique: int,
+                       w: int = 8) -> np.ndarray:
     """Shingled (m, k) coding matrix (reference
     shec_reedsolomon_coding_matrix, ErasureCodeShec.cc:456): a Vandermonde
-    RS matrix with shingle-patterned zeros."""
+    RS matrix over GF(2^w) with shingle-patterned zeros."""
     if technique == MULTIPLE:
         c1_best, m1_best = -1, -1
         min_r_e1 = 100.0
@@ -96,7 +105,11 @@ def shec_coding_matrix(k: int, m: int, c: int, technique: int) -> np.ndarray:
         m1, c1 = 0, 0
         m2, c2 = m, c
 
-    mat = matrices.reed_sol_vandermonde_coding_matrix(k, m).astype(np.uint8)
+    if w == 8:
+        mat = matrices.reed_sol_vandermonde_coding_matrix(k, m).astype(
+            np.uint8)
+    else:
+        mat = matrices.reed_sol_vandermonde_coding_matrix_w(k, m, w)
     for rr in range(m1):
         end = ((rr * k) // m1) % k
         start = (((rr + c1) * k) // m1) % k
@@ -165,8 +178,6 @@ class ErasureCodeShec(MatrixCodec):
                 wv = 8
             if wv not in (8, 16, 32):
                 wv = 8  # reference falls back to the default, no error
-            if wv != 8:
-                raise NotImplementedError("tpu shec supports w=8")
             self.w = wv
 
     def get_alignment(self) -> int:
@@ -182,7 +193,32 @@ class ErasureCodeShec(MatrixCodec):
         return padded // self.k
 
     def build_coding_matrix(self) -> np.ndarray:
-        return shec_coding_matrix(self.k, self.m, self.c, self.technique)
+        return shec_coding_matrix(self.k, self.m, self.c, self.technique,
+                                  self.w)
+
+    # -- field-width helpers (gf8 fast path, gfw for w in {16, 32}) ---------
+
+    def _invert(self, mat: np.ndarray) -> np.ndarray:
+        if self.w == 8:
+            return gf8.gf_invert_matrix(mat.astype(np.uint8))
+        return gfw_invert(mat, self.w)
+
+    def _mul(self, a: int, b_row: np.ndarray) -> np.ndarray:
+        if self.w == 8:
+            return gf8.gf_mul(a, b_row)
+        gf = gfw.field(self.w)
+        return np.array([gf.mul(a, int(x)) for x in b_row],
+                        dtype=np.uint64)
+
+    def _matmul_host(self, rows: np.ndarray, data: np.ndarray) -> np.ndarray:
+        """(r, c) words x (c, S) bytes -> (r, S) bytes on host."""
+        if self.w == 8:
+            return np.asarray(gf8.gf_matmul_ref(rows, data))
+        bitmat = gfw.expand_bitmatrix_w(rows, self.w)
+        import jax.numpy as jnp
+
+        return np.asarray(gfw.bitmatrix_matmul_w(
+            jnp.asarray(bitmat), jnp.asarray(data), self.w // 8))
 
     # -- decode-plan search (reference shec_make_decoding_matrix, :526) -----
 
@@ -245,7 +281,9 @@ class ErasureCodeShec(MatrixCodec):
             if dup < mindup:
                 srcs = [i for i in range(k + m) if tmprow[i]]
                 cols = [j for j in range(k) if tmpcolumn[j]]
-                tmpmat = np.zeros((dup, dup), dtype=np.uint8)
+                tmpmat = np.zeros((dup, dup),
+                                  dtype=np.uint8 if self.w == 8
+                                  else np.uint64)
                 for r, i in enumerate(srcs):
                     for cidx, j in enumerate(cols):
                         if i < k:
@@ -253,7 +291,7 @@ class ErasureCodeShec(MatrixCodec):
                         else:
                             tmpmat[r, cidx] = matrix[i - k, j]
                 try:
-                    inv = gf8.gf_invert_matrix(tmpmat)
+                    inv = self._invert(tmpmat)
                 except gf8.SingularMatrixError:
                     continue  # singular: determinant is zero, reject
                 mindup = dup
@@ -319,9 +357,9 @@ class ErasureCodeShec(MatrixCodec):
             out_rows = [ci for ci, j in enumerate(cols) if not avails[j]]
             if out_rows:
                 rmat = inv[out_rows]
-                out = np.asarray(gf8.gf_matmul_ref(rmat, src_data)) \
-                    if src_data.shape[1] < 4096 else self._device_matmul(
-                        rmat, src_data)
+                out = self._matmul_host(rmat, src_data) \
+                    if src_data.shape[1] < 4096 or self.w != 8 \
+                    else self._device_matmul(rmat, src_data)
                 for idx, ci in enumerate(out_rows):
                     decoded[cols[ci]][...] = out[idx]
         # re-encode wanted erased parity chunks from complete data
@@ -331,8 +369,9 @@ class ErasureCodeShec(MatrixCodec):
                 np.asarray(decoded[i], dtype=np.uint8) for i in range(k)
             ])
             rows = self.engine.coding[parity_want]
-            out = np.asarray(gf8.gf_matmul_ref(rows, data)) \
-                if data.shape[1] < 4096 else self._device_matmul(rows, data)
+            out = self._matmul_host(rows, data) \
+                if data.shape[1] < 4096 or self.w != 8 \
+                else self._device_matmul(rows, data)
             for idx, i in enumerate(parity_want):
                 decoded[k + i][...] = out[idx]
 
@@ -360,7 +399,15 @@ class ErasureCodeShec(MatrixCodec):
         """
         import jax.numpy as jnp
 
-        from ceph_tpu.ec.codec import _gather_encode_batch_jit
+        from ceph_tpu.ec.codec import (_gather_encode_batch_jit,
+                                       _gather_encode_batch_w_jit)
+
+        def _apply(bitmat, src_list):
+            if self.w == 8:
+                return _gather_encode_batch_jit(
+                    bitmat, jnp.asarray(chunks), tuple(src_list))
+            return _gather_encode_batch_w_jit(
+                bitmat, jnp.asarray(chunks), tuple(src_list), self.w // 8)
 
         if want is None:
             want = tuple(erasures)
@@ -368,8 +415,7 @@ class ErasureCodeShec(MatrixCodec):
         cached = self._batch_cache.get(cache_key)
         if cached is not None:
             bitmat, src_list = cached
-            return _gather_encode_batch_jit(
-                bitmat, jnp.asarray(chunks), tuple(src_list))
+            return _apply(bitmat, src_list)
         n = self.k + self.m
         avails = [0 if i in erasures else 1 for i in range(n)]
         want_vec = [1 if i in want else 0 for i in range(n)]
@@ -387,9 +433,11 @@ class ErasureCodeShec(MatrixCodec):
                         src_list.append(j)
         S = len(src_list)
 
+        word_dtype = np.uint8 if self.w == 8 else np.uint64
+
         def data_expr(j: int) -> np.ndarray:
             """Row expressing data chunk j over src_list."""
-            row = np.zeros(S, dtype=np.uint8)
+            row = np.zeros(S, dtype=word_dtype)
             if avails[j]:
                 row[pos[j]] = 1
             else:
@@ -404,17 +452,19 @@ class ErasureCodeShec(MatrixCodec):
                 rows.append(data_expr(e))
             else:
                 crow = self.engine.coding[e - self.k]
-                acc = np.zeros(S, dtype=np.uint8)
+                acc = np.zeros(S, dtype=word_dtype)
                 for j in range(self.k):
                     cj = int(crow[j])
                     if cj:
-                        acc ^= gf8.gf_mul(cj, data_expr(j))
+                        acc ^= self._mul(cj, data_expr(j)).astype(word_dtype)
                 rows.append(acc)
-        rmat = np.stack(rows).astype(np.uint8)
-        bitmat = jnp.asarray(gf8.expand_bitmatrix(rmat))
+        rmat = np.stack(rows).astype(word_dtype)
+        if self.w == 8:
+            bitmat = jnp.asarray(gf8.expand_bitmatrix(rmat))
+        else:
+            bitmat = jnp.asarray(gfw.expand_bitmatrix_w(rmat, self.w))
         self._batch_cache[cache_key] = (bitmat, tuple(src_list))
-        return _gather_encode_batch_jit(
-            bitmat, jnp.asarray(chunks), tuple(src_list))
+        return _apply(bitmat, src_list)
 
 
 def make_shec(profile: ErasureCodeProfile):

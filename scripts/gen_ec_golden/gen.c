@@ -527,6 +527,102 @@ static void hex16(const uint8_t *p, char *out) {
     out[32] = 0;
 }
 
+/* ---------------- SHEC shingled matrix --------------------------------- */
+/* Vandermonde RS matrix with shingle-patterned zeros; the (m1, c1) split
+ * minimizes the recovery-efficiency metric (independent re-derivation of
+ * the SHEC construction for the oracle; same algorithm as the published
+ * SHEC paper / reference ErasureCodeShec.cc:415-524). */
+
+static double shec_eff1(int k, int m1, int m2, int c1, int c2) {
+    int r_eff_k[64];
+    double r_e1 = 0.0;
+    int i, rr, cc, first;
+    if (m1 < c1 || m2 < c2) return -1.0;
+    if ((m1 == 0 && c1 != 0) || (m2 == 0 && c2 != 0)) return -1.0;
+    for (i = 0; i < k; i++) r_eff_k[i] = 100000000;
+    for (rr = 0; rr < m1; rr++) {
+        int start = ((rr * k) / m1) % k;
+        int end = (((rr + c1) * k) / m1) % k;
+        int span = ((rr + c1) * k) / m1 - (rr * k) / m1;
+        cc = start; first = 1;
+        while (first || cc != end) {
+            first = 0;
+            if (span < r_eff_k[cc]) r_eff_k[cc] = span;
+            cc = (cc + 1) % k;
+        }
+        r_e1 += span;
+    }
+    for (rr = 0; rr < m2; rr++) {
+        int start = ((rr * k) / m2) % k;
+        int end = (((rr + c2) * k) / m2) % k;
+        int span = ((rr + c2) * k) / m2 - (rr * k) / m2;
+        cc = start; first = 1;
+        while (first || cc != end) {
+            first = 0;
+            if (span < r_eff_k[cc]) r_eff_k[cc] = span;
+            cc = (cc + 1) % k;
+        }
+        r_e1 += span;
+    }
+    for (i = 0; i < k; i++) r_e1 += r_eff_k[i];
+    return r_e1 / (k + m1 + m2);
+}
+
+static void shec_matrix_w(int k, int m, int c, int w, int single,
+                          uint64_t *matw) {
+    int c1, m1, c2, m2, rr, cc, end, start;
+    int c1_best = -1, m1_best = -1;
+    double min_r_e1 = 100.0;
+    if (single) {
+        m1 = 0; c1 = 0; m2 = m; c2 = c;
+    } else {
+        for (c1 = 0; c1 <= c / 2; c1++) {
+            for (m1 = 0; m1 <= m; m1++) {
+                double r_e1;
+                c2 = c - c1; m2 = m - m1;
+                if (m1 < c1 || m2 < c2) continue;
+                if ((m1 == 0 && c1 != 0) || (m2 == 0 && c2 != 0)) continue;
+                if ((m1 != 0 && c1 == 0) || (m2 != 0 && c2 == 0)) continue;
+                r_e1 = shec_eff1(k, m1, m2, c1, c2);
+                if (min_r_e1 - r_e1 > 2.220446049250313e-16 &&
+                    r_e1 < min_r_e1) {
+                    min_r_e1 = r_e1;
+                    c1_best = c1; m1_best = m1;
+                }
+            }
+        }
+        m1 = m1_best; c1 = c1_best;
+        m2 = m - m1_best; c2 = c - c1_best;
+    }
+    if (w == 8) {
+        int *m8 = calloc(m * k, sizeof(int));
+        int i;
+        reed_sol_van_matrix(k, m, m8);
+        for (i = 0; i < m * k; i++) matw[i] = (uint64_t)m8[i];
+        free(m8);
+    } else {
+        reed_sol_van_matrix_w(k, m, w, matw);
+    }
+    for (rr = 0; rr < m1; rr++) {
+        end = ((rr * k) / m1) % k;
+        start = (((rr + c1) * k) / m1) % k;
+        cc = start;
+        while (cc != end) {
+            matw[rr * k + cc] = 0;
+            cc = (cc + 1) % k;
+        }
+    }
+    for (rr = 0; rr < m2; rr++) {
+        end = ((rr * k) / m2) % k;
+        start = (((rr + c2) * k) / m2) % k;
+        cc = start;
+        while (cc != end) {
+            matw[(rr + m1) * k + cc] = 0;
+            cc = (cc + 1) % k;
+        }
+    }
+}
+
 /* ---------------- config table + main ---------------------------------- */
 
 typedef struct {
@@ -535,6 +631,7 @@ typedef struct {
     int k, m, w, packetsize;
     int object_size;   /* chosen pre-aligned: no padding ambiguity */
     int seed;
+    int c;             /* shec only */
 } Cfg;
 
 static const Cfg CONFIGS[] = {
@@ -560,6 +657,12 @@ static const Cfg CONFIGS[] = {
     {"jerasure", "cauchy_orig", 4, 2, 16, 4, 4096, 17},
     {"jerasure", "cauchy_good", 4, 2, 16, 4, 4096, 18},
     {"jerasure", "cauchy_good", 4, 2, 32, 4, 8192, 19},
+    /* shec shingled codes (round 5: w in {8, 16, 32}) */
+    {"shec", "multiple", 6, 4, 8, 0, 3072, 20, 3},
+    {"shec", "multiple", 6, 4, 16, 0, 6144, 21, 3},
+    {"shec", "multiple", 6, 4, 32, 0, 12288, 22, 3},
+    {"shec", "single", 4, 3, 16, 0, 4096, 23, 2},
+    {"shec", "multiple", 8, 4, 32, 0, 16384, 24, 2},
 };
 
 static int is_native_bitmatrix(const Cfg *c) {
@@ -589,7 +692,16 @@ int main(void) {
         }
         for (i = 0; i < m; i++) parity[i] = malloc(chunk);
 
-        if (is_native_bitmatrix(c)) {
+        if (!strcmp(c->plugin, "shec")) {
+            shec_matrix_w(k, m, c->c, w, !strcmp(c->technique, "single"),
+                          matw);
+            if (w == 8) {
+                for (i = 0; i < m * k; i++) mat[i] = (int)matw[i];
+                matrix_encode(mat, k, m, data, parity, chunk);
+            } else {
+                matrix_encode_w(matw, k, m, w, data, parity, chunk);
+            }
+        } else if (is_native_bitmatrix(c)) {
             if (!strcmp(c->technique, "liberation")) lib_bitmatrix(k, w, bm);
             else if (!strcmp(c->technique, "blaum_roth")) br_bitmatrix(k, w, bm);
             else l8_bitmatrix(k, bm);
@@ -630,7 +742,13 @@ int main(void) {
                "\"seed\": %d, \"chunk_size\": %d, ",
                c->plugin, c->technique, k, m, w, c->packetsize,
                c->object_size, c->seed, chunk);
-        if (is_native_bitmatrix(c)) {
+        if (c->c) printf("\"c\": %d, ", c->c);
+        if (!strcmp(c->plugin, "shec")) {
+            printf("\"matrix\": [");
+            for (i = 0; i < m * k; i++)
+                printf("%s%llu", i ? ", " : "",
+                       (unsigned long long)matw[i]);
+        } else if (is_native_bitmatrix(c)) {
             printf("\"bitmatrix\": [");
             for (i = 0; i < m * w * k * w; i++)
                 printf("%s%d", i ? ", " : "", bm[i]);

@@ -151,3 +151,45 @@ def test_scrub_tie_marks_inconsistent_not_repaired():
             await cluster.stop()
 
     run(scenario())
+
+
+def test_resend_after_primary_change_not_reexecuted():
+    """ADVICE r5: the in-memory reqid cache dies with the primary, but
+    client reqids ride the replicated pg log entries — a resend landing
+    on the NEW primary must find the reqid in its log and refuse to
+    re-apply the (non-idempotent) append."""
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("failover", "replicated",
+                                            pg_num=4, size=3)
+            obj = client.objecter
+            io = client.ioctx(pool)
+            await io.write_full("log", b"base")
+            reqid = (obj.client_name, 999_995)
+            ops = [("append", {"data": b"+one"})]
+            r1 = await _send_op_raw(obj, pool, "log", ops, reqid)
+            assert r1.result == 0
+            assert await io.read("log") == b"base+one"
+            # kill the primary, wait for a new acting primary
+            pgid = obj.object_pgid(pool, "log")
+            _, _, _, old_primary = obj.osdmap.pg_to_up_acting_osds(pgid)
+            await cluster.osds[old_primary].stop()
+            for _ in range(200):
+                await asyncio.sleep(0.25)
+                _, _, acting, primary = \
+                    obj.osdmap.pg_to_up_acting_osds(pgid)
+                if primary >= 0 and primary != old_primary:
+                    break
+            assert primary != old_primary, "no failover happened"
+            # resend the SAME op to the new primary
+            r2 = await _send_op_raw(obj, pool, "log", ops, reqid)
+            assert r2.result == 0
+            got = await io.read("log", timeout=60)
+            assert got == b"base+one", \
+                f"resend re-executed after failover: {got!r}"
+        finally:
+            await cluster.stop()
+
+    run(scenario())

@@ -181,3 +181,33 @@ def test_batch_decode_want_subset():
     out = np.asarray(codec.decode_batch((2, 8), zeroed, want=(2,)))
     assert out.shape[1] == 1
     assert np.array_equal(out[:, 0, :], batch[:, 2, :])
+
+
+def test_shec_wide_w_roundtrip():
+    """VERDICT r4 missing #6: w in {16, 32} via the gfw machinery —
+    encode + multi-erasure decode, scalar and batched paths (the byte
+    goldens vs the C oracle live in test_ec_golden.py)."""
+    import numpy as np
+
+    from ceph_tpu.ec import factory
+
+    rng = np.random.default_rng(5)
+    for w in (16, 32):
+        codec = factory({"plugin": "shec", "k": "6", "m": "4", "c": "3",
+                         "w": str(w)})
+        assert codec.w == w
+        obj = rng.integers(0, 256, codec.get_alignment() * 2,
+                           dtype=np.uint8).tobytes()
+        chunks = codec.encode(set(range(10)), obj)
+        avail = {i: c for i, c in chunks.items() if i not in (0, 3, 7)}
+        assert codec.decode_concat(avail)[:len(obj)] == obj
+        # minimum_to_decode stays shingle-local (fewer than full k+m)
+        minimum = codec.minimum_to_decode({0}, set(range(10)) - {0})
+        assert len(minimum) <= codec.k
+        # batched path
+        S = codec.get_alignment() // codec.k
+        data = rng.integers(0, 256, (4, 6, S), dtype=np.uint8)
+        par = np.asarray(codec.encode_batch(data))
+        full = np.concatenate([data, par], axis=1)
+        got = np.asarray(codec.decode_batch((1, 5, 8), full))
+        assert np.array_equal(got, full[:, [1, 5, 8], :]), w
