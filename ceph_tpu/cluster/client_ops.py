@@ -176,8 +176,7 @@ class ClientOpsMixin:
         # replicated log entries (reference pg_log_entry_t::reqid dups)
         # and must NOT re-execute — reply success (the recorded effect is
         # applied; per-op out data is not reconstructible from the log).
-        if any(getattr(e, "client_reqid", None) == reqid
-               for e in st.log.entries):
+        if st.log.has_reqid(reqid):
             self.perf.inc("osd_dup_ops_from_log")
             top.mark("dup_refused_from_log")
             await conn.send(M.MOSDOpReply(

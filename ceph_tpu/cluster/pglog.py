@@ -61,6 +61,9 @@ class PGLog:
     def append(self, entry: LogEntry) -> None:
         assert entry.version > self.head, (entry.version, self.head)
         self.entries.append(entry)
+        rq = getattr(entry, "client_reqid", None)
+        if rq is not None and getattr(self, "_reqids", None) is not None:
+            self._reqids[rq] = self._reqids.get(rq, 0) + 1
 
     def trim(self) -> List[LogEntry]:
         """Drop oldest entries beyond max_entries, advancing the tail;
@@ -72,7 +75,29 @@ class PGLog:
         dropped = self.entries[:excess]
         self.tail = self.entries[excess - 1].version
         del self.entries[:excess]
+        idx = getattr(self, "_reqids", None)
+        if idx is not None:
+            for e in dropped:
+                rq = getattr(e, "client_reqid", None)
+                if rq is not None and rq in idx:
+                    idx[rq] -= 1
+                    if idx[rq] <= 0:
+                        del idx[rq]
         return dropped
+
+    def has_reqid(self, reqid) -> bool:
+        """O(1) dup lookup over the entries' client reqids (reference
+        pg_log dup index).  The index builds lazily so wholesale log
+        replacements (peering adoption, store load, log push — all of
+        which construct a NEW PGLog) can never serve a stale view."""
+        idx = getattr(self, "_reqids", None)
+        if idx is None:
+            idx = self._reqids = {}
+            for e in self.entries:
+                rq = getattr(e, "client_reqid", None)
+                if rq is not None:
+                    idx[rq] = idx.get(rq, 0) + 1
+        return idx.get(reqid, 0) > 0
 
     def since(self, v: Eversion) -> Optional[List[LogEntry]]:
         """Entries strictly newer than v, or None when v is before the

@@ -101,20 +101,25 @@ class TensorMapper:
             items[row, : b.size] = b.items
             weights[row, : b.size] = b.weights
             for i, w in enumerate(b.weights):
-                if w == 1:
-                    r = 2**64 - 1
-                elif w > 1:
-                    r = 2**64 // w
-                else:
-                    r = 0
-                recip_hi[row, i] = r >> 32
-                recip_lo[row, i] = r & 0xFFFFFFFF
+                recip_hi[row, i], recip_lo[row, i] = self._recip_u64(int(w))
         self.items = jnp.asarray(items)
         self.iweights = jnp.asarray(weights)
         self.sizes = jnp.asarray(sizes)
         self.btypes = jnp.asarray(btypes)
         self.recip_hi = jnp.asarray(recip_hi)
         self.recip_lo = jnp.asarray(recip_lo)
+        self._items_np = items
+        self._iweights_np = weights
+        # choose_args override tensors (inactive placeholders; see
+        # _activate_choose_args)
+        self._ca_active = False
+        self._ca_pdim = 1
+        self._ca_ids = jnp.zeros((1, 1), dtype=I32)
+        self._ca_w = jnp.zeros((1, 1), dtype=U32)
+        self._ca_rh = jnp.zeros((1, 1), dtype=U32)
+        self._ca_rl = jnp.zeros((1, 1), dtype=U32)
+        self._ca_pmax = jnp.zeros(1, dtype=I32)
+        self._ca_cache: Dict = {}
         self.max_devices = cmap.max_devices
         self.max_depth = cmap.max_depth()
         rh_hi, rh_lo = _split_u64(RH_TBL)
@@ -260,6 +265,86 @@ class TensorMapper:
         self._wclass = jnp.asarray(wclass)
         self._rep = jnp.asarray(rep_all)
 
+    # ------------------------------------------------------- choose_args
+
+    @staticmethod
+    def _recip_u64(w: int) -> Tuple[int, int]:
+        if w == 1:
+            r = 2**64 - 1
+        elif w > 1:
+            r = 2**64 // w
+        else:
+            r = 0
+        return r >> 32, r & 0xFFFFFFFF
+
+    def _build_ca_tensors(self, cargs) -> Tuple[Dict, int]:
+        """Device tensors for a choose_args set (reference crush.h:273-278
+        crush_choose_arg: per-bucket weight_set positions + id remaps,
+        consumed by bucket_straw2_choose via mapper.c:302-320).
+
+        Layout: ids (nb, S) replace the HASH input (chosen items stay the
+        bucket's real items); weights flatten to (nb*P, S) rows indexed by
+        bno*P + min(position, pmax[bno]), with precomputed u64 reciprocals
+        for the draw division."""
+        nb, S = self._items_np.shape
+        P = 1
+        for a in cargs.values():
+            if a.weight_set:
+                P = max(P, len(a.weight_set))
+        ids = self._items_np.astype(np.int64).copy()
+        w = np.repeat(self._iweights_np[:, None, :], P, axis=1).copy()
+        pmax = np.zeros(nb, dtype=np.int32)
+        for bid, arg in cargs.items():
+            row = -1 - bid
+            if not (0 <= row < nb):
+                continue
+            if arg.ids:
+                ids[row, :len(arg.ids)] = arg.ids
+            if arg.weight_set:
+                for p, ws in enumerate(arg.weight_set):
+                    w[row, p, :len(ws)] = ws
+                last = len(arg.weight_set) - 1
+                for p in range(len(arg.weight_set), P):
+                    w[row, p] = w[row, last]
+                pmax[row] = last
+        rh = np.zeros((nb, P, S), dtype=np.uint32)
+        rl = np.zeros((nb, P, S), dtype=np.uint32)
+        recip_memo: Dict[int, Tuple[int, int]] = {}
+        for idx, wv in np.ndenumerate(w):
+            wv = int(wv)
+            pair = recip_memo.get(wv)
+            if pair is None:
+                pair = recip_memo[wv] = self._recip_u64(wv)
+            rh[idx], rl[idx] = pair
+        tensors = {
+            "_ca_ids": jnp.asarray(ids.astype(np.int32)),
+            "_ca_w": jnp.asarray(w.reshape(nb * P, S).astype(np.uint32)),
+            "_ca_rh": jnp.asarray(rh.reshape(nb * P, S)),
+            "_ca_rl": jnp.asarray(rl.reshape(nb * P, S)),
+            "_ca_pmax": jnp.asarray(pmax),
+        }
+        return tensors, P
+
+    def _resolve_choose_args(self, choose_args):
+        """-> (cache_key, tensors, P) for a name or {bucket_id: ChooseArg}."""
+        if isinstance(choose_args, str):
+            cargs = self.map.choose_args[choose_args]
+            key = choose_args
+        else:
+            cargs = choose_args
+            # content-addressed: a balancer loop passing fresh weights for
+            # the same buckets must never hit a stale tensor set
+            key = ("dict", tuple(sorted(
+                (bid,
+                 tuple(a.ids) if a.ids else None,
+                 tuple(tuple(ws) for ws in a.weight_set)
+                 if a.weight_set else None)
+                for bid, a in cargs.items())))
+        cached = self._ca_cache.get(key)
+        if cached is None:
+            cached = self._ca_cache[key] = self._build_ca_tensors(cargs)
+        return key, cached[0], cached[1]
+
     # ------------------------------------------------------------------ ln
 
     @staticmethod
@@ -337,17 +422,36 @@ class TensorMapper:
 
     # -------------------------------------------------------------- straw2
 
-    def _straw2(self, bno, x, r):
+    def _straw2(self, bno, x, r, wpos=None):
         """bucket_straw2_choose (mapper.c:322-367) over a lane batch.
 
         bno (L,), x (L,) uint32, r (L,) int32 -> chosen item (L,) int32.
+        ``wpos`` (L,) is the output position selecting the choose_args
+        weight_set row (mapper.c:302-320); ignored without choose_args.
 
         Uniform-weight maps take the gather-free plateau path (see
-        _build_fast_straw2); others evaluate |ln| draws via table gather.
+        _build_fast_straw2); choose_args overrides and non-uniform maps
+        evaluate |ln| draws via table gather.
         """
         it = self.items[bno]                      # (L, S)
         meta = self._meta[bno]                    # (L, 4) row gather
         sz = meta[:, 0]
+        if self._ca_active:
+            # choose_args: alternate ids feed the hash (the chosen item
+            # stays the bucket's real item), alternate weights feed the
+            # draws
+            hash_ids = self._ca_ids[bno]
+            if wpos is None:
+                wpos = jnp.zeros_like(bno)
+            p = jnp.minimum(wpos, self._ca_pmax[bno])
+            row = bno * self._ca_pdim + p
+            wt = self._ca_w[row]                  # (L, S)
+            u = jenkins.hash3(x[:, None], hash_ids.astype(U32),
+                              r.astype(U32)[:, None]) & 0xFFFF
+            pos = jnp.arange(it.shape[1], dtype=I32)
+            invalid = (wt == 0) | (pos[None, :] >= sz[:, None])
+            return self._draw_argmin(it, u, wt, self._ca_rh[row],
+                                     self._ca_rl[row], invalid)
         u = jenkins.hash3(x[:, None], it.astype(U32), r.astype(U32)[:, None]) & 0xFFFF
         pos = jnp.arange(it.shape[1], dtype=I32)
         if self._fast:
@@ -363,12 +467,16 @@ class TensorMapper:
             return jnp.take_along_axis(it, idx[:, None], axis=1)[:, 0]
         wt = self.iweights[bno]
         invalid = (wt == 0) | (pos[None, :] >= sz[:, None])
+        return self._draw_argmin(it, u, wt, self.recip_hi[bno],
+                                 self.recip_lo[bno], invalid)
+
+    def _draw_argmin(self, it, u, wt, rh, rl, invalid):
+        """Shared |ln|-draw evaluation + first-occurrence two-level
+        argmin (draw > high_draw semantics) over (L, S) lanes."""
         n = (self._lnn[0][u], self._lnn[1][u])
-        qh, ql = u64pair.div_by_recip(
-            n, wt, self.recip_hi[bno], self.recip_lo[bno])
+        qh, ql = u64pair.div_by_recip(n, wt, rh, rl)
         qh = jnp.where(invalid, jnp.uint32(0xFFFFFFFF), qh)
         ql = jnp.where(invalid, jnp.uint32(0xFFFFFFFF), ql)
-        # first-occurrence two-level argmin (draw > high_draw semantics)
         m1 = qh.min(axis=1, keepdims=True)
         c1 = qh == m1
         ql2 = jnp.where(c1, ql, jnp.uint32(0xFFFFFFFF))
@@ -387,7 +495,7 @@ class TensorMapper:
         hashed = (jenkins.hash2(x, item.astype(U32)) & 0xFFFF) >= w
         return over | (w == 0) | ((w < 0x10000) & hashed)
 
-    def _descend(self, start, x, r, type_):
+    def _descend(self, start, x, r, type_, wpos=None):
         """Descend intervening buckets until an item of type_ (or dead end).
 
         Returns (item, hit_empty).  Mirrors the retry_bucket descent of
@@ -402,7 +510,7 @@ class TensorMapper:
             need = is_b & (meta[:, 1] != type_)
             empty = need & (meta[:, 0] == 0)
             hit_empty = hit_empty | empty
-            nxt = self._straw2(bno, x, r)
+            nxt = self._straw2(bno, x, r, wpos)
             cur = jnp.where(need & ~empty, nxt, cur)
         return cur, hit_empty
 
@@ -433,7 +541,9 @@ class TensorMapper:
             leaf, done, lftotal = s
             live = ~done & (lftotal < tries)
             r2 = inner_rep + sub_r + lftotal
-            cur, hit_empty = self._descend(host, x, r2, 0)
+            # choose_args position: the recursing slot (scalar passes the
+            # outer outpos through to the leaf's bucket_choose)
+            cur, hit_empty = self._descend(host, x, r2, 0, cnt)
             bad = self._bad_item(cur, 0) & ~hit_empty
             coll = jnp.any(
                 (out2 == cur[:, None])
@@ -467,7 +577,8 @@ class TensorMapper:
                 out, out2, cnt, ftotal, done = s
                 live = ~done & (ftotal < tries)
                 r = rep + ftotal
-                cur, hit_empty = self._descend(take, x, r, type_)
+                # choose_args position = the slot being filled (outpos)
+                cur, hit_empty = self._descend(take, x, r, type_, cnt)
                 bad = live & self._bad_item(cur, type_) & ~hit_empty
                 coll = jnp.any(
                     (out == cur[:, None])
@@ -517,7 +628,9 @@ class TensorMapper:
             leaf, done, ftotal = s
             live = ~done & (ftotal < tries)
             r = rep + parent_r + numrep * ftotal
-            cur, hit_empty = self._descend(host, x, r, 0)
+            # scalar's indep leaf recursion passes its slot as outpos
+            cur, hit_empty = self._descend(
+                host, x, r, 0, jnp.full_like(host, rep))
             bad = self._bad_item(cur, 0)
             rej = self._is_out(self._w, cur, x) | hit_empty
             ok = live & ~bad & ~rej
@@ -589,23 +702,30 @@ class TensorMapper:
     # dispatch in the process on the axon platform (~150x slowdown).
     _TENSOR_ATTRS = ("items", "iweights", "sizes", "btypes", "recip_hi",
                      "recip_lo", "_rh", "_lh", "_ll", "_lnn",
-                     "_p2flat", "_meta")
+                     "_p2flat", "_meta",
+                     "_ca_ids", "_ca_w", "_ca_rh", "_ca_rl", "_ca_pmax")
 
     def _tensor_args(self):
         return {a: getattr(self, a) for a in self._TENSOR_ATTRS}
 
-    def _build_rule_fn(self, ruleno: int, result_max: int):
+    def _build_rule_fn(self, ruleno: int, result_max: int,
+                       ca_active: bool = False, ca_pdim: int = 1):
         m = self.map
         t = m.tunables
         rule = m.rules[ruleno]
 
         def run(xs, weights, tensors):
             saved = {a: getattr(self, a) for a in self._TENSOR_ATTRS}
+            saved_ca = (self._ca_active, self._ca_pdim)
             for a, v in tensors.items():
                 setattr(self, a, v)
+            # static choose_args mode must bind at TRACE time (jit traces
+            # lazily on first call, not at build)
+            self._ca_active, self._ca_pdim = ca_active, ca_pdim
             try:
                 return self._run_rule(xs, weights, rule, t, result_max)
             finally:
+                self._ca_active, self._ca_pdim = saved_ca
                 for a, v in saved.items():
                     setattr(self, a, v)
 
@@ -698,20 +818,41 @@ class TensorMapper:
             else:
                 raise NotImplementedError(f"rule op {op}")
         return result, rlen
-    def compiled_rule(self, ruleno: int, result_max: int):
+    def compiled_rule(self, ruleno: int, result_max: int,
+                      choose_args=None):
         """Public seam for external dispatch harnesses (e.g. the mesh
         shard-out in parallel/engine.py): the cached compiled rule fn
         ``(xs, weights, tensors) -> (result, lens)`` plus the map tensor
-        args, sharing this mapper's compile cache."""
-        key = (ruleno, result_max)
+        args, sharing this mapper's compile cache.  ``choose_args``: a
+        name registered in map.choose_args or a {bucket_id: ChooseArg}
+        dict — compiles a variant whose straw2 draws use the override
+        weights/ids (mapper.c:302-320)."""
+        if choose_args is None:
+            key = (ruleno, result_max)
+            if key not in self._compiled:
+                self._compiled[key] = self._build_rule_fn(
+                    ruleno, result_max)
+            return self._compiled[key], self._tensor_args()
+        ca_key, ca_tensors, P = self._resolve_choose_args(choose_args)
+        key = (ruleno, result_max, ca_key, P)
         if key not in self._compiled:
-            self._compiled[key] = self._build_rule_fn(ruleno, result_max)
-        return self._compiled[key], self._tensor_args()
+            self._compiled[key] = self._build_rule_fn(
+                ruleno, result_max, ca_active=True, ca_pdim=P)
+        # tensor-args snapshot with the override tensors swapped in
+        saved = {a: getattr(self, a) for a in ca_tensors}
+        for a, v in ca_tensors.items():
+            setattr(self, a, v)
+        try:
+            return self._compiled[key], self._tensor_args()
+        finally:
+            for a, v in saved.items():
+                setattr(self, a, v)
 
-    def do_rule_batch(self, ruleno: int, xs, result_max: int, weights):
+    def do_rule_batch(self, ruleno: int, xs, result_max: int, weights,
+                      choose_args=None):
         """Map a batch of x values; returns (N, result_max) int32 with
         CRUSH_ITEM_NONE padding, plus lengths, matching crush_do_rule."""
-        fn, tensors = self.compiled_rule(ruleno, result_max)
+        fn, tensors = self.compiled_rule(ruleno, result_max, choose_args)
         xs = jnp.asarray(xs, dtype=U32)
         weights = jnp.asarray(weights, dtype=U32)
         n = xs.shape[0]

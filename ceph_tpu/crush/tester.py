@@ -78,26 +78,26 @@ class CrushTester:
             weights = [0x10000] * m.max_devices
         xs = range(min_x, max_x + 1)
         results: List[List[int]] = []
-        # TensorMapper itself raises NotImplementedError for maps it
-        # cannot vectorize (non-straw2 buckets, local retries) — only the
-        # choose_args gap needs pre-checking here
-        use_tensor = choose_args is None
-        if use_tensor:
-            try:
-                from ceph_tpu.crush.mapper import TensorMapper
+        # TensorMapper raises NotImplementedError for maps it cannot
+        # vectorize (non-straw2 buckets, local retries); since round 5 it
+        # vectorizes choose_args too
+        use_tensor = True
+        try:
+            from ceph_tpu.crush.mapper import TensorMapper
 
-                tm = TensorMapper(m)
-                out, lens = tm.do_rule_batch(
-                    ruleno, np.arange(min_x, max_x + 1, dtype=np.uint32),
-                    result_max=result_max,
-                    weights=np.asarray(weights, dtype=np.uint32))
-                out = np.asarray(out)
-                lens = np.asarray(lens)
-                results = [
-                    [int(v) for v in out[i, :int(lens[i])]]
-                    for i in range(out.shape[0])]
-            except (NotImplementedError, AssertionError):
-                use_tensor = False
+            tm = TensorMapper(m)
+            out, lens = tm.do_rule_batch(
+                ruleno, np.arange(min_x, max_x + 1, dtype=np.uint32),
+                result_max=result_max,
+                weights=np.asarray(weights, dtype=np.uint32),
+                choose_args=choose_args)
+            out = np.asarray(out)
+            lens = np.asarray(lens)
+            results = [
+                [int(v) for v in out[i, :int(lens[i])]]
+                for i in range(out.shape[0])]
+        except (NotImplementedError, AssertionError):
+            use_tensor = False
         if not use_tensor:
             sm = ScalarMapper(m)
             results = [sm.do_rule(ruleno, x, result_max, weights,
