@@ -283,6 +283,37 @@ class Monitor(Dispatcher):
         self._log.append((what, payload))
         self.perf.inc("mon_proposals")
 
+    async def _pool_set_pgnum(self, pid: int, var: str, val):
+        """'osd pool set pg_num/pgp_num' (reference OSDMonitor pg_num
+        checks + PG splitting on the OSDs).  pg_num may only GROW, and
+        pgp_num stays put until set separately, so freshly-split children
+        place with their parents (osd_types pps folding) and migrate on
+        the later pgp_num bump — the reference's split-then-move design."""
+        import dataclasses as _dc
+
+        po = self.osdmap.pools[pid]
+        try:
+            ival = int(val)
+        except (TypeError, ValueError):
+            return -22, f"invalid {var}={val!r}"
+        if var == "pg_num":
+            if po.is_erasure():
+                return -95, "pg_num change on erasure pools not supported"
+            if ival <= po.pg_num:
+                return -22, (f"pg_num {ival} must exceed current "
+                             f"{po.pg_num} (merging unsupported)")
+            new_pool = _dc.replace(po, pg_num=ival)
+        else:
+            if not (1 <= ival <= po.pg_num):
+                return -22, f"need 1 <= pgp_num <= pg_num ({po.pg_num})"
+            new_pool = _dc.replace(po, pgp_num=ival)
+        async with self._map_mutex:
+            inc = self._new_inc()
+            inc.new_pools[pid] = new_pool
+            if not await self._commit_inc(inc):
+                return -11, "quorum lost"
+        return 0, ival
+
     def _new_inc(self) -> Incremental:
         return Incremental(epoch=self.osdmap.epoch + 1)
 
@@ -589,9 +620,10 @@ class Monitor(Dispatcher):
                 var, val = cmd.get("var"), cmd.get("val")
                 if pid is None:
                     result, data = -2, f"pool {cmd['pool']!r} not found"
+                elif var in ("pg_num", "pgp_num"):
+                    result, data = await self._pool_set_pgnum(
+                        pid, var, val)
                 elif var not in ("size", "min_size"):
-                    # pg_num changes imply PG splitting — unimplemented,
-                    # refused loudly rather than silently misplacing
                     result, data = -22, f"cannot set {var!r}"
                 else:
                     import dataclasses as _dc

@@ -442,6 +442,18 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         path, reference OSD.cc:2572)."""
         m = self.osdmap
         changed = False
+        # pg_num growth: split local PGs whose persisted split watermark
+        # trails the pool's pg_num, BEFORE recomputing membership, so
+        # child PGStates load the split-out meta/objects (reference
+        # PG::split_colls on map advance).  The watermark rides the
+        # PGMETA object, so an OSD that was down across the bump splits
+        # on resume.
+        for pool_id, pool in m.pools.items():
+            if pool.is_erasure():
+                continue
+            for pgid, st in list(self.pgs.items()):
+                if pgid.pool == pool_id and self._maybe_split(pool, st):
+                    changed = True
         for pool_id, pool in m.pools.items():
             for pgid, up, upp, acting, actp in self._pool_memberships(
                     m, pool_id, pool):
@@ -453,6 +465,10 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                         self.store.queue_transaction(
                             Transaction().create_collection(_coll(pgid)))
                         st = PGState(pgid, up, acting, actp)
+                        # resumed parent collections split BEFORE their
+                        # children (lower seeds iterate first) load meta
+                        if not pool.is_erasure():
+                            self._maybe_split(pool, st)
                         st.last_update, st.log = self._load_pg_meta(pgid)
                         st.last_complete = self._load_last_complete(pgid)
                         self.pgs[pgid] = st

@@ -18,7 +18,7 @@ from ceph_tpu.cluster import messages as M
 from ceph_tpu.cluster import pglog
 from ceph_tpu.cluster.pglog import LogEntry, PGInfo, PGLog
 from ceph_tpu.cluster.store import Transaction
-from ceph_tpu.osdmap.osdmap import PGid
+from ceph_tpu.osdmap.osdmap import PGid, ceph_stable_mod
 
 # the client reqid whose op vector is currently executing (set around
 # _execute_client_ops by the mutation-dedup wrapper); _log_mutation stamps
@@ -207,10 +207,111 @@ class PGLogMixin:
             self.perf.inc("osd_log_rewinds")
         st.log.entries = [e for e in st.log.entries
                           if e.version <= auth_head]
+        # in-place entries rewrite: the lazy reqid dup index must rebuild,
+        # or has_reqid would ack ops whose effects were just rolled back
+        st.log._reqids = None
         st.last_update = auth_head
         txn.setattr(coll, PGMETA, "last_update", pickle.dumps(auth_head))
         self.store.queue_transaction(txn)
         return need_copy
+
+    # ------------------------------------------------------- PG splitting
+
+    def _split_pg(self, pool, st: "PGState") -> List[PGid]:
+        """Split this parent PG's objects/log into child collections by
+        stable_mod under the pool's CURRENT pg_num (reference
+        PG::split_colls / split_into, PG.h:416-422,1436).
+
+        Runs on every OSD holding the parent when pg_num grows; because
+        pgp_num is unchanged at that moment, children place onto the SAME
+        acting set as the parent (raw_pg_to_pps folds child seeds back to
+        the parent's placement seed), so every member splits identically
+        and the children activate with their data in place.  A later
+        pgp_num increase migrates children via the normal remap+recovery
+        path.  Returns the child pgids that received objects."""
+        from ceph_tpu.cluster import snaps as snapmod
+        from ceph_tpu.ops.jenkins import str_hash_rjenkins
+
+        coll = _coll(st.pgid)
+        new_num, mask = pool.pg_num, pool.pg_num_mask
+
+        def child_seed(head: str) -> int:
+            return ceph_stable_mod(
+                str_hash_rjenkins(head.encode()), new_num, mask)
+
+        moves: Dict[int, List[str]] = {}
+        for name in self.store.list_objects(coll):
+            if name in (PGMETA, PGRB):
+                continue  # pg-internal bookkeeping objects stay put
+            seed = child_seed(snapmod.head_of(name))
+            if seed != st.pgid.seed:
+                moves.setdefault(seed, []).append(name)
+        # the LOG splits by oid hash independently of surviving store
+        # objects: entries for deleted objects must migrate too, or their
+        # dup protection dies with the split
+        log_moves: Dict[int, List[LogEntry]] = {}
+        for e in st.log.entries:
+            seed = child_seed(snapmod.head_of(e.oid))
+            if seed != st.pgid.seed:
+                log_moves.setdefault(seed, []).append(e)
+        children: List[PGid] = []
+        for seed in sorted(set(moves) | set(log_moves)):
+            names = moves.get(seed, [])
+            child = PGid(st.pgid.pool, seed)
+            children.append(child)
+            dst = _coll(child)
+            txn = Transaction()
+            if dst not in self.store.list_collections():
+                txn.create_collection(dst)
+            for name in names:
+                data = self.store.read(coll, name)
+                txn.write(dst, name, 0, data if data else b"")
+                for k, v in self.store.get_xattrs(coll, name).items():
+                    txn.setattr(dst, name, k, v)
+                om = self.store.omap_get(coll, name)
+                if om:
+                    txn.omap_set(dst, name, om)
+                txn.set_version(dst, name, self.store.get_version(coll, name))
+                txn.remove(coll, name)
+            # child log: the parent's entries for the child's objects,
+            # with the parent's watermarks so peering among the child's
+            # members (== the parent's members) agrees
+            entries = log_moves.get(seed, [])
+            txn.omap_set(dst, PGMETA,
+                         {self._meta_key(e.version): pickle.dumps(e)
+                          for e in entries})
+            txn.setattr(dst, PGMETA, "last_update",
+                        pickle.dumps(st.last_update))
+            txn.setattr(dst, PGMETA, "log_tail", pickle.dumps(st.log.tail))
+            txn.setattr(dst, PGMETA, "last_complete",
+                        pickle.dumps(st.last_complete))
+            txn.setattr(dst, PGMETA, "split_pgnum", pickle.dumps(new_num))
+            self.store.queue_transaction(txn)
+            self.perf.inc("osd_pg_splits")
+        # stamp the parent: this collection is now consistent with new_num
+        self.store.queue_transaction(Transaction().setattr(
+            coll, PGMETA, "split_pgnum", pickle.dumps(new_num)))
+        return children
+
+    def _maybe_split(self, pool, st: "PGState") -> bool:
+        """Split this PG if its on-store split watermark is behind the
+        pool's pg_num.  The watermark persists with the PG (setattr on
+        PGMETA), so an OSD that was down or restarted across the pg_num
+        bump still splits on resume — an in-memory tracker would not
+        survive (reference: split is driven from the persisted map epoch).
+        NOTE: children assume the parent's placement (pgp_num unchanged);
+        bump pgp_num only after the cluster has advanced past the split.
+        """
+        coll = _coll(st.pgid)
+        blob = self.store.getattr(coll, PGMETA, "split_pgnum")
+        stored = pickle.loads(blob) if blob else -1
+        # stored == -1: unstamped collection (predates the watermark, or
+        # the OSD was down across the bump before creation stamping) —
+        # scan once; _split_pg stamps even when nothing moves
+        if 0 < pool.pg_num <= stored:
+            return False
+        self._split_pg(pool, st)
+        return True
 
     def _save_pg_meta(self, st: PGState) -> None:
         """Full rewrite of the persisted log (recovery-time adoption of an

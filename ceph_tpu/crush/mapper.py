@@ -301,12 +301,11 @@ class TensorMapper:
             if arg.ids:
                 ids[row, :len(arg.ids)] = arg.ids
             if arg.weight_set:
+                # positions beyond len(weight_set) are never selected:
+                # _straw2 clamps with pmax, so no padding is needed
                 for p, ws in enumerate(arg.weight_set):
                     w[row, p, :len(ws)] = ws
-                last = len(arg.weight_set) - 1
-                for p in range(len(arg.weight_set), P):
-                    w[row, p] = w[row, last]
-                pmax[row] = last
+                pmax[row] = len(arg.weight_set) - 1
         rh = np.zeros((nb, P, S), dtype=np.uint32)
         rl = np.zeros((nb, P, S), dtype=np.uint32)
         recip_memo: Dict[int, Tuple[int, int]] = {}
@@ -343,6 +342,10 @@ class TensorMapper:
         cached = self._ca_cache.get(key)
         if cached is None:
             cached = self._ca_cache[key] = self._build_ca_tensors(cargs)
+            # bound the content-addressed tensor cache (balancer loops
+            # mint a fresh weight set per iteration)
+            while len(self._ca_cache) > 16:
+                self._ca_cache.pop(next(iter(self._ca_cache)))
         return key, cached[0], cached[1]
 
     # ------------------------------------------------------------------ ln
@@ -834,7 +837,10 @@ class TensorMapper:
                     ruleno, result_max)
             return self._compiled[key], self._tensor_args()
         ca_key, ca_tensors, P = self._resolve_choose_args(choose_args)
-        key = (ruleno, result_max, ca_key, P)
+        # the compiled fn depends only on (rule, result_max, P) — the
+        # override tensors are runtime args — so a balancer loop with
+        # fresh weights each iteration reuses one compilation
+        key = (ruleno, result_max, "ca", P)
         if key not in self._compiled:
             self._compiled[key] = self._build_rule_fn(
                 ruleno, result_max, ca_active=True, ca_pdim=P)

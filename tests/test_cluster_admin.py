@@ -159,7 +159,7 @@ def test_pool_delete_rename_set():
             await client.pool_set("renamed", "size", 2)
             assert client.objecter.osdmap.pools[pool].size == 2
             with pytest.raises(RuntimeError):
-                await client.pool_set("renamed", "pg_num", 16)
+                await client.pool_set("renamed", "pg_num", 4)  # shrink
             # ADVICE r4: invalid size/min_size must be EINVAL, never
             # committed (they would wedge all writes on the pool)
             for var, val in (("size", 0), ("size", -1), ("min_size", 0),

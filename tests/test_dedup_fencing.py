@@ -153,6 +153,10 @@ def test_scrub_tie_marks_inconsistent_not_repaired():
     run(scenario())
 
 
+from tests._flaky import contention_retry
+
+
+@contention_retry()
 def test_resend_after_primary_change_not_reexecuted():
     """ADVICE r5: the in-memory reqid cache dies with the primary, but
     client reqids ride the replicated pg log entries — a resend landing
@@ -180,7 +184,8 @@ def test_resend_after_primary_change_not_reexecuted():
                 await asyncio.sleep(0.25)
                 _, _, acting, primary = \
                     obj.osdmap.pg_to_up_acting_osds(pgid)
-                if primary >= 0 and primary != old_primary:
+                if primary >= 0 and primary != old_primary \
+                        and pgid in cluster.osds[primary].pgs:
                     break
             assert primary != old_primary, "no failover happened"
             # resend the SAME op to the new primary

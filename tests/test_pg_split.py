@@ -1,0 +1,180 @@
+"""PG splitting: pg_num growth under load (VERDICT r4 missing #2).
+
+Reference seams: PG::split_colls / split_into (src/osd/PG.h:416-422,1436)
+and OSDMonitor's pg_num/pgp_num handling — pg_num growth splits objects
+and logs into child PGs colocated with their parents (pgp_num unchanged
+keeps the placement seed folded), then a separate pgp_num increase
+migrates children through the normal remap+recovery path.
+"""
+
+import asyncio
+
+import pytest
+
+from tests._flaky import contention_retry
+
+from ceph_tpu.cluster.vstart import start_cluster
+from ceph_tpu.osdmap.osdmap import PGid
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@contention_retry(attempts=3)
+def test_pg_split_doubles_under_load_and_scrubs_clean():
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("split", "replicated",
+                                            pg_num=4, size=3)
+            io = client.ioctx(pool)
+            objs = {f"obj-{i}": (b"payload-%d " % i) * 50
+                    for i in range(24)}
+            for k, v in objs.items():
+                await io.write_full(k, v)
+            # snapshot + overwrite so clones must follow their heads
+            await io.snap_create("before")
+            await io.write_full("obj-0", b"after-snap")
+
+            async def writer():
+                for i in range(10):
+                    await io.write_full(f"live-{i}", b"during-split")
+                    await asyncio.sleep(0.01)
+
+            wtask = asyncio.get_event_loop().create_task(writer())
+            await client.pool_set("split", "pg_num", 8)
+            await wtask
+            p = client.objecter.osdmap.pools[pool]
+            assert p.pg_num == 8 and p.pgp_num == 4
+            # wait until every OSD has advanced to the split map (fixed
+            # sleeps flake on the 1-core driver)
+            for _ in range(300):
+                if all(o.osdmap.pools[pool].pg_num == 8
+                       for o in cluster.osds.values() if not o._stopped):
+                    break
+                await asyncio.sleep(0.1)
+
+            # every object still reads back
+            for k, v in objs.items():
+                want = b"after-snap" if k == "obj-0" else v
+                assert await io.read(k, timeout=60) == want, k
+            for i in range(10):
+                assert await io.read(f"live-{i}", timeout=60) \
+                    == b"during-split"
+            # snap read resolves through the split
+            snapid = client.objecter.osdmap.pools[pool].snaps
+            sid = next(s for s, n in snapid.items() if n == "before")
+            assert await io.read("obj-0", snapid=sid) == objs["obj-0"]
+
+            # child PGs actually exist and hold objects
+            seeds = {client.objecter.object_pgid(pool, k).seed
+                     for k in objs}
+            assert any(s >= 4 for s in seeds), "no object maps to a child"
+
+            # scrub every PG clean on its primary
+            for seed in range(8):
+                pgid = PGid(pool, seed)
+                _, _, acting, primary = \
+                    client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+                st = cluster.osds[primary].pgs.get(pgid)
+                if st is None:
+                    continue
+                report = await cluster.osds[primary].scrub_pg(st)
+                assert report["inconsistent"] == [], (seed, report)
+
+            # now move placements: pgp_num follows, children remap and
+            # recover; data survives
+            await client.pool_set("split", "pgp_num", 8)
+            for _ in range(300):
+                if all(o.osdmap.pools[pool].pgp_num == 8
+                       for o in cluster.osds.values() if not o._stopped):
+                    break
+                await asyncio.sleep(0.1)
+            for k, v in objs.items():
+                want = b"after-snap" if k == "obj-0" else v
+                assert await io.read(k, timeout=60) == want, k
+            assert client.objecter.osdmap.pools[pool].pgp_num == 8
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_pg_num_validation():
+    async def scenario():
+        cluster = await start_cluster(2)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("v", "replicated",
+                                            pg_num=4, size=2)
+            with pytest.raises(RuntimeError):
+                await client.pool_set("v", "pg_num", 4)     # no shrink/same
+            with pytest.raises(RuntimeError):
+                await client.pool_set("v", "pg_num", 2)
+            with pytest.raises(RuntimeError):
+                await client.pool_set("v", "pgp_num", 9)    # > pg_num
+            ec = await client.pool_create(
+                "ev", "erasure", pg_num=4,
+                ec_profile={"plugin": "jerasure",
+                            "technique": "reed_sol_van",
+                            "k": "2", "m": "1"})
+            with pytest.raises(RuntimeError):
+                await client.pool_set("ev", "pg_num", 8)    # EC refused
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+@contention_retry()
+def test_osd_down_across_split_splits_on_resume():
+    """An OSD that missed the pg_num bump must split its parent
+    collections when it rejoins (the split watermark persists on the
+    PGMETA object, not in daemon memory)."""
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("rsplit", "replicated",
+                                            pg_num=4, size=3)
+            io = client.ioctx(pool)
+            for i in range(20):
+                await io.write_full(f"r-{i}", b"resume-%d" % i)
+            victim = next(iter(cluster.osds))
+            await cluster.osds[victim].stop()
+            await client.pool_set("rsplit", "pg_num", 8)
+            await asyncio.sleep(1.0)
+            osd = await cluster.restart_osd(victim)
+            # wait for the resumed OSD to advance to the split map
+            for _ in range(300):
+                if osd.osdmap.pools.get(pool) is not None and \
+                        osd.osdmap.pools[pool].pg_num == 8:
+                    break
+                await asyncio.sleep(0.1)
+            await asyncio.sleep(1.0)
+            for i in range(20):
+                assert await io.read(f"r-{i}", timeout=60) \
+                    == b"resume-%d" % i
+            # the resumed OSD's parent collections hold no child objects
+            from ceph_tpu.cluster.pg import PGMETA, PGRB, _coll
+            from ceph_tpu.ops.jenkins import str_hash_rjenkins
+            from ceph_tpu.osdmap.osdmap import ceph_stable_mod
+            p = osd.osdmap.pools[pool]
+            for coll in osd.store.list_collections():
+                if not coll.startswith(f"pg_{pool}_"):
+                    continue
+                seed = int(coll.split("_")[2])
+                for name in osd.store.list_objects(coll):
+                    if name in (PGMETA, PGRB):
+                        continue
+                    want = ceph_stable_mod(
+                        str_hash_rjenkins(name.encode()),
+                        p.pg_num, p.pg_num_mask)
+                    assert want == seed, \
+                        f"{name} stranded in {coll} (belongs to {want})"
+        finally:
+            await cluster.stop()
+
+    run(scenario())
