@@ -292,6 +292,54 @@ EC_CONFIGS = [
 ]
 
 
+def bench_cluster_io(secs_write=4.0, secs_read=3.0):
+    """End-to-end cluster I/O (the reference `rados bench` run,
+    src/tools/rados/rados.cc:103): a live 3-OSD vstart cluster with an
+    EC k2m1 pool, measured through the full client->primary->EC
+    encode(TPU)->replicate pipeline.  Returns a list of metric rows."""
+    import asyncio
+
+    from ceph_tpu.cluster.vstart import _fast_config, start_cluster
+    from ceph_tpu.tools.rados import bench as rados_bench
+
+    async def scenario():
+        cluster = await start_cluster(3, config=_fast_config())
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create(
+                "bench_ec", "erasure", pg_num=8,
+                ec_profile={"plugin": "jerasure",
+                            "technique": "reed_sol_van",
+                            "k": "2", "m": "1"})
+            io = client.ioctx(pool)
+            # warm the codec compile caches before the timing window so
+            # the window measures steady-state I/O, not XLA compiles
+            for i in range(3):
+                await io.write_full(f"warm_{i}", b"\xa5" * (1 << 20))
+                await io.read(f"warm_{i}")
+            w = await rados_bench(io, secs_write, "write",
+                                  concurrency=16, block_size=1 << 20,
+                                  cleanup=False)
+            r = await rados_bench(io, secs_read, "rand",
+                                  concurrency=16, block_size=1 << 20)
+            return w, r
+        finally:
+            await cluster.stop()
+
+    w, r = asyncio.run(scenario())
+    rows = []
+    for tag, rep in (("write", w), ("rand_read", r)):
+        rows.append({
+            "metric": f"cluster_io_{tag}_ec_k2m1_1MiB_t16",
+            "value": round(rep["mbps"], 2), "unit": "MB/s",
+            "vs_baseline": None, "baseline": None,
+            "baseline_src": "unmeasured", "mode": "cluster_vstart",
+            "lat_p50_ms": round(rep["lat_p50_ms"], 2),
+            "lat_p95_ms": round(rep["lat_p95_ms"], 2),
+            "iops": round(rep["iops"], 1)})
+    return rows
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--all", action="store_true",
@@ -339,6 +387,11 @@ def main():
         except Exception as e:
             print(json.dumps({"metric": "crush_map_10kosd_1Mpg",
                               "error": repr(e)}), file=sys.stderr)
+        try:
+            results.extend(bench_cluster_io())
+        except Exception as e:
+            print(json.dumps({"metric": "cluster_io", "error": repr(e)}),
+                  file=sys.stderr)
         for r in results:
             print(json.dumps(r))
 

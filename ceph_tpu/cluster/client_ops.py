@@ -125,7 +125,8 @@ class ClientOpsMixin:
     _MUTATING_OPS = frozenset({
         "write_full", "write", "delete", "setxattr", "rmxattr",
         "omap_set", "omap_rmkeys", "exec",
-        "append", "truncate", "zero", "create"})
+        "append", "truncate", "zero", "create",
+        "copy_from", "rollback"})
     _REQID_DUPS_TRACKED = 3000
     # ops that gate the rest of their vector (CEPH_OSD_OP_CMPXATTR etc.)
     _GUARD_OPS = frozenset({"cmpxattr"})
@@ -388,7 +389,12 @@ class ClientOpsMixin:
                      if not snapmod.is_snap_key(o)]
             return 0, names
         if opname in ("getxattr", "getxattrs", "omap_get"):
-            return self._op_read_meta(st, msg.oid, opname, args)
+            # snap-aware like "read": resolve the serving clone first
+            try:
+                moid = self._snap_read_oid(pool, st, msg.oid, msg.snapid)
+            except FileNotFoundError:
+                return -2, None
+            return self._op_read_meta(st, moid, opname, args)
         if opname in ("setxattr", "rmxattr", "omap_set", "omap_rmkeys"):
             async with st.lock:
                 r = await self._op_write_meta(st, msg.oid, opname, args)
@@ -405,6 +411,55 @@ class ClientOpsMixin:
             self._watchers.get((st.pgid, msg.oid), {}).pop(
                 (str(msg.src), args["cookie"]), None)
             return 0, None
+        if opname == "copy_from":
+            # CEPH_OSD_OP_COPY_FROM (reference PrimaryLogPG.cc:3113
+            # do_osd_ops COPY_FROM -> start_copy): the DESTINATION
+            # primary pulls the source object — data, user xattrs, omap —
+            # through its own internal client (works cross-pool and
+            # across pool types) and REPLACES the destination wholesale
+            src_pool = args.get("src_pool", st.pgid.pool)
+            src_oid = args["src_oid"]
+            src_snapid = args.get("src_snapid")
+            reply = await self.internal_op(
+                src_pool, src_oid,
+                [("read", {}), ("getxattrs", {}), ("omap_get", {})],
+                snapid=src_snapid)
+            if reply.result < 0:
+                return reply.result, None
+            data, xattrs, omap = reply.data
+            async with st.lock:
+                r = await self._op_write_full(pool, st, msg.oid, data,
+                                              snapc=msg.snapc)
+                if r < 0:
+                    return r, None
+                r = await self._replace_meta(st, msg.oid, xattrs or {},
+                                             omap or {})
+            return (r, None) if r < 0 else (0, len(data))
+        if opname == "rollback":
+            # CEPH_OSD_OP_ROLLBACK (reference PrimaryLogPG::_rollback_to):
+            # make the head IDENTICAL to the object's state at ``snapid``
+            # — the restore runs through the normal write path, so the
+            # CURRENT head still COWs into its own clone first
+            snapid = args["snapid"]
+            try:
+                src = self._snap_read_oid(pool, st, msg.oid, snapid)
+            except FileNotFoundError:
+                return -2, None
+            if src == msg.oid:
+                return 0, None  # head already carries the snap state
+            data = await self._op_read(pool, st, src, 0, None)
+            coll = _coll(st.pgid)
+            xattrs = {k[1:]: v for k, v in
+                      self.store.get_xattrs(coll, src).items()
+                      if k.startswith("_")}
+            omap = self.store.omap_get(coll, src)
+            async with st.lock:
+                r = await self._op_write_full(pool, st, msg.oid, data,
+                                              snapc=msg.snapc)
+                if r < 0:
+                    return r, None
+                r = await self._replace_meta(st, msg.oid, xattrs, omap)
+            return (r, None) if r < 0 else (0, None)
         if opname == "notify_ack":
             entry = self._notifies.get(args["notify_id"])
             if entry is not None:
@@ -420,6 +475,37 @@ class ClientOpsMixin:
     # User xattrs are stored with a "_" prefix, exactly like the reference
     # object store's user-attr namespace, so they never collide with the
     # internal shard/size/hinfo attrs.
+
+    async def _replace_meta(self, st: PGState, oid: str,
+                            xattrs: Dict, omap: Dict) -> int:
+        """Make the object's user xattrs and omap IDENTICAL to the given
+        sets (copy-from/rollback are wholesale replacements, never
+        merges): stale head keys absent from the source are removed."""
+        coll = _coll(st.pgid)
+        cur_x = {k[1:] for k in self.store.get_xattrs(coll, oid)
+                 if k.startswith("_")}
+        for name in cur_x - set(xattrs):
+            r = await self._op_write_meta(st, oid, "rmxattr",
+                                          {"name": name})
+            if r < 0:
+                return r
+        for name, value in xattrs.items():
+            r = await self._op_write_meta(st, oid, "setxattr",
+                                          {"name": name, "value": value})
+            if r < 0:
+                return r
+        stale = set(self.store.omap_get(coll, oid)) - set(omap)
+        if stale:
+            r = await self._op_write_meta(st, oid, "omap_rmkeys",
+                                          {"keys": sorted(stale)})
+            if r < 0:
+                return r
+        if omap:
+            r = await self._op_write_meta(st, oid, "omap_set",
+                                          {"kv": omap})
+            if r < 0:
+                return r
+        return 0
 
     def _op_read_meta(self, st: PGState, oid: str, opname: str, args):
         coll = _coll(st.pgid)

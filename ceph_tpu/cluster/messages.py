@@ -40,6 +40,15 @@ class MOSDAlive(Message):
 
 
 @dataclass
+class MLog(Message):
+    """Cluster-log events daemon -> mon (reference MLog,
+    src/messages/MLog.h; entries per src/common/LogEntry.h: who, stamp,
+    priority, message).  The mon's log service Paxos-replicates them."""
+
+    entries: Tuple = ()   # of (who: str, stamp: float, prio: str, msg: str)
+
+
+@dataclass
 class MMonSubscribe(Message):
     what: str = "osdmap"
     addr: Optional[Addr] = None

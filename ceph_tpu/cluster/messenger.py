@@ -194,6 +194,51 @@ class Dispatcher:
         ...
 
 
+class Throttle:
+    """Byte-budget backpressure (reference Throttle bound to the
+    messenger policies, src/ceph_osd.cc:511-525 client-throttler): a
+    reader acquires its frame's bytes before dispatch and releases
+    after; when the budget is exhausted the reader WAITS — it stops
+    draining its socket, so TCP backpressure propagates to the peer
+    instead of the daemon queueing unboundedly."""
+
+    def __init__(self, max_bytes: int):
+        self.max = max_bytes
+        self.cur = 0
+        self.waiting = 0
+        self._cond = asyncio.Condition()
+
+    async def acquire(self, n: int) -> None:
+        n = min(n, self.max)  # a single oversized frame must not wedge
+        async with self._cond:
+            self.waiting += 1
+            try:
+                while self.cur + n > self.max:
+                    await self._cond.wait()
+            finally:
+                self.waiting -= 1
+            self.cur += n
+
+    async def release(self, n: int) -> None:
+        n = min(n, self.max)
+        async with self._cond:
+            self.cur = max(0, self.cur - n)
+            self._cond.notify_all()
+
+
+@dataclass
+class Policy:
+    """Per-peer-type connection policy (reference Messenger::Policy):
+    ``lossy`` sessions do NOT replay their unacked tail across a reset —
+    the send fails and the peer re-requests (stateless client policy;
+    enforced in _reconnect_replay); ``throttle`` bounds bytes
+    concurrently in dispatch from peers of this type (backpressure in
+    _read_loop)."""
+
+    lossy: bool = False
+    throttle: Optional[Throttle] = None
+
+
 SIG_LEN = 16
 
 # frame-type bytes: every frame is <u32 len><type><body>.  Type 0 is a
@@ -283,6 +328,18 @@ class Messenger:
         self._auth_waiters: Dict[int, asyncio.Future] = {}
         self._closing = False
         self.my_addr: Optional[Addr] = None
+        # per-peer-type policies (reference Messenger::set_policy, bound
+        # in ceph_osd.cc:511-525); key None = default
+        self._policies: Dict[Optional[str], Policy] = {}
+
+    def set_policy(self, peer_type: Optional[str], policy: Policy) -> None:
+        """Bind a Policy for connections whose peer entity has ``type``
+        (e.g. 'client', 'osd'); ``None`` sets the default."""
+        self._policies[peer_type] = policy
+
+    def policy_for(self, conn: "Connection") -> Optional[Policy]:
+        ptype = conn.peer.type if conn.peer is not None else None
+        return self._policies.get(ptype, self._policies.get(None))
 
     def add_dispatcher(self, d: Dispatcher) -> None:
         self.dispatchers.append(d)
@@ -353,9 +410,19 @@ class Messenger:
                         await conn.send(_MsgAck(acked=msg.seq))
                     except (ConnectionError, OSError, RuntimeError):
                         pass
-                for d in self.dispatchers:
-                    if await d.ms_dispatch(conn, msg):
-                        break
+                pol = self.policy_for(conn)
+                thr = pol.throttle if pol is not None else None
+                if thr is not None:
+                    # byte-budget backpressure: waiting here stops this
+                    # socket's drain, pushing TCP backpressure to the peer
+                    await thr.acquire(n)
+                try:
+                    for d in self.dispatchers:
+                        if await d.ms_dispatch(conn, msg):
+                            break
+                finally:
+                    if thr is not None:
+                        await thr.release(n)
         except (asyncio.IncompleteReadError, ConnectionError,
                 asyncio.CancelledError):
             # actually CLOSE the socket (not just flag it): a signature
@@ -503,6 +570,16 @@ class Messenger:
             raise ConnectionError(
                 f"session to {addr} lost unacked frames (overflow); "
                 "cannot replay")
+        old_conn = self._out.get(addr)
+        if old_conn is not None:
+            pol = self.policy_for(old_conn)
+            if pol is not None and pol.lossy:
+                # lossy peer policy (reference stateless client policy):
+                # no replay across a reset — drop the unacked tail and
+                # surface the failure so the caller re-requests
+                sess.unacked.clear()
+                raise ConnectionError(
+                    f"lossy session to {addr} reset; not replaying")
         last: Optional[Exception] = None
         for attempt in range(retries):
             old = self._out.pop(addr, None)

@@ -79,6 +79,13 @@ class Monitor(Dispatcher):
         self.perf = PerfCounters("mon")
         self._tick_task: Optional[asyncio.Task] = None
         self._log: List[Tuple[str, object]] = []  # committed proposal log
+        # cluster log (reference LogMonitor, src/mon/LogMonitor.h:39): a
+        # Paxos-replicated event log every quorum member applies in order;
+        # daemons feed it with MLog, the mon's own state changes append
+        # directly, and 'log last' reads it back
+        self.cluster_log: List[Tuple[str, float, str, str]] = []
+        self._pending_clog: List[Tuple[str, float, str, str]] = []
+        self.CLUSTER_LOG_MAX = 10_000
         # recent incrementals by resulting epoch (reference: mon keeps a
         # window of full+inc maps; subscribers behind the window get a full
         # map).  Size mirrors osd_map_cache_size.
@@ -108,6 +115,9 @@ class Monitor(Dispatcher):
                 # resume the committed map (MonitorDBStore refresh)
                 self.osdmap = pickle.loads(blob)
                 self.perf.inc("mon_store_resumes")
+            clog_blob = self.db.get("clog", "recent")
+            if clog_blob is not None:
+                self.cluster_log = pickle.loads(clog_blob)
         addr = await self.messenger.bind(host, port)
         if self.n_mons == 1:
             self._tick_task = asyncio.get_event_loop().create_task(
@@ -283,6 +293,12 @@ class Monitor(Dispatcher):
         self._log.append((what, payload))
         self.perf.inc("mon_proposals")
 
+    def clog(self, prio: str, msg: str) -> None:
+        """Buffer a cluster-log event from this mon (leader side); the
+        tick flushes the buffer through a Paxos round."""
+        self._pending_clog.append(
+            (f"mon.{self.rank}", time.time(), prio, msg))
+
     async def _pool_set_pgnum(self, pid: int, var: str, val):
         """'osd pool set pg_num/pgp_num' (reference OSDMonitor pg_num
         checks + PG splitting on the OSDs).  pg_num may only GROW, and
@@ -328,6 +344,13 @@ class Monitor(Dispatcher):
     async def _apply_inc_local(self, inc: Incremental) -> None:
         """Apply a delta to the replicated map, log it, broadcast it."""
         self.osdmap.apply_incremental(inc)
+        # cluster-log events ride the delta stream: every quorum member
+        # appends the same entries in the same order (LogMonitor refresh)
+        new_clog = getattr(inc, "new_log_entries", ())
+        if new_clog:
+            self.cluster_log.extend(tuple(e) for e in new_clog)
+            del self.cluster_log[:-self.CLUSTER_LOG_MAX]
+            self.perf.inc("mon_clog_entries", len(new_clog))
         self._inc_log[inc.epoch] = inc
         cutoff = inc.epoch - self.config.osd_map_cache_size
         for e in [e for e in self._inc_log if e <= cutoff]:
@@ -341,6 +364,9 @@ class Monitor(Dispatcher):
                    .set("osdmap", "latest", pickle.dumps(self.osdmap)))
             # trim the persisted inc window like the in-memory one
             txn.rmkey("osdmap", f"inc_{cutoff:010d}")
+            if new_clog:
+                txn.set("clog", "recent",
+                        pickle.dumps(self.cluster_log[-1000:]))
             self.db.submit_transaction(txn)
         await self._broadcast_map()
 
@@ -369,6 +395,17 @@ class Monitor(Dispatcher):
                 self.leader_rank = msg.rank
             elif self.paxos:
                 await self.paxos.handle(msg)
+            return True
+        if isinstance(msg, M.MLog):
+            if not self.is_leader:
+                if self.leader_rank is not None and \
+                        self.leader_rank != self.rank:
+                    try:
+                        await self._send_mon(self.leader_rank, msg)
+                    except (ConnectionError, OSError):
+                        pass
+                return True
+            self._pending_clog.extend(tuple(e) for e in msg.entries)
             return True
         if isinstance(msg, (M.MOSDBoot, M.MOSDFailure, M.MOSDAlive)):
             if not self.is_leader:
@@ -492,6 +529,7 @@ class Monitor(Dispatcher):
             self.failure_reports.pop(msg.osd_id, None)
             self.last_beacon[msg.osd_id] = time.monotonic()
             self.perf.inc("mon_osd_boot")
+            self.clog("INF", f"osd.{msg.osd_id} boot")
             await self._commit_inc(inc)
 
     async def _handle_failure(self, msg: M.MOSDFailure) -> None:
@@ -510,8 +548,10 @@ class Monitor(Dispatcher):
                 inc = self._new_inc()
                 inc.new_down.append(osd)
                 self.down_since[osd] = time.monotonic()
-                self.failure_reports.pop(osd, None)
+                nrep = len(self.failure_reports.pop(osd, ()))
                 self.perf.inc("mon_osd_marked_down")
+                self.clog("ERR", f"osd.{osd} failed "
+                                 f"({nrep} reporters) -> marked down")
                 await self._commit_inc(inc)
 
     # commands that mutate cluster state need mon "rw" caps (MonCap)
@@ -744,6 +784,16 @@ class Monitor(Dispatcher):
                 }
             elif prefix == "perf dump":
                 data = self.perf.dump()
+            elif prefix == "log last":
+                # 'ceph log last [n]' (reference LogMonitor command)
+                try:
+                    n = int(cmd.get("num", 20))
+                except (TypeError, ValueError):
+                    n = 20
+                tail = self.cluster_log[-n:] if n > 0 else []
+                data = [
+                    {"who": who, "stamp": stamp, "prio": prio, "msg": m_}
+                    for who, stamp, prio, m_ in tail]
             else:
                 result = -22  # EINVAL
         except Exception as e:  # surface errors to the caller
@@ -803,6 +853,7 @@ class Monitor(Dispatcher):
             pg_num=pg_num, pgp_num=pg_num, crush_rule=ruleno,
             ec_profile=ec_profile, name=name)
         self._propose("pool_create", (pool_id, name))
+        self.clog("INF", f"pool '{name}' created (id {pool_id})")
         self.perf.inc("mon_pool_create")
         return pool_id, inc
 
@@ -923,5 +974,16 @@ class Monitor(Dispatcher):
                         self.down_since[osd] = now
                         self.last_beacon.pop(osd)
                         self.perf.inc("mon_osd_marked_down")
-                if inc.new_weights or inc.new_down:
+                for osd in inc.new_down:
+                    self.clog("WRN", f"osd.{osd} marked down "
+                                     "(beacon grace expired)")
+                for osd in inc.new_weights:
+                    self.clog("WRN", f"osd.{osd} marked out "
+                                     "(down past the out interval)")
+                # flush buffered cluster-log events through Paxos so the
+                # whole quorum (and the persisted store) agree on the log
+                if self._pending_clog:
+                    inc.new_log_entries = tuple(self._pending_clog)
+                    self._pending_clog = []
+                if inc.new_weights or inc.new_down or inc.new_log_entries:
                     await self._commit_inc(inc)

@@ -460,6 +460,37 @@ class IoCtx:
         if reply.result != 0:
             raise IOError(f"zero({oid}) -> {reply.result}")
 
+    async def copy_from(self, dst_oid: str, src_oid: str,
+                        src_pool: Optional[int] = None,
+                        src_snapid: Optional[int] = None) -> int:
+        """Server-side object copy (reference rados_copy /
+        CEPH_OSD_OP_COPY_FROM): the destination primary pulls data,
+        user xattrs, and omap from the source — cross-pool and across
+        pool types — without routing bytes through this client.
+        Returns the copied byte count."""
+        args = {"src_oid": src_oid}
+        if src_pool is not None:
+            args["src_pool"] = src_pool
+        if src_snapid is not None:
+            args["src_snapid"] = src_snapid
+        reply = await self.objecter.op_submit(
+            self.pool_id, dst_oid, [("copy_from", args)],
+            snapc=self._write_snapc())
+        if reply.result != 0:
+            raise IOError(f"copy_from({dst_oid} <- {src_oid}) -> "
+                          f"{reply.result}")
+        return reply.data
+
+    async def rollback(self, oid: str, snapid: int) -> None:
+        """Roll the head back to its state at ``snapid`` (reference
+        rados_ioctx_snap_rollback -> _rollback_to); the current head
+        still COWs into its own clone first."""
+        reply = await self.objecter.op_submit(
+            self.pool_id, oid, [("rollback", {"snapid": snapid})],
+            snapc=self._write_snapc())
+        if reply.result != 0:
+            raise IOError(f"rollback({oid}@{snapid}) -> {reply.result}")
+
     async def create(self, oid: str, exclusive: bool = True) -> None:
         """Exclusive object create (rados_write_op create + EXCL)."""
         reply = await self.objecter.op_submit(

@@ -68,6 +68,17 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
             secret=self.config.auth_secret(),
             auth=self.config.cephx_context(f"osd.{osd_id}"))
         self.messenger.add_dispatcher(self)
+        # reference ceph_osd.cc:511-525 policy binding: clients are lossy
+        # (replies are connection-scoped; the client re-requests) with a
+        # byte throttle so a fast client backpressures instead of burying
+        # the daemon; osd/mon peers stay lossless (session replay)
+        from ceph_tpu.cluster.messenger import Policy, Throttle
+
+        self.messenger.set_policy("client", Policy(
+            lossy=True,
+            throttle=Throttle(self.config.osd_client_message_size_cap)))
+        self.messenger.set_policy("osd", Policy(lossy=False))
+        self.messenger.set_policy("mon", Policy(lossy=False))
         # monmap failover (shared MonClient hunting, cluster/monclient.py)
         from ceph_tpu.cluster.monclient import MonTargeter
 
@@ -83,6 +94,10 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         self._codecs: Dict[int, object] = {}
         self._pending: Dict[Tuple, Tuple[asyncio.Future, List]] = {}
         self._tid = 0
+        # waiters for this OSD's own internal client ops (copy-from, tier
+        # promote/flush): reqid -> future resolved by MOSDOpReply
+        self._internal_inflight: Dict[Tuple, asyncio.Future] = {}
+        self._internal_tid = 0
         self._tasks: List[asyncio.Task] = []
         self._hb_last: Dict[int, float] = {}
         self._reported: Set[int] = set()
@@ -175,6 +190,78 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
     async def _mon_send(self, msg, raise_on_fail: bool = False) -> bool:
         return await self.monc.send(msg, raise_on_fail=raise_on_fail)
 
+    async def internal_op(self, pool_id: int, oid: str, ops,
+                          snapid=None, snapc=None,
+                          timeout: Optional[float] = None):
+        """This OSD acting as a rados client (the reference OSD's own
+        Objecter, used by copy-from and cache tiering): target the
+        object's primary in ``pool_id`` and run an op vector.  Returns
+        the terminal MOSDOpReply."""
+        from ceph_tpu.ops.jenkins import str_hash_rjenkins
+        from ceph_tpu.osdmap.osdmap import ceph_stable_mod
+
+        if timeout is None:
+            timeout = self.config.osd_client_op_timeout + 2.0
+        deadline = asyncio.get_event_loop().time() + timeout
+        while True:
+            m = self.osdmap
+            pool = m.pools.get(pool_id)
+            if pool is None:
+                raise IOError(f"pool {pool_id} gone")
+            seed = ceph_stable_mod(str_hash_rjenkins(oid.encode()),
+                                   pool.pg_num, pool.pg_num_mask)
+            pgid = PGid(pool_id, seed)
+            _, _, _, primary = m.pg_to_up_acting_osds(pgid)
+            addr = m.osd_addrs.get(primary) if primary >= 0 else None
+            if addr is None:
+                if asyncio.get_event_loop().time() > deadline:
+                    raise IOError(f"no primary for {pool_id}:{oid}")
+                await asyncio.sleep(0.1)
+                continue
+            self._internal_tid += 1
+            reqid = (f"osd.{self.osd_id}.int", self._internal_tid)
+            fut = asyncio.get_event_loop().create_future()
+            self._internal_inflight[reqid] = fut
+            try:
+                await self.messenger.send_message(
+                    M.MOSDOp(reqid=reqid, pgid=pgid, oid=oid, ops=ops,
+                             epoch=m.epoch, snapc=snapc, snapid=snapid),
+                    tuple(addr))
+                reply = await asyncio.wait_for(
+                    fut, timeout=max(0.1, deadline -
+                                     asyncio.get_event_loop().time()))
+                if reply.result == -11:  # misdirected: map moved, retry
+                    if asyncio.get_event_loop().time() > deadline:
+                        raise IOError(
+                            f"internal op to {pool_id}:{oid} kept "
+                            "misdirecting past the deadline")
+                    await asyncio.sleep(0.1)
+                    continue
+                return reply
+            except asyncio.TimeoutError:
+                raise IOError(f"internal op to {pool_id}:{oid} timed out")
+            finally:
+                self._internal_inflight.pop(reqid, None)
+
+    def clog(self, prio: str, text: str) -> None:
+        """Fire-and-forget cluster-log event to the mon (reference clog /
+        MLog; the mon's log service Paxos-replicates it)."""
+        import time as _time
+
+        entry = (f"osd.{self.osd_id}", _time.time(), prio, text)
+
+        async def _send():
+            try:
+                await self._mon_send(M.MLog(entries=(entry,)))
+            except Exception:
+                pass
+
+        try:
+            self._tasks.append(
+                asyncio.get_event_loop().create_task(_send()))
+        except RuntimeError:
+            pass  # no running loop (teardown)
+
 
     # ------------------------------------------------------------- dispatch
 
@@ -192,6 +279,13 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
     async def _dispatch(self, conn: Connection, msg) -> bool:
         if isinstance(msg, M.MOSDMapMsg):
             await self._handle_map(msg)
+            return True
+        if isinstance(msg, M.MOSDOpReply):
+            # reply to one of OUR internal client ops (copy-from /
+            # tier traffic): resolve the waiter
+            fut = self._internal_inflight.pop(tuple(msg.reqid), None)
+            if fut is not None and not fut.done():
+                fut.set_result(msg)
             return True
         if isinstance(msg, M.MOSDIncMapMsg):
             await self._handle_inc_map(msg)

@@ -291,6 +291,10 @@ class PGLogMixin:
         # stamp the parent: this collection is now consistent with new_num
         self.store.queue_transaction(Transaction().setattr(
             coll, PGMETA, "split_pgnum", pickle.dumps(new_num)))
+        if children and hasattr(self, "clog"):
+            self.clog("INF", f"pg {st.pgid} split into "
+                             f"{[str(c) for c in children]} "
+                             f"(pg_num {new_num})")
         return children
 
     def _maybe_split(self, pool, st: "PGState") -> bool:

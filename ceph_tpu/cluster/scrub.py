@@ -58,7 +58,16 @@ class ScrubMixin:
         """Primary-driven scrub of one PG; returns
         {"inconsistent": [...], "repaired": [...]}."""
         async with st.lock:
-            return await self._scrub_pg_locked(st)
+            report = await self._scrub_pg_locked(st)
+        if report["inconsistent"]:
+            # cluster-log the scrub result (reference clog error stream)
+            self.clog(
+                "ERR",
+                f"pg {st.pgid} scrub: "
+                f"{len(report['inconsistent'])} inconsistent "
+                f"({len(report['repaired'])} repaired): "
+                f"{report['inconsistent'][:5]}")
+        return report
 
     async def _scrub_pg_locked(self, st: PGState) -> Dict[str, List[str]]:
         pool = self.osdmap.pools[st.pgid.pool]

@@ -136,6 +136,10 @@ class Incremental:
     new_mds_addr: object = None  # active MDS (MDSMap-lite)
     new_revoked: Tuple[str, ...] = ()  # cephx entities to revoke
     old_pools: Tuple[int, ...] = ()    # pool deletions
+    # cluster-log events riding the same Paxos stream (the reference's
+    # LogMonitor is likewise a PaxosService on the shared paxos); the
+    # OSDMap itself ignores them — the mon's log service consumes them
+    new_log_entries: Tuple = ()        # of (who, stamp, prio, msg)
 
 
 class OSDMap:

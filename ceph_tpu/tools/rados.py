@@ -58,24 +58,90 @@ async def _run(args) -> int:
         elif args.cmd == "stat":
             print(args.obj, "size", await io.stat(args.obj))
         elif args.cmd == "bench":
-            secs = args.seconds
-            size = args.block_size
-            blob = b"\xa5" * size
-            n = 0
-            t0 = time.perf_counter()
-            while time.perf_counter() - t0 < secs:
-                await io.write_full(f"bench_{n}", blob)
-                n += 1
-            dt = time.perf_counter() - t0
-            print(f"wrote {n} x {size} B in {dt:.2f}s = "
-                  f"{n * size / dt / 1e6:.1f} MB/s, {n / dt:.1f} iops")
-            for i in range(n):
-                await io.remove(f"bench_{i}")
+            report = await bench(io, args.seconds, args.mode,
+                                 concurrency=args.t,
+                                 block_size=args.block_size,
+                                 cleanup=not args.no_cleanup)
+            print(f"{report['mode']}: {report['ops']} x "
+                  f"{report['block_size']} B in {report['seconds']:.2f}s")
+            print(f"  bandwidth: {report['mbps']:.1f} MB/s   "
+                  f"iops: {report['iops']:.1f}")
+            print(f"  latency ms: avg {report['lat_avg_ms']:.2f}  "
+                  f"p50 {report['lat_p50_ms']:.2f}  "
+                  f"p95 {report['lat_p95_ms']:.2f}  "
+                  f"max {report['lat_max_ms']:.2f}")
         else:
             return 2
         return 0
     finally:
         await client.shutdown()
+
+
+async def bench(io, seconds: float, mode: str = "write",
+                concurrency: int = 16, block_size: int = 65536,
+                cleanup: bool = True) -> dict:
+    """The reference `rados bench` engine (src/tools/rados/rados.cc:103
+    obj_bencher write/seq/rand): `concurrency` in-flight ops for
+    `seconds`, returning bandwidth + latency percentiles.
+
+    write: distinct objects; seq: read the bench objects in written
+    order; rand: uniform random reads over them.  seq/rand write a
+    seeding set first when none exists."""
+    import random
+
+    blob = b"\xa5" * block_size
+    lats: list = []
+    counter = {"n": 0}
+
+    existing: list = []
+    if mode in ("seq", "rand"):
+        existing = [o for o in await io.list_objects()
+                    if o.startswith("bench_")]
+        if not existing:
+            # seed enough objects to read back
+            existing = [f"bench_{i}" for i in range(concurrency * 4)]
+            await asyncio.gather(*(io.write_full(o, blob)
+                                   for o in existing))
+
+    deadline = time.perf_counter() + seconds
+    rng = random.Random(0)
+
+    async def worker(wid: int):
+        i = wid
+        while time.perf_counter() < deadline:
+            t0 = time.perf_counter()
+            if mode == "write":
+                await io.write_full(f"bench_{i}", blob)
+            elif mode == "seq":
+                await io.read(existing[i % len(existing)])
+            else:
+                await io.read(existing[rng.randrange(len(existing))])
+            lats.append(time.perf_counter() - t0)
+            counter["n"] += 1
+            i += concurrency
+
+    t0 = time.perf_counter()
+    await asyncio.gather(*(worker(w) for w in range(concurrency)))
+    dt = max(time.perf_counter() - t0, 1e-9)
+    n = counter["n"]
+    lats.sort()
+
+    def pct(p):
+        return lats[min(len(lats) - 1, int(p * len(lats)))] * 1e3 \
+            if lats else 0.0
+
+    report = {
+        "mode": mode, "ops": n, "block_size": block_size, "seconds": dt,
+        "mbps": n * block_size / dt / 1e6, "iops": n / dt,
+        "lat_avg_ms": (sum(lats) / len(lats) * 1e3) if lats else 0.0,
+        "lat_p50_ms": pct(0.50), "lat_p95_ms": pct(0.95),
+        "lat_max_ms": lats[-1] * 1e3 if lats else 0.0,
+    }
+    if cleanup and mode == "write":
+        names = [o for o in await io.list_objects()
+                 if o.startswith("bench_")]
+        await asyncio.gather(*(io.remove(o) for o in names))
+    return report
 
 
 def parse_args(argv=None):
@@ -91,7 +157,11 @@ def parse_args(argv=None):
     p = sub.add_parser("stat"); p.add_argument("obj")
     p = sub.add_parser("bench")
     p.add_argument("seconds", type=float)
+    p.add_argument("mode", nargs="?", default="write",
+                   choices=("write", "seq", "rand"))
+    p.add_argument("-t", type=int, default=16, help="concurrent ops")
     p.add_argument("--block-size", type=int, default=65536)
+    p.add_argument("--no-cleanup", action="store_true")
     return ap.parse_args(argv)
 
 

@@ -103,6 +103,10 @@ def test_injectargs_via_mon():
     run(scenario())
 
 
+from tests._flaky import contention_retry
+
+
+@contention_retry()
 def test_mgr_receives_perf_streams():
     async def scenario():
         cfg = _fast_config()
