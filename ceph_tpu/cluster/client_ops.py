@@ -122,11 +122,7 @@ class ClientOpsMixin:
     # ops whose effects are not idempotent under at-least-once delivery;
     # a resend must return the cached original reply (reference pg_log
     # dup detection, PGLog dups / osd_pg_log_dups_tracked)
-    _MUTATING_OPS = frozenset({
-        "write_full", "write", "delete", "setxattr", "rmxattr",
-        "omap_set", "omap_rmkeys", "exec",
-        "append", "truncate", "zero", "create",
-        "copy_from", "rollback"})
+    _MUTATING_OPS = M.MUTATING_OPS
     _REQID_DUPS_TRACKED = 3000
     # ops that gate the rest of their vector (CEPH_OSD_OP_CMPXATTR etc.)
     _GUARD_OPS = frozenset({"cmpxattr"})
@@ -210,6 +206,9 @@ class ClientOpsMixin:
             st.reqid_replies[reqid] = sent
             while len(st.reqid_replies) > self._REQID_DUPS_TRACKED:
                 st.reqid_replies.popitem(last=False)
+            if pool.is_tier() and sent and \
+                    getattr(sent[-1], "result", -1) == 0:
+                await self._tier_mark_dirty_after_write(pool, st, msg)
         finally:
             CURRENT_CLIENT_REQID.reset(token)
             st.reqid_inflight.pop(reqid, None)
@@ -272,6 +271,13 @@ class ClientOpsMixin:
 
             self._tasks.append(
                 asyncio.get_event_loop().create_task(_notify_bg()))
+            return
+        # cache-pool admission (promote / proxy / forward /
+        # delete-through).  Runs INSIDE the dedup wrapper so a resent
+        # mutation answers from the reqid cache before it can forward or
+        # delete-through a second time.
+        if pool.is_tier() and await self._tier_intercept(
+                conn, msg, m, pool, st):
             return
         # two-phase, approximating the reference's discard-txn-on-error
         # atomicity: GUARD ops run first (in their vector order), the rest

@@ -39,6 +39,17 @@ class MOSDAlive(Message):
     statfs: Optional[Tuple[int, int]] = None   # (total_bytes, used_bytes)
 
 
+# op verbs that mutate object state — shared by the OSD's dedup/caps
+# logic and the objecter's cache-overlay targeting so the two can never
+# drift (a verb classified differently on the two sides would route
+# writes to the read tier)
+MUTATING_OPS = frozenset({
+    "write_full", "write", "delete", "setxattr", "rmxattr",
+    "omap_set", "omap_rmkeys", "exec",
+    "append", "truncate", "zero", "create",
+    "copy_from", "rollback"})
+
+
 @dataclass
 class MLog(Message):
     """Cluster-log events daemon -> mon (reference MLog,

@@ -299,6 +299,81 @@ class Monitor(Dispatcher):
         self._pending_clog.append(
             (f"mon.{self.rank}", time.time(), prio, msg))
 
+    def _pool_by_name(self, name):
+        return next((p for p, po in self.osdmap.pools.items()
+                     if po.name == name or p == name), None)
+
+    async def _handle_tier_command(self, prefix: str, cmd):
+        """Cache-tier admin (reference OSDMonitor 'osd tier *' handlers):
+        add/remove a cache pool over a base, set the cache mode, and
+        point the base's overlay (read/write redirect) at the cache."""
+        import dataclasses as _dc
+
+        # snapshot + inc construction INSIDE the map mutex like every
+        # other mutation path: two concurrent tier commands must never
+        # commit deltas derived from the same stale pool state
+        async with self._map_mutex:
+            base_id = self._pool_by_name(cmd.get("pool"))
+            if base_id is None:
+                return -2, f"pool {cmd.get('pool')!r} not found"
+            base = self.osdmap.pools[base_id]
+            inc = None
+            if prefix == "osd tier add":
+                tid = self._pool_by_name(cmd.get("tierpool"))
+                if tid is None:
+                    return -2, f"pool {cmd.get('tierpool')!r} not found"
+                if tid == base_id:
+                    return -22, "a pool cannot be its own tier"
+                tier = self.osdmap.pools[tid]
+                if tier.is_tier():
+                    return -22, f"{tier.name} is already a tier"
+                if tier.tiers or base.is_tier():
+                    return -22, "tier chains are not allowed"
+                inc = self._new_inc()
+                inc.new_pools[base_id] = _dc.replace(
+                    base, tiers=tuple(base.tiers) + (tid,))
+                inc.new_pools[tid] = _dc.replace(tier, tier_of=base_id)
+            elif prefix == "osd tier remove":
+                tid = self._pool_by_name(cmd.get("tierpool"))
+                if tid is None or tid not in base.tiers:
+                    return -2, "no such tier"
+                if base.read_tier == tid or base.write_tier == tid:
+                    return -16, ("tier is an active overlay; "
+                                 "remove-overlay first")
+                tier = self.osdmap.pools[tid]
+                inc = self._new_inc()
+                inc.new_pools[base_id] = _dc.replace(
+                    base, tiers=tuple(t for t in base.tiers if t != tid))
+                inc.new_pools[tid] = _dc.replace(tier, tier_of=-1,
+                                                 cache_mode="none")
+            elif prefix == "osd tier cache-mode":
+                # here 'pool' names the CACHE pool
+                mode = cmd.get("mode")
+                if mode not in ("none", "writeback", "readproxy",
+                                "forward"):
+                    return -22, f"invalid cache mode {mode!r}"
+                if not base.is_tier():
+                    return -22, f"{base.name} is not a tier"
+                inc = self._new_inc()
+                inc.new_pools[base_id] = _dc.replace(base,
+                                                     cache_mode=mode)
+            elif prefix == "osd tier set-overlay":
+                tid = self._pool_by_name(cmd.get("overlaypool"))
+                if tid is None or tid not in base.tiers:
+                    return -2, "overlay pool is not a tier of this pool"
+                inc = self._new_inc()
+                inc.new_pools[base_id] = _dc.replace(
+                    base, read_tier=tid, write_tier=tid)
+            elif prefix == "osd tier remove-overlay":
+                inc = self._new_inc()
+                inc.new_pools[base_id] = _dc.replace(
+                    base, read_tier=-1, write_tier=-1)
+            if not await self._commit_inc(inc):
+                return -11, "quorum lost"
+        self.clog("INF", f"tier command '{prefix}' on pool "
+                         f"'{base.name}' applied")
+        return 0, None
+
     async def _pool_set_pgnum(self, pid: int, var: str, val):
         """'osd pool set pg_num/pgp_num' (reference OSDMonitor pg_num
         checks + PG splitting on the OSDs).  pg_num may only GROW, and
@@ -560,7 +635,9 @@ class Monitor(Dispatcher):
         "osd pool mksnap", "osd pool rmsnap",
         "osd pool selfmanaged_snap_create",
         "osd pool selfmanaged_snap_remove", "auth revoke",
-        "osd pool delete", "osd pool rename", "osd pool set"})
+        "osd pool delete", "osd pool rename", "osd pool set",
+        "osd tier add", "osd tier remove", "osd tier cache-mode",
+        "osd tier set-overlay", "osd tier remove-overlay"})
 
     async def _handle_command(self, conn: Connection, msg: M.MMonCommand) -> None:
         cmd = msg.cmd
@@ -581,7 +658,9 @@ class Monitor(Dispatcher):
             "osd pool mksnap", "osd pool rmsnap",
             "osd pool selfmanaged_snap_create",
             "osd pool selfmanaged_snap_remove", "auth revoke",
-            "osd pool delete", "osd pool rename", "osd pool set")
+            "osd pool delete", "osd pool rename", "osd pool set",
+            "osd tier add", "osd tier remove", "osd tier cache-mode",
+            "osd tier set-overlay", "osd tier remove-overlay")
         if mutating and not self.is_leader:
             # forward to the leader, relay its reply (reference
             # Monitor::forward_request_leader)
@@ -663,6 +742,28 @@ class Monitor(Dispatcher):
                 elif var in ("pg_num", "pgp_num"):
                     result, data = await self._pool_set_pgnum(
                         pid, var, val)
+                elif var in ("target_max_objects", "hit_set_count",
+                             "hit_set_period"):
+                    # cache-tier agent/hit-set knobs (reference
+                    # OSDMonitor pool opts)
+                    import dataclasses as _dc
+
+                    caster = float if var == "hit_set_period" else int
+                    try:
+                        tval = caster(val)
+                        if tval < 0:
+                            raise ValueError
+                    except (TypeError, ValueError):
+                        result, data = -22, f"invalid {var}={val!r}"
+                    else:
+                        async with self._map_mutex:
+                            inc = self._new_inc()
+                            inc.new_pools[pid] = _dc.replace(
+                                self.osdmap.pools[pid], **{var: tval})
+                            if not await self._commit_inc(inc):
+                                result, data = -11, "quorum lost"
+                            else:
+                                data = tval
                 elif var not in ("size", "min_size"):
                     result, data = -22, f"cannot set {var!r}"
                 else:
@@ -692,6 +793,10 @@ class Monitor(Dispatcher):
                                 result, data = -11, "quorum lost"
                             else:
                                 data = ival
+            elif prefix in ("osd tier add", "osd tier remove",
+                            "osd tier cache-mode", "osd tier set-overlay",
+                            "osd tier remove-overlay"):
+                result, data = await self._handle_tier_command(prefix, cmd)
             elif prefix == "auth revoke":
                 # refuse future ticket issuance/renewal for the entity
                 # (existing tickets die at their TTL); committed through

@@ -168,6 +168,23 @@ class Objecter(Dispatcher):
 
     # -- op submission with resend-on-map-change ---------------------------
 
+    # write verbs for overlay targeting (shared with the OSD's dedup set)
+    _WRITE_OPS = M.MUTATING_OPS
+
+    def _overlay_pool(self, pool_id: int, ops) -> int:
+        """Cache-tier overlay redirect (reference Objecter::_calc_target,
+        src/osdc/Objecter.cc: target_oloc.pool = read_tier/write_tier):
+        ops against a base pool with an overlay go to the cache pool."""
+        pool = self.osdmap.pools.get(pool_id)
+        if pool is None:
+            return pool_id
+        writes = any(o[0] in self._WRITE_OPS for o in ops)
+        if writes and pool.has_write_tier():
+            return pool.write_tier
+        if not writes and pool.has_read_tier():
+            return pool.read_tier
+        return pool_id
+
     async def op_submit(self, pool_id: int, oid: str,
                         ops: List[Tuple[str, Dict[str, Any]]],
                         timeout: Optional[float] = None,
@@ -179,7 +196,11 @@ class Objecter(Dispatcher):
         backoff = 0.05
         explicit_pgid = pgid
         while True:
-            pgid = explicit_pgid if explicit_pgid is not None                 else self.object_pgid(pool_id, oid)
+            # re-resolve the overlay every attempt: a tier/overlay change
+            # mid-retry must re-target (the redirect is map state)
+            target_pool = self._overlay_pool(pool_id, ops)
+            pgid = explicit_pgid if explicit_pgid is not None \
+                else self.object_pgid(target_pool, oid)
             primary = self._target_osd(pgid)
             addr = self.osdmap.osd_addrs.get(primary) if primary >= 0 else None
             if addr is not None:
@@ -646,6 +667,34 @@ class RadosClient:
 
     async def status(self):
         return await self.objecter.mon_command({"prefix": "status"})
+
+    async def tier_add(self, base: str, cache: str) -> None:
+        """'osd tier add <base> <cache>' (reference OSDMonitor)."""
+        await self.objecter.mon_command({
+            "prefix": "osd tier add", "pool": base, "tierpool": cache})
+        await self.objecter._refresh_map()
+
+    async def tier_remove(self, base: str, cache: str) -> None:
+        await self.objecter.mon_command({
+            "prefix": "osd tier remove", "pool": base, "tierpool": cache})
+        await self.objecter._refresh_map()
+
+    async def tier_cache_mode(self, cache: str, mode: str) -> None:
+        """'osd tier cache-mode <cache> writeback|readproxy|forward|none'."""
+        await self.objecter.mon_command({
+            "prefix": "osd tier cache-mode", "pool": cache, "mode": mode})
+        await self.objecter._refresh_map()
+
+    async def tier_set_overlay(self, base: str, cache: str) -> None:
+        await self.objecter.mon_command({
+            "prefix": "osd tier set-overlay", "pool": base,
+            "overlaypool": cache})
+        await self.objecter._refresh_map()
+
+    async def tier_remove_overlay(self, base: str) -> None:
+        await self.objecter.mon_command({
+            "prefix": "osd tier remove-overlay", "pool": base})
+        await self.objecter._refresh_map()
 
     async def pool_delete(self, name: str, sure: bool = False) -> None:
         """Irreversible; mirrors the reference's name-twice + sure gate."""

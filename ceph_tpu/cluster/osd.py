@@ -35,6 +35,7 @@ from ceph_tpu.ops import crc32c as crcmod
 from ceph_tpu.osdmap.osdmap import OSDMap, PGid, PGPool
 from ceph_tpu.utils import Config, PerfCounters
 from ceph_tpu.cluster.backend_ec import ECBackendMixin
+from ceph_tpu.cluster.tiering import TieringMixin
 from ceph_tpu.cluster.backend_replicated import ReplicatedBackendMixin
 from ceph_tpu.cluster.client_ops import ClientOpsMixin
 from ceph_tpu.cluster.pg import (  # noqa: F401  (re-exported: tools/tests)
@@ -54,7 +55,8 @@ METACOLL = "meta"
 
 
 class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
-                ECBackendMixin, RecoveryMixin, ScrubMixin, Dispatcher):
+                ECBackendMixin, RecoveryMixin, ScrubMixin, TieringMixin,
+                Dispatcher):
     def __init__(self, osd_id: int, mon_addr,
                  config: Optional[Config] = None,
                  store: Optional[ObjectStore] = None):
@@ -146,6 +148,7 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         loop = asyncio.get_event_loop()
         self._tasks.append(loop.create_task(self._heartbeat_loop()))
         self._tasks.append(loop.create_task(self._scrub_loop()))
+        self._tasks.append(loop.create_task(self._tier_agent_loop()))
         if self._opq is not None:
             self._tasks.append(loop.create_task(self._opq_drain()))
         return addr
@@ -192,7 +195,8 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
 
     async def internal_op(self, pool_id: int, oid: str, ops,
                           snapid=None, snapc=None,
-                          timeout: Optional[float] = None):
+                          timeout: Optional[float] = None,
+                          reqid_override: Optional[Tuple] = None):
         """This OSD acting as a rados client (the reference OSD's own
         Objecter, used by copy-from and cache tiering): target the
         object's primary in ``pool_id`` and run an op vector.  Returns
@@ -218,15 +222,54 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                     raise IOError(f"no primary for {pool_id}:{oid}")
                 await asyncio.sleep(0.1)
                 continue
-            self._internal_tid += 1
-            reqid = (f"osd.{self.osd_id}.int", self._internal_tid)
+            if reqid_override is not None:
+                reqid = reqid_override
+            else:
+                self._internal_tid += 1
+                # nonce'd per incarnation like client reqids: a restarted
+                # OSD's counter resets, and a stale reqid colliding with
+                # the target's dup detection would silently skip the op
+                reqid = (f"osd.{self.osd_id}.int#{self.boot_instance}",
+                         self._internal_tid)
+            msg = M.MOSDOp(reqid=reqid, pgid=pgid, oid=oid, ops=ops,
+                           epoch=m.epoch, snapc=snapc, snapid=snapid)
+            if primary == self.osd_id and self._opq is None:
+                # self-targeted: dispatch DIRECTLY instead of messaging
+                # ourselves — a nested internal op would share the outer
+                # op's self-connection, whose read loop is blocked in the
+                # outer dispatch (same-conn serialization deadlock when
+                # e.g. the base and cache primaries coincide).  Under
+                # mclock (queued dispatch) the read loop never blocks, so
+                # normal self-messaging is both safe and required (the
+                # loopback would return before the queued op runs).
+                replies: List = []
+
+                class _LoopConn:
+                    peer = self.messenger.name
+                    peer_caps = None
+
+                    async def send(self, reply):
+                        replies.append(reply)
+
+                msg.src = self.messenger.name
+                await self._handle_client_op(_LoopConn(), msg)
+                reply = next((r for r in reversed(replies)
+                              if isinstance(r, M.MOSDOpReply)), None)
+                if reply is None:
+                    raise IOError(f"internal loopback op on {oid}: "
+                                  "no reply")
+                if reply.result == -11:
+                    if asyncio.get_event_loop().time() > deadline:
+                        raise IOError(
+                            f"internal op to {pool_id}:{oid} kept "
+                            "misdirecting past the deadline")
+                    await asyncio.sleep(0.1)
+                    continue
+                return reply
             fut = asyncio.get_event_loop().create_future()
             self._internal_inflight[reqid] = fut
             try:
-                await self.messenger.send_message(
-                    M.MOSDOp(reqid=reqid, pgid=pgid, oid=oid, ops=ops,
-                             epoch=m.epoch, snapc=snapc, snapid=snapid),
-                    tuple(addr))
+                await self.messenger.send_message(msg, tuple(addr))
                 reply = await asyncio.wait_for(
                     fut, timeout=max(0.1, deadline -
                                      asyncio.get_event_loop().time()))

@@ -239,9 +239,11 @@ class PGLogMixin:
             return ceph_stable_mod(
                 str_hash_rjenkins(head.encode()), new_num, mask)
 
+        from ceph_tpu.cluster.tiering import HITSET_PREFIX
+
         moves: Dict[int, List[str]] = {}
         for name in self.store.list_objects(coll):
-            if name in (PGMETA, PGRB):
+            if name in (PGMETA, PGRB) or name.startswith(HITSET_PREFIX):
                 continue  # pg-internal bookkeeping objects stay put
             seed = child_seed(snapmod.head_of(name))
             if seed != st.pgid.seed:
@@ -350,8 +352,12 @@ class PGLogMixin:
         return pickle.loads(blob) if blob else pglog.ZERO
 
     def _list_pg_objects(self, pgid: PGid) -> List[str]:
-        # PGMETA and the rollback journal are PG bookkeeping, and the
-        # journal is member-LOCAL (each shard's pre-write bytes differ) —
-        # neither may ever be listed, scrubbed, or backfilled as data
+        # PGMETA, the rollback journal, and archived hit sets are PG
+        # bookkeeping; the journal and hit sets are member-LOCAL (each
+        # shard/primary records its own) — none may ever be listed,
+        # scrubbed, or backfilled as data
+        from ceph_tpu.cluster.tiering import HITSET_PREFIX
+
         return [o for o in self.store.list_objects(_coll(pgid))
-                if o not in (PGMETA, PGRB)]
+                if o not in (PGMETA, PGRB)
+                and not o.startswith(HITSET_PREFIX)]

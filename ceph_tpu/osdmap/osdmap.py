@@ -72,6 +72,20 @@ class PGPool:
     snap_seq: int = 0
     snaps: Dict[int, str] = field(default_factory=dict)
     removed_snaps: Tuple[int, ...] = ()
+    # cache tiering (reference pg_pool_t tier fields, osd_types.h:1323-28
+    # + cache_mode_t :1235): ``tiers`` lists cache pools over this base;
+    # ``tier_of`` points a cache pool at its base; read/write_tier are
+    # the objecter overlay redirect targets on the BASE pool
+    tiers: Tuple[int, ...] = ()
+    tier_of: int = -1
+    read_tier: int = -1
+    write_tier: int = -1
+    cache_mode: str = "none"   # none|writeback|readproxy|forward
+    hit_set_count: int = 4
+    hit_set_period: float = 30.0
+    hit_set_fpp: float = 0.05
+    target_max_objects: int = 0   # agent evict trigger (0 = unbounded)
+    cache_target_dirty_ratio: float = 0.4
 
     @property
     def pg_num_mask(self) -> int:
@@ -89,6 +103,15 @@ class PGPool:
 
     def can_shift_osds(self) -> bool:
         return self.type == POOL_TYPE_REPLICATED
+
+    def is_tier(self) -> bool:
+        return self.tier_of >= 0
+
+    def has_read_tier(self) -> bool:
+        return self.read_tier >= 0
+
+    def has_write_tier(self) -> bool:
+        return self.write_tier >= 0
 
     def is_erasure(self) -> bool:
         return self.type == POOL_TYPE_ERASURE

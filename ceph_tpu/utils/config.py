@@ -36,6 +36,8 @@ OPTIONS: List[Option] = [
     Option("osd_pool_default_pg_num", int, 32, min=1),
     Option("osd_recovery_delay_start", float, 0.0),
     Option("osd_client_op_timeout", float, 10.0),
+    Option("osd_tier_agent_interval", float, 1.0,
+           "cache-tier agent flush/evict period (s)"),
     Option("osd_client_message_size_cap", int, 500 * 1024 * 1024,
            "byte budget concurrently in dispatch from clients "
            "(reference osd_client_message_size_cap throttle)"),

@@ -1,0 +1,223 @@
+"""Cache tiering: EC base + replicated cache overlay (VERDICT r4
+missing #1).
+
+Reference seams: PrimaryLogPG maybe_handle_cache / promote_object /
+do_proxy_read (src/osd/PrimaryLogPG.h:904,919-923), TierAgentState
+flush/evict, OSDMonitor 'osd tier *' commands, and the Objecter overlay
+redirect (read_tier/write_tier, osd_types.h:1323-28).
+"""
+
+import asyncio
+
+import pytest
+
+from tests._flaky import contention_retry
+
+from ceph_tpu.cluster.vstart import _fast_config, start_cluster
+from ceph_tpu.cluster.pg import _coll
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _setup(cluster, base_kind="erasure"):
+    client = await cluster.client()
+    if base_kind == "erasure":
+        base = await client.pool_create(
+            "base", "erasure", pg_num=4,
+            ec_profile={"plugin": "jerasure",
+                        "technique": "reed_sol_van",
+                        "k": "2", "m": "1"})
+    else:
+        base = await client.pool_create("base", "replicated",
+                                        pg_num=4, size=2)
+    cache = await client.pool_create("cache", "replicated",
+                                     pg_num=4, size=2)
+    await client.tier_add("base", "cache")
+    await client.tier_cache_mode("cache", "writeback")
+    await client.tier_set_overlay("base", "cache")
+    return client, base, cache
+
+
+def _pool_objects(cluster, pool_id):
+    """Union of client-visible objects across every OSD's collections
+    for a pool."""
+    from ceph_tpu.cluster import snaps as snapmod
+
+    out = set()
+    for osd in cluster.osds.values():
+        for coll in osd.store.list_collections():
+            if not coll.startswith(f"pg_{pool_id}_"):
+                continue
+            for name in osd.store.list_objects(coll):
+                if name.startswith("_") or snapmod.is_snap_key(name):
+                    continue
+                out.add(name)
+    return out
+
+
+@contention_retry()
+def test_writeback_promote_flush_evict():
+    async def scenario():
+        cluster = await start_cluster(3, config=_fast_config())
+        try:
+            client, base, cache = await _setup(cluster)
+            bio = client.ioctx(base)  # ops redirect through the overlay
+
+            # 1. writes land in the CACHE pool (writeback)
+            payload = b"tiered-payload " * 200
+            await bio.write_full("hot", payload)
+            assert await bio.read("hot") == payload
+            assert "hot" in _pool_objects(cluster, cache)
+            assert "hot" not in _pool_objects(cluster, base)
+
+            # 2. the agent flushes the dirty object to the base
+            for _ in range(300):
+                if "hot" in _pool_objects(cluster, base):
+                    break
+                await asyncio.sleep(0.1)
+            assert "hot" in _pool_objects(cluster, base), "never flushed"
+            assert await bio.read("hot") == payload
+
+            # 3. eviction: cap the cache and write enough cold objects
+            await client.pool_set("cache", "target_max_objects", 4)
+            for i in range(12):
+                await bio.write_full(f"cold-{i}", b"c" * 512)
+            for _ in range(400):
+                if len(_pool_objects(cluster, cache)) <= 8:
+                    break
+                await asyncio.sleep(0.1)
+            assert len(_pool_objects(cluster, cache)) <= 8, \
+                _pool_objects(cluster, cache)
+            # every object still reads back (from cache or via promote)
+            for i in range(12):
+                assert await bio.read(f"cold-{i}", timeout=60) \
+                    == b"c" * 512
+
+            # 4. promote-on-read: read an object that was evicted from
+            # the cache — it must come back via promotion and land there
+            evicted = sorted(
+                _pool_objects(cluster, base) -
+                _pool_objects(cluster, cache))
+            if evicted:
+                target = evicted[0]
+                assert await bio.read(target, timeout=60) is not None
+                assert target in _pool_objects(cluster, cache), \
+                    "read miss did not promote"
+
+            # 5. delete-through: removing via the overlay removes BOTH
+            await bio.remove("hot")
+            with pytest.raises((IOError, FileNotFoundError)):
+                await bio.read("hot", timeout=15)
+            await asyncio.sleep(0.5)
+            assert "hot" not in _pool_objects(cluster, base)
+            assert "hot" not in _pool_objects(cluster, cache)
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+@contention_retry()
+def test_readproxy_and_forward_modes():
+    async def scenario():
+        cluster = await start_cluster(3, config=_fast_config())
+        try:
+            client, base, cache = await _setup(cluster)
+            bio = client.ioctx(base)
+            await bio.write_full("obj", b"payload-1")
+            # flush it to the base, then drop the cache copy via drain
+            await client.tier_cache_mode("cache", "forward")
+            for _ in range(300):
+                if "obj" in _pool_objects(cluster, base) and \
+                        "obj" not in _pool_objects(cluster, cache):
+                    break
+                await asyncio.sleep(0.1)
+            assert "obj" in _pool_objects(cluster, base)
+            assert "obj" not in _pool_objects(cluster, cache)
+            # forward mode: reads work, nothing re-enters the cache
+            assert await bio.read("obj") == b"payload-1"
+            assert "obj" not in _pool_objects(cluster, cache)
+
+            # readproxy: reads proxy to the base WITHOUT promoting;
+            # writes still land in the cache
+            await client.tier_cache_mode("cache", "readproxy")
+            assert await bio.read("obj") == b"payload-1"
+            assert "obj" not in _pool_objects(cluster, cache)
+            await bio.write_full("obj2", b"payload-2")
+            assert "obj2" in _pool_objects(cluster, cache)
+            assert await bio.read("obj2") == b"payload-2"
+
+            # remove-overlay: traffic goes straight to the base again
+            await client.tier_remove_overlay("base")
+            assert await bio.read("obj") == b"payload-1"
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+@contention_retry()
+def test_tiering_survives_cache_primary_kill():
+    """Thrash: dirty objects in the cache survive a cache-primary kill —
+    the replicated dirty flag lets the new primary flush them."""
+    async def scenario():
+        cluster = await start_cluster(3, config=_fast_config())
+        try:
+            client, base, cache = await _setup(cluster)
+            bio = client.ioctx(base)
+            payloads = {f"o{i}": (b"D%d" % i) * 300 for i in range(6)}
+            for k, v in payloads.items():
+                await bio.write_full(k, v)
+            # kill one OSD serving the cache pool
+            pgid = client.objecter.object_pgid(cache, "o0")
+            _, _, acting, primary = \
+                client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+            await cluster.osds[primary].stop()
+            # everything still reads back and eventually flushes
+            for k, v in payloads.items():
+                assert await bio.read(k, timeout=90) == v, k
+            for _ in range(600):
+                if all(k in _pool_objects(cluster, base)
+                       for k in payloads):
+                    break
+                await asyncio.sleep(0.1)
+            assert all(k in _pool_objects(cluster, base)
+                       for k in payloads), "flush stalled after kill"
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_tier_command_validation():
+    async def scenario():
+        cluster = await start_cluster(2, config=_fast_config())
+        try:
+            client = await cluster.client()
+            await client.pool_create("b1", "replicated", pg_num=4, size=2)
+            await client.pool_create("c1", "replicated", pg_num=4, size=2)
+            await client.pool_create("c2", "replicated", pg_num=4, size=2)
+            await client.tier_add("b1", "c1")
+            # a tier cannot itself get a tier; a pool can't tier twice
+            with pytest.raises(RuntimeError):
+                await client.tier_add("c1", "c2")
+            with pytest.raises(RuntimeError):
+                await client.tier_add("b1", "c1")
+            # overlay must be a registered tier
+            with pytest.raises(RuntimeError):
+                await client.tier_set_overlay("b1", "c2")
+            await client.tier_set_overlay("b1", "c1")
+            # cannot remove an active overlay tier
+            with pytest.raises(RuntimeError):
+                await client.tier_remove("b1", "c1")
+            await client.tier_remove_overlay("b1")
+            await client.tier_remove("b1", "c1")
+            p = client.objecter.osdmap.pools
+            assert all(not po.is_tier() and not po.tiers
+                       for po in p.values())
+        finally:
+            await cluster.stop()
+
+    run(scenario())
