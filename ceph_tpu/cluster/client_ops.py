@@ -403,11 +403,13 @@ class ClientOpsMixin:
             return self._op_read_meta(st, moid, opname, args)
         if opname in ("setxattr", "rmxattr", "omap_set", "omap_rmkeys"):
             async with st.lock:
-                r = await self._op_write_meta(st, msg.oid, opname, args)
+                r = await self._op_write_meta(st, msg.oid, opname, args,
+                                              snapc=msg.snapc, pool=pool)
             return r, None
         if opname == "exec":
             async with st.lock:
-                return await self._op_exec(st, msg.oid, args)
+                return await self._op_exec(st, msg.oid, args,
+                                           snapc=msg.snapc, pool=pool)
         if opname == "watch":
             self._watchers.setdefault((st.pgid, msg.oid), {})[
                 (str(msg.src), args["cookie"])] = conn
@@ -529,12 +531,20 @@ class ClientOpsMixin:
         return -95, None
 
     async def _op_write_meta(self, st: PGState, oid: str, opname: str,
-                             args) -> int:
+                             args, snapc=None, pool=None) -> int:
         """Metadata mutations ride the same logged+replicated transaction
         path as data writes (reference do_osd_ops xattr/omap cases write
-        into the op's transaction, PrimaryLogPG.cc:4917)."""
+        into the op's transaction, PrimaryLogPG.cc:4917).  ``snapc``
+        clone-on-writes the object first like data mutations do — omap
+        and xattr state snapshot with the object (the CephFS dirfrag
+        snapshots ride this)."""
         coll = _coll(st.pgid)
-        txn = Transaction().touch(coll, oid)
+        txn = Transaction()
+        if snapc is not None:
+            txn.ops.extend(self._cow_pre_ops(
+                st, oid, snapc,
+                erasure=bool(pool is not None and pool.is_erasure())))
+        txn.touch(coll, oid)
         if opname == "setxattr":
             txn.setattr(coll, oid, "_" + args["name"], args["value"])
         elif opname == "rmxattr":
@@ -547,16 +557,25 @@ class ClientOpsMixin:
         txn.set_version(coll, oid, version[1])
         return await self._replicate_txn(st, txn, "modify", oid, version)
 
-    async def _op_exec(self, st: PGState, oid: str, args):
+    async def _op_exec(self, st: PGState, oid: str, args, snapc=None,
+                       pool=None):
         """Object-class execution (reference do_osd_ops CEPH_OSD_OP_CALL):
         the method's reads hit the store, its writes collect into a txn
-        that commits + replicates atomically with the op."""
+        that commits + replicates atomically with the op.  ``snapc``
+        clone-on-writes first, so cls-mutated state (dirfrags, bucket
+        indexes) snapshots like plain data."""
         from ceph_tpu.cluster.objclass import (
             ClassRegistry, ClsError, MethodContext,
         )
 
         coll = _coll(st.pgid)
-        txn = Transaction().touch(coll, oid)
+        txn = Transaction()
+        if snapc is not None:
+            txn.ops.extend(self._cow_pre_ops(
+                st, oid, snapc,
+                erasure=bool(pool is not None and pool.is_erasure())))
+        txn.touch(coll, oid)
+        base_ops = len(txn.ops)
         ctx = MethodContext(self.store, coll, oid, txn)
         try:
             out = ClassRegistry.instance().call(
@@ -564,7 +583,7 @@ class ClientOpsMixin:
         except ClsError as e:
             return e.errno, str(e)
         self.perf.inc("osd_cls_calls")
-        if len(txn.ops) > 1:  # beyond the touch: mutations to commit
+        if len(txn.ops) > base_ops:  # method added mutations to commit
             version = self._next_version(st)
             txn.set_version(coll, oid, version[1])
             r = await self._replicate_txn(st, txn, "modify", oid, version)

@@ -74,15 +74,18 @@ class FileSystem:
         out = await self.meta.execute("meta.next_ino", "inotable", "alloc")
         return int(out)
 
-    async def _lookup_dir(self, path: str) -> Tuple[int, str]:
+    async def _lookup_dir(self, path: str,
+                          snapid: Optional[int] = None) -> Tuple[int, str]:
         """Resolve the parent directory of ``path``; returns
-        (parent_ino, leaf_name)."""
+        (parent_ino, leaf_name).  ``snapid`` walks the dirfrags as they
+        were at that (meta-pool) snapshot — the CephFS .snap read path."""
         parts = [p for p in path.split("/") if p]
         if not parts:
             raise IsADirectoryError("/")
         ino = ROOT_INO
         for name in parts[:-1]:
-            entries = await self.meta.omap_get(self._dir_oid(ino))
+            entries = await self.meta.omap_get(self._dir_oid(ino),
+                                               snapid=snapid)
             blob = entries.get(name)
             if blob is None:
                 raise FileNotFoundError(f"{name} in {path}")
@@ -92,20 +95,24 @@ class FileSystem:
             ino = inode.ino
         return ino, parts[-1]
 
-    async def _resolve(self, path: str) -> Tuple[int, str, Inode]:
+    async def _resolve(self, path: str,
+                       snapid: Optional[int] = None
+                       ) -> Tuple[int, str, Inode]:
         """ONE walk: (parent_ino, leaf, inode) — callers must not re-walk
         (each component costs an omap round trip)."""
-        parent, leaf = await self._lookup_dir(path)
-        entries = await self.meta.omap_get(self._dir_oid(parent))
+        parent, leaf = await self._lookup_dir(path, snapid=snapid)
+        entries = await self.meta.omap_get(self._dir_oid(parent),
+                                           snapid=snapid)
         blob = entries.get(leaf)
         if blob is None:
             raise FileNotFoundError(path)
         return parent, leaf, pickle.loads(blob)
 
-    async def _get(self, path: str) -> Inode:
+    async def _get(self, path: str,
+                   snapid: Optional[int] = None) -> Inode:
         if path.strip("/") == "":
             return Inode(ROOT_INO, "dir")
-        return (await self._resolve(path))[2]
+        return (await self._resolve(path, snapid=snapid))[2]
 
     async def _set_dentry(self, parent: int, name: str,
                           inode: Inode) -> None:
@@ -163,14 +170,17 @@ class FileSystem:
         await self._link_dentry(parent, leaf, inode, path)
         return ino
 
-    async def listdir(self, path: str = "/") -> List[str]:
-        inode = await self._get(path)
+    async def listdir(self, path: str = "/",
+                      snapid: Optional[int] = None) -> List[str]:
+        inode = await self._get(path, snapid=snapid)
         if inode.mode != "dir":
             raise NotADirectoryError(path)
-        return sorted(await self.meta.omap_get(self._dir_oid(inode.ino)))
+        return sorted(await self.meta.omap_get(self._dir_oid(inode.ino),
+                                               snapid=snapid))
 
-    async def stat(self, path: str) -> Inode:
-        return await self._get(path)
+    async def stat(self, path: str,
+                   snapid: Optional[int] = None) -> Inode:
+        return await self._get(path, snapid=snapid)
 
     async def unlink(self, path: str) -> None:
         parent, leaf, inode = await self._resolve(path)

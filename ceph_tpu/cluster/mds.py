@@ -13,11 +13,13 @@ client may cache a lookup until the lease expires; every mutation goes
 to the MDS, so two clients always observe a single serialized order).
 
 An MDS restart REPLAYS unapplied journal events before serving
-(MDSRank::boot_start replay stage).  The active MDS address rides the
-cluster map via beacons (MDSMap-lite, like the mgr's registration).
+(MDSRank::boot_start replay stage).  Active MDS addresses ride the
+cluster map via rank-tagged beacons (MDSMap-lite).
 
-Not implemented (documented): multi-active subtree partitioning
-(Migrator.h:52) — single active MDS, standby takeover by restart.
+Round 5: MULTI-ACTIVE subtree partitioning (the Migrator analog — see
+the subtree-authority section) and fs SNAPSHOTS (SnapServer-lite: the
+.snap pseudo-paths over pool-level selfmanaged COW, metadata included
+via the dirfrag exec/omap SnapContext seam).
 """
 
 from __future__ import annotations
@@ -39,7 +41,8 @@ from ceph_tpu.cluster.messenger import (
 )
 from ceph_tpu.utils import Config, PerfCounters
 
-JOURNAL_OID = "mds_journal.0"
+JOURNAL_OID = "mds_journal.0"   # rank 0 (kept name: store compat)
+SUBTREE_OID = "mds_subtrees"    # omap {dir path: owner rank} (auth table)
 
 
 @dataclass
@@ -59,6 +62,8 @@ class MClientReply(M.Message):
     data: object = None
     error: str = ""
     lease_ttl: float = 0.0            # read-cacheable until now+ttl
+    snapc: Optional[Tuple] = None     # data-pool write context (stat)
+    snapid: Optional[int] = None      # data-pool read snap (.snap stat)
 
 
 @dataclass
@@ -66,10 +71,32 @@ class MMDSBeacon(M.Message):
     """MDS -> mon registration (reference MMDSBeacon)."""
 
     addr: Optional[Tuple] = None
+    rank: int = 0
+
+
+def norm_path(path: str) -> str:
+    return "/" + "/".join(p for p in str(path).split("/") if p)
+
+
+def owner_rank(subtrees: Dict[str, int], path: str) -> int:
+    """Longest-prefix subtree authority lookup — ONE implementation
+    shared by daemon routing and client targeting, so the two can never
+    disagree (component-boundary aware)."""
+    path = norm_path(path)
+    best, best_len = 0, -1
+    for prefix, rank in subtrees.items():
+        if prefix == "/" or path == prefix or \
+                path.startswith(prefix + "/"):
+            if len(prefix) > best_len:
+                best, best_len = rank, len(prefix)
+    return best
 
 
 # journal ops that mutate dirfrag state (everything except pure reads)
 _MUTATING = {"mkdir", "create", "unlink", "rename", "set_size"}
+# ops routed by subtree authority (args[0] is always the primary path)
+_ROUTED = _MUTATING | {"stat", "listdir", "snap_create", "snap_rm",
+                       "export_dir"}
 
 
 class MDSDaemon(Dispatcher):
@@ -109,18 +136,164 @@ class MDSDaemon(Dispatcher):
         self._client = RadosClient(self.mon_addr, name=f"mds{self.rank}",
                                    config=self.config)
         await self._client.connect()
-        meta_io = self._client.ioctx(self.meta_pool)
-        data_io = self._client.ioctx(self.data_pool)
+        # HOLD these instances: ioctx() mints a fresh IoCtx per call,
+        # and the snapshot SnapContexts install onto these exact objects
+        self._meta_io = self._client.ioctx(self.meta_pool)
+        self._data_io = self._client.ioctx(self.data_pool)
+        meta_io, data_io = self._meta_io, self._data_io
         self.fs = FileSystem(meta_io, data_io)
         try:
             await self.fs.stat("/")
         except FileNotFoundError:
             await self.fs.mkfs()
+        await self._load_subtrees(create=(self.rank == 0))
+        await self._load_snaptable()
         await self._replay_journal()
         await self._beacon()
         loop = asyncio.get_event_loop()
         self._tasks.append(loop.create_task(self._beacon_loop()))
         return addr
+
+    # -- subtree authority (Migrator analog) --------------------------------
+    #
+    # Reference src/mds/Migrator.h:52: multi-active MDS partitions the
+    # namespace into subtrees, each owned by one rank; export_dir moves
+    # authority.  In this framework EVERY dirfrag lives in shared RADOS,
+    # so "migration" is an authority-table flip (one atomic omap write) —
+    # no cache or journal segments travel, and the per-op WRITE-AHEAD
+    # journal means there is no unflushed state to hand over.  Requests
+    # that land on the wrong rank bounce with ESTALE + the owner hint and
+    # the client retargets (the reference's forward-to-auth).
+
+    async def _load_subtrees(self, create: bool = False) -> None:
+        io = self._meta_io
+        try:
+            om = await io.omap_get(SUBTREE_OID)
+        except (FileNotFoundError, IOError):
+            om = {}
+        if not om:
+            if create:
+                await io.write_full(SUBTREE_OID, b"")
+                await io.omap_set(SUBTREE_OID, {"/": b"0"})
+            om = {"/": b"0"}
+        self.subtrees = {p: int(r) for p, r in om.items()}
+
+    @staticmethod
+    def _norm(path: str) -> str:
+        return norm_path(path)
+
+    def _owner_rank(self, path: str) -> int:
+        return owner_rank(self.subtrees, path)
+
+    async def _export_dir(self, path: str, target: int) -> None:
+        """Move subtree authority (Migrator::export_dir): one atomic
+        authority-table write; the journal is already flushed per-op."""
+        path = self._norm(path)
+        io = self._meta_io
+        await self.fs.stat(path)  # must exist (and be resolvable)
+        await io.omap_set(SUBTREE_OID, {path: str(target).encode()})
+        await self._load_subtrees()
+        self.perf.inc("mds_exports")
+
+    # -- snapshots (SnapServer/SnapRealm-lite) ------------------------------
+    #
+    # Reference src/mds/SnapServer.h snaptable + snaprealms: a snapshot
+    # of directory D freezes D's subtree.  Here both pools already COW
+    # under selfmanaged SnapContexts (dirfrag omaps included, via the
+    # exec/omap snapc seam), so an fs snapshot = allocate one snapid in
+    # each pool, record (name -> ids, dir) in the snaptable object, and
+    # extend every MDS's write SnapContext.  The realm is GLOBAL (one
+    # context covers the whole fs — objects outside the snapped dir may
+    # grow clones if modified, which costs space, never correctness);
+    # .snap path reads resolve with the recorded ids.
+
+    SNAPTABLE_OID = "mds_snaptable"
+
+    async def _load_snaptable(self) -> None:
+        io = self._meta_io
+        try:
+            om = await io.omap_get(self.SNAPTABLE_OID)
+        except (FileNotFoundError, IOError):
+            om = {}
+        self.snaptable = {name: pickle.loads(blob)
+                          for name, blob in om.items()}
+        self._install_snapc()
+
+    def _install_snapc(self) -> None:
+        metas = sorted((v["meta_id"] for v in self.snaptable.values()),
+                       reverse=True)
+        datas = sorted((v["data_id"] for v in self.snaptable.values()),
+                       reverse=True)
+        self._meta_io.set_snap_context(metas[0] if metas else 0,
+                                       metas)
+        self._data_io.set_snap_context(datas[0] if datas else 0, datas)
+
+    def _data_snapc(self) -> Tuple[int, Tuple[int, ...]]:
+        datas = tuple(sorted((v["data_id"]
+                              for v in self.snaptable.values()),
+                             reverse=True))
+        return (datas[0] if datas else 0, datas)
+
+    async def _snap_create(self, dirpath: str, name: str) -> int:
+        dirpath = self._norm(dirpath)
+        ino = await self.fs.stat(dirpath)
+        if ino.mode != "dir":
+            raise NotADirectoryError(dirpath)
+        if name in self.snaptable:
+            raise FileExistsError(f"{dirpath}/.snap/{name}")
+        meta_id = await self._meta_io.selfmanaged_snap_create()
+        data_id = await self._data_io.selfmanaged_snap_create()
+        rec = {"dir": dirpath, "meta_id": meta_id, "data_id": data_id,
+               "stamp": time.time()}
+        io = self._meta_io
+        try:
+            await io.stat(self.SNAPTABLE_OID)
+        except FileNotFoundError:
+            await io.write_full(self.SNAPTABLE_OID, b"")
+        await io.omap_set(self.SNAPTABLE_OID, {name: pickle.dumps(rec)})
+        await self._load_snaptable()
+        # lease barrier: clients cache stat replies (and the data snapc
+        # they carry) up to lease_ttl, and OTHER active ranks only adopt
+        # the new snaptable on their beacon tick — by the time we reply,
+        # every rank has refreshed AND every lease it issued pre-refresh
+        # has expired, so no write can miss the new COW context (the
+        # reference revokes caps; we wait them out)
+        await asyncio.sleep(self.lease_ttl +
+                            self.config.mds_beacon_interval)
+        return data_id
+
+    async def _snap_rm(self, dirpath: str, name: str) -> None:
+        rec = self.snaptable.get(name)
+        if rec is None or rec["dir"] != self._norm(dirpath):
+            raise FileNotFoundError(f"{dirpath}/.snap/{name}")
+        io = self._meta_io
+        await io.omap_rmkeys(self.SNAPTABLE_OID, [name])
+        try:
+            await self._meta_io.selfmanaged_snap_remove(rec["meta_id"])
+            await self._data_io.selfmanaged_snap_remove(rec["data_id"])
+        except Exception:
+            pass  # trimming is advisory; the table entry is gone
+        await self._load_snaptable()
+
+    def _split_snap_path(self, path: str):
+        """'/d/.snap/name[/rest]' -> (live '/d[/rest]', snap record) or
+        (path, None)."""
+        parts = [p for p in path.split("/") if p]
+        if ".snap" not in parts:
+            return self._norm(path), None
+        i = parts.index(".snap")
+        if i + 1 >= len(parts):
+            return self._norm(path), "LIST"   # '/d/.snap' itself
+        name = parts[i + 1]
+        rec = self.snaptable.get(name)
+        if rec is None:
+            raise FileNotFoundError(path)
+        base = self._norm("/" + "/".join(parts[:i]))
+        d = rec["dir"]
+        if base != d and not (d == "/" or base.startswith(d + "/")):
+            raise FileNotFoundError(path)
+        live = "/" + "/".join(parts[:i] + parts[i + 2:])
+        return self._norm(live), rec
 
     async def stop(self) -> None:
         self._stopped = True
@@ -133,7 +306,8 @@ class MDSDaemon(Dispatcher):
     async def _beacon(self) -> None:
         try:
             await self.messenger.send_message(
-                MMDSBeacon(addr=self.messenger.my_addr), self.mon_addr)
+                MMDSBeacon(addr=self.messenger.my_addr, rank=self.rank),
+                self.mon_addr)
         except (ConnectionError, OSError):
             pass
 
@@ -141,38 +315,50 @@ class MDSDaemon(Dispatcher):
         while not self._stopped:
             await asyncio.sleep(self.config.mds_beacon_interval)
             await self._beacon()
+            # converge shared tables across ranks (subtree authority +
+            # snap contexts); cheap omap reads
+            try:
+                await self._load_subtrees()
+                await self._load_snaptable()
+            except Exception:
+                pass
 
     # -- journal (MDLog analog) --------------------------------------------
+
+    @property
+    def _journal_oid(self) -> str:
+        # per-rank journals (reference: each MDSRank owns its own MDLog)
+        return f"mds_journal.{self.rank}"
 
     async def _journal_append(self, seq: int, event: Tuple) -> None:
         """WRITE-AHEAD: the event lands in the journal before any
         dirfrag mutation (journal.cc: EUpdate logged before apply)."""
-        io = self._client.ioctx(self.meta_pool)
-        await io.omap_set(JOURNAL_OID,
+        io = self._meta_io
+        await io.omap_set(self._journal_oid,
                           {f"{seq:016d}": pickle.dumps(event)})
 
     async def _journal_commit(self, seq: int) -> None:
         """Advance applied-through and TRIM the applied events (MDLog
         segment expiry): the journal holds only the unapplied tail, so
         restart replay is O(tail), not O(all ops ever)."""
-        io = self._client.ioctx(self.meta_pool)
-        await io.setxattr(JOURNAL_OID, "applied", str(seq).encode())
+        io = self._meta_io
+        await io.setxattr(self._journal_oid, "applied", str(seq).encode())
         try:
-            events = await io.omap_get(JOURNAL_OID)
+            events = await io.omap_get(self._journal_oid)
             dead = [k for k in events if int(k) <= seq]
             if dead:
-                await io.omap_rmkeys(JOURNAL_OID, dead)
+                await io.omap_rmkeys(self._journal_oid, dead)
         except (IOError, FileNotFoundError):
             pass
 
     async def _journal_state(self) -> Tuple[int, Dict[str, bytes]]:
-        io = self._client.ioctx(self.meta_pool)
+        io = self._meta_io
         try:
-            events = await io.omap_get(JOURNAL_OID)
+            events = await io.omap_get(self._journal_oid)
         except (IOError, FileNotFoundError):
             events = {}
         try:
-            applied = int(await io.getxattr(JOURNAL_OID, "applied"))
+            applied = int(await io.getxattr(self._journal_oid, "applied"))
         except (KeyError, IOError, FileNotFoundError, ValueError):
             applied = 0
         return applied, events
@@ -220,6 +406,32 @@ class MDSDaemon(Dispatcher):
         self.perf.inc("mds_requests")
         dup_key = (msg.client, msg.tid)
         try:
+            # subtree authority routing (the reference forwards to auth;
+            # we bounce with ESTALE + owner hint and the client retargets)
+            if msg.op in _ROUTED and msg.args:
+                path = str(msg.args[0])
+                live, _snap = (path, None)
+                if ".snap" in path:
+                    live, _snap = self._split_snap_path(path)
+                owner = self._owner_rank(live)
+                if owner != self.rank:
+                    await self._load_subtrees()  # maybe stale: re-check
+                    owner = self._owner_rank(live)
+                if owner != self.rank:
+                    await conn.send(MClientReply(
+                        tid=msg.tid, result=-116, error=str(owner)))
+                    self.perf.inc("mds_bounced")
+                    return True
+            if msg.op == "rename":
+                if self._owner_rank(msg.args[0]) != \
+                        self._owner_rank(msg.args[1]):
+                    # cross-subtree rename needs multi-MDS transactions
+                    # (reference slave requests); refused like early
+                    # multi-active — copy+unlink instead
+                    await conn.send(MClientReply(
+                        tid=msg.tid, result=-18,
+                        error="cross-subtree rename"))
+                    return True
             if msg.op in _MUTATING:
                 async with self._lock:     # the MDS serialization point
                     cached = self._completed.get(dup_key)
@@ -234,14 +446,51 @@ class MDSDaemon(Dispatcher):
                     await self._journal_commit(seq)
                 reply = MClientReply(tid=msg.tid, result=0, data=data)
             elif msg.op == "stat":
-                ino = await self.fs.stat(msg.args[0])
-                reply = MClientReply(tid=msg.tid, result=0,
-                                     data=pickle.dumps(ino),
-                                     lease_ttl=self.lease_ttl)
+                live, rec = self._split_snap_path(str(msg.args[0]))
+                if rec == "LIST":
+                    raise FileNotFoundError(msg.args[0])
+                snapid = rec["meta_id"] if rec else None
+                ino = await self.fs.stat(live, snapid=snapid)
+                reply = MClientReply(
+                    tid=msg.tid, result=0, data=pickle.dumps(ino),
+                    lease_ttl=self.lease_ttl,
+                    snapc=self._data_snapc(),
+                    snapid=rec["data_id"] if rec else None)
             elif msg.op == "listdir":
-                names = await self.fs.listdir(msg.args[0])
+                live, rec = self._split_snap_path(str(msg.args[0]))
+                if rec == "LIST":
+                    # '/d/.snap': the dir's snapshot names
+                    base = self._norm(live[: -len("/.snap")]
+                                      if live.endswith("/.snap") else live)
+                    names = sorted(n for n, r in self.snaptable.items()
+                                   if r["dir"] == base)
+                else:
+                    names = await self.fs.listdir(
+                        live, snapid=rec["meta_id"] if rec else None)
                 reply = MClientReply(tid=msg.tid, result=0, data=names,
                                      lease_ttl=self.lease_ttl)
+            elif msg.op in ("snap_create", "snap_rm", "export_dir"):
+                # durable admin mutations: dup-cached like journal ops,
+                # so a retry after a lost reply gets the ORIGINAL answer
+                # instead of a spurious EEXIST/ENOENT
+                async with self._lock:
+                    cached = self._completed.get(dup_key)
+                    if cached is not None:
+                        self.perf.inc("mds_dup_requests")
+                        await conn.send(cached)
+                        return True
+                    if msg.op == "snap_create":
+                        data = await self._snap_create(msg.args[0],
+                                                       msg.args[1])
+                        reply = MClientReply(tid=msg.tid, result=0,
+                                             data=data)
+                    elif msg.op == "snap_rm":
+                        await self._snap_rm(msg.args[0], msg.args[1])
+                        reply = MClientReply(tid=msg.tid, result=0)
+                    else:
+                        await self._export_dir(msg.args[0],
+                                               int(msg.args[1]))
+                        reply = MClientReply(tid=msg.tid, result=0)
             else:
                 reply = MClientReply(tid=msg.tid, result=-95,
                                      error=f"bad op {msg.op}")
@@ -254,7 +503,8 @@ class MDSDaemon(Dispatcher):
         except Exception as e:
             self.perf.inc("mds_errors")
             reply = MClientReply(tid=msg.tid, result=-5, error=repr(e))
-        if msg.op in _MUTATING:
+        if msg.op in _MUTATING or msg.op in ("snap_create", "snap_rm",
+                                             "export_dir"):
             self._completed[dup_key] = reply
             while len(self._completed) > 3000:
                 self._completed.popitem(last=False)
@@ -272,23 +522,43 @@ class MDSClient:
     stat/listdir replies carry a read lease — cached until expiry, so
     repeated lookups don't round-trip (Locker caps-lite)."""
 
-    def __init__(self, rados_client, data_pool: int):
+    def __init__(self, rados_client, data_pool: int,
+                 meta_pool: Optional[int] = None):
         self.client = rados_client
         self.objecter = rados_client.objecter
         self.data_io = rados_client.ioctx(data_pool)
+        self.meta_io = rados_client.ioctx(meta_pool) \
+            if meta_pool is not None else None
         self._tid = 0
         self._lease: Dict[Tuple, Tuple[float, object]] = {}
+        self._subtrees: Dict[str, int] = {"/": 0}
 
-    def _mds_addr(self):
-        addr = getattr(self.objecter.osdmap, "mds_addr", None)
+    def _mds_addr(self, rank: int = 0):
+        addrs = getattr(self.objecter.osdmap, "mds_addrs", None) or {}
+        addr = addrs.get(rank)
+        if addr is None and rank == 0:
+            addr = getattr(self.objecter.osdmap, "mds_addr", None)
         if addr is None:
-            raise ConnectionError("no active MDS in the cluster map")
+            raise ConnectionError(f"no active MDS rank {rank} in the map")
         return tuple(addr)
+
+    def _owner_rank(self, path: str) -> int:
+        return owner_rank(self._subtrees, path)
+
+    async def _refresh_subtrees(self) -> None:
+        if self.meta_io is None:
+            return
+        try:
+            om = await self.meta_io.omap_get("mds_subtrees")
+            self._subtrees = {p: int(r) for p, r in om.items()}
+        except (FileNotFoundError, IOError):
+            pass
 
     async def _call(self, op: str, *args, timeout: float = 30.0):
         self._tid += 1
         tid = self._tid
         deadline = asyncio.get_event_loop().time() + timeout
+        rank = self._owner_rank(args[0]) if args else 0
         while True:
             # fresh future per attempt: wait_for CANCELS on timeout, and
             # re-awaiting a cancelled future would kill the retry loop
@@ -299,8 +569,20 @@ class MDSClient:
                     MClientRequest(tid=tid,
                                    client=self.objecter.client_name,
                                    op=op, args=tuple(args)),
-                    self._mds_addr())
+                    self._mds_addr(rank))
                 reply = await asyncio.wait_for(fut, timeout=5.0)
+                if reply.result == -116:
+                    # wrong rank: adopt the owner hint / fresh subtree
+                    # map and retarget (reference forward-to-auth)
+                    self.objecter._mds_inflight.pop(tid, None)
+                    await self._refresh_subtrees()
+                    try:
+                        rank = int(reply.error)
+                    except (TypeError, ValueError):
+                        rank = self._owner_rank(args[0]) if args else 0
+                    if asyncio.get_event_loop().time() > deadline:
+                        raise TimeoutError(f"mds op {op} kept bouncing")
+                    continue
                 break
             except (ConnectionError, OSError, asyncio.TimeoutError):
                 # MDS restarting: refresh the map for the new address;
@@ -310,6 +592,8 @@ class MDSClient:
                     raise TimeoutError(f"mds op {op} timed out")
                 try:
                     await self.objecter._refresh_map()
+                    await self._refresh_subtrees()
+                    rank = self._owner_rank(args[0]) if args else 0
                 except Exception:
                     pass
                 await asyncio.sleep(0.2)
@@ -319,6 +603,8 @@ class MDSClient:
             raise FileNotFoundError(reply.error)
         if reply.result == -20:
             raise NotADirectoryError(reply.error)
+        if reply.result == -18:
+            raise OSError(18, f"cross-device: {reply.error}")
         if reply.result != 0:
             raise IOError(f"mds {op}: {reply.result} {reply.error}")
         return reply
@@ -342,15 +628,43 @@ class MDSClient:
         await self._call("rename", src, dst)
 
     async def stat(self, path: str) -> Inode:
+        ino, _ = await self._stat_full(path)
+        return ino
+
+    async def _stat_full(self, path: str):
+        """(inode, snapid) — also adopts the reply's data-pool write
+        SnapContext (the caps-carried snapc analog), so subsequent data
+        writes COW correctly across fs snapshots."""
         now = time.monotonic()
         hit = self._lease.get(("stat", path))
         if hit is not None and hit[0] > now:
             return hit[1]
         reply = await self._call("stat", path)
         ino = pickle.loads(reply.data)
+        if reply.snapc is not None:
+            seq, snaps = reply.snapc
+            self.data_io.set_snap_context(seq, list(snaps))
+        out = (ino, reply.snapid)
         if reply.lease_ttl > 0:
-            self._lease[("stat", path)] = (now + reply.lease_ttl, ino)
-        return ino
+            self._lease[("stat", path)] = (now + reply.lease_ttl, out)
+        return out
+
+    # -- snapshots (.snap surface) ------------------------------------------
+
+    async def snap_create(self, dirpath: str, name: str) -> int:
+        """mkdir dir/.snap/name analog (reference ceph fs snapshots)."""
+        self._lease.clear()
+        return (await self._call("snap_create", dirpath, name)).data
+
+    async def snap_rm(self, dirpath: str, name: str) -> None:
+        self._lease.clear()
+        await self._call("snap_rm", dirpath, name)
+
+    async def export_dir(self, path: str, rank: int) -> None:
+        """Move subtree authority to ``rank`` (Migrator::export_dir)."""
+        self._lease.clear()
+        await self._call("export_dir", path, rank)
+        await self._refresh_subtrees()
 
     async def listdir(self, path: str = "/") -> List[str]:
         now = time.monotonic()
@@ -375,7 +689,9 @@ class MDSClient:
                           object_size=1 << 20)  # fs.py default layout
 
     async def write(self, path: str, offset: int, data: bytes) -> None:
-        ino = await self.stat(path)
+        ino, snapid = await self._stat_full(path)
+        if snapid is not None:
+            raise PermissionError(f"{path}: snapshots are read-only")
         from ceph_tpu.cluster.striper import StripedReader, file_to_extents
 
         fmt = f"{ino.ino:x}.%016x"   # fs.py FileSystem._fmt layout
@@ -393,7 +709,7 @@ class MDSClient:
 
     async def read(self, path: str, offset: int = 0,
                    length: Optional[int] = None) -> bytes:
-        ino = await self.stat(path)
+        ino, snapid = await self._stat_full(path)
         from ceph_tpu.cluster.striper import StripedReader, file_to_extents
 
         if length is None:
@@ -408,7 +724,8 @@ class MDSClient:
         async def fetch(ex):
             try:
                 return ex.oid, await self.data_io.read(
-                    ex.oid, offset=ex.offset, length=ex.length)
+                    ex.oid, offset=ex.offset, length=ex.length,
+                    snapid=snapid)
             except FileNotFoundError:
                 return ex.oid, b""
 

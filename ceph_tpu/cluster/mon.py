@@ -542,9 +542,13 @@ class Monitor(Dispatcher):
                         pass
                 return True
             async with self._map_mutex:
-                if self.osdmap.mds_addr != tuple(msg.addr):
+                rank = getattr(msg, "rank", 0) or 0
+                known = getattr(self.osdmap, "mds_addrs", {})
+                if known.get(rank) != tuple(msg.addr):
                     inc = self._new_inc()
-                    inc.new_mds_addr = tuple(msg.addr)
+                    inc.new_mds_addrs = {rank: tuple(msg.addr)}
+                    if rank == 0:
+                        inc.new_mds_addr = tuple(msg.addr)
                     self.perf.inc("mon_mds_beacons")
                     await self._commit_inc(inc)
             return True

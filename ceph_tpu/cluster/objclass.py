@@ -204,3 +204,26 @@ def _rbd_journal_trim(ctx: MethodContext, indata: bytes) -> bytes:
         ctx.omap_rmkeys(dead)
     return str(len(dead)).encode()
 
+
+
+@register("rgw_bilog", "append")
+def _bilog_append(ctx: MethodContext, indata: bytes) -> bytes:
+    """Atomic bucket-index-log append (reference cls_rgw bilog ops):
+    seq allocation + entry write + window trim run as ONE transaction
+    under PG serialization, so concurrent index mutations can never
+    collide on a sequence number or lose an entry.  indata: pickled
+    {"entry": bytes, "max": int}; returns the allocated seq."""
+    import pickle as _p
+
+    req = _p.loads(indata)
+    head_b = ctx.getxattr("bilog.head")
+    seq = (int(head_b) if head_b else 0) + 1
+    ctx.omap_set({f"{seq:012d}": req["entry"]})
+    ctx.setxattr("bilog.head", str(seq).encode())
+    maxlen = int(req.get("max", 1000))
+    if seq > maxlen:
+        cutoff = seq - maxlen
+        ctx.omap_rmkeys([f"{s:012d}"
+                         for s in range(max(1, cutoff - 64), cutoff + 1)])
+        ctx.setxattr("bilog.tail", str(cutoff).encode())
+    return str(seq).encode()

@@ -558,9 +558,11 @@ class IoCtx:
 
     # -- xattrs (librados rados_getxattr/setxattr family) -------------------
 
-    async def getxattr(self, oid: str, name: str) -> bytes:
+    async def getxattr(self, oid: str, name: str,
+                       snapid: Optional[int] = None) -> bytes:
         reply = await self.objecter.op_submit(
-            self.pool_id, oid, [("getxattr", {"name": name})])
+            self.pool_id, oid, [("getxattr", {"name": name})],
+            snapid=snapid if snapid is not None else self._snap_read)
         if reply.result == -61:
             raise KeyError(name)
         if reply.result != 0:
@@ -570,13 +572,15 @@ class IoCtx:
     async def setxattr(self, oid: str, name: str, value: bytes) -> None:
         reply = await self.objecter.op_submit(
             self.pool_id, oid, [("setxattr", {"name": name,
-                                              "value": bytes(value)})])
+                                              "value": bytes(value)})],
+            snapc=self._write_snapc())
         if reply.result != 0:
             raise IOError(f"setxattr({oid}, {name}) -> {reply.result}")
 
     async def rmxattr(self, oid: str, name: str) -> None:
         reply = await self.objecter.op_submit(
-            self.pool_id, oid, [("rmxattr", {"name": name})])
+            self.pool_id, oid, [("rmxattr", {"name": name})],
+            snapc=self._write_snapc())
         if reply.result != 0:
             raise IOError(f"rmxattr({oid}, {name}) -> {reply.result}")
 
@@ -591,20 +595,24 @@ class IoCtx:
 
     async def omap_set(self, oid: str, kv: Dict[str, bytes]) -> None:
         reply = await self.objecter.op_submit(
-            self.pool_id, oid, [("omap_set", {"kv": dict(kv)})])
+            self.pool_id, oid, [("omap_set", {"kv": dict(kv)})],
+            snapc=self._write_snapc())
         if reply.result != 0:
             raise IOError(f"omap_set({oid}) -> {reply.result}")
 
-    async def omap_get(self, oid: str) -> Dict[str, bytes]:
+    async def omap_get(self, oid: str,
+                       snapid: Optional[int] = None) -> Dict[str, bytes]:
         reply = await self.objecter.op_submit(
-            self.pool_id, oid, [("omap_get", {})])
+            self.pool_id, oid, [("omap_get", {})],
+            snapid=snapid if snapid is not None else self._snap_read)
         if reply.result != 0:
             raise IOError(f"omap_get({oid}) -> {reply.result}")
         return reply.data
 
     async def omap_rmkeys(self, oid: str, keys) -> None:
         reply = await self.objecter.op_submit(
-            self.pool_id, oid, [("omap_rmkeys", {"keys": list(keys)})])
+            self.pool_id, oid, [("omap_rmkeys", {"keys": list(keys)})],
+            snapc=self._write_snapc())
         if reply.result != 0:
             raise IOError(f"omap_rmkeys({oid}) -> {reply.result}")
 
@@ -614,7 +622,8 @@ class IoCtx:
                       indata: bytes = b"") -> bytes:
         reply = await self.objecter.op_submit(
             self.pool_id, oid, [("exec", {"cls": cls, "method": method,
-                                          "indata": bytes(indata)})])
+                                          "indata": bytes(indata)})],
+            snapc=self._write_snapc())
         if reply.result != 0:
             raise IOError(
                 f"exec({oid}, {cls}.{method}) -> {reply.result}: "

@@ -41,16 +41,89 @@ class ListResult:
 
 
 class RGW:
-    """Gateway handle (the radosgw storage core as a library)."""
+    """Gateway handle (the radosgw storage core as a library).
 
-    def __init__(self, ioctx: IoCtx):
+    ``zone`` names this gateway's zone for multisite sync (reference
+    rgw_zone): every index mutation also appends to the bucket's index
+    LOG (cls_rgw bilog analog) and registers the bucket in the zone
+    datalog, which RGWSyncAgent (rgw_sync.py) replays into peer zones.
+    """
+
+    def __init__(self, ioctx: IoCtx, zone: str = "default"):
         self.ioctx = ioctx
+        self.zone = zone
 
     BUCKETS_OID = ".buckets.list"   # registry of buckets (omap)
+    DATALOG_OID = ".datalog"        # bucket -> latest bilog seq (omap)
+    BILOG_MAX = 1000                # trimmed window; older -> full sync
 
     @staticmethod
     def _index_oid(bucket: str) -> str:
         return f".bucket.index.{bucket}"
+
+    @staticmethod
+    def _bilog_oid(bucket: str) -> str:
+        return f".bucket.log.{bucket}"
+
+    # -- bucket index log (bilog) -------------------------------------------
+
+    async def _bilog_append(self, bucket: str, op: str, key: str,
+                            origin: Optional[str] = None) -> None:
+        """Append one change record (reference cls_rgw bilog entry) and
+        bump the bucket's datalog cursor.  ``origin`` is the zone the
+        change FIRST happened in — the sync agent skips entries that
+        originated in its own destination, which is what breaks the
+        active-active echo loop."""
+        log_oid = self._bilog_oid(bucket)
+        entry = pickle.dumps({"op": op, "key": key,
+                              "origin": origin or self.zone,
+                              "stamp": time.time()})
+        try:
+            await self.ioctx.stat(log_oid)
+        except FileNotFoundError:
+            await self.ioctx.write_full(log_oid, b"")
+        # cls-atomic append (cls_rgw bilog semantics): seq allocation +
+        # entry + trim run as one transaction under PG serialization, so
+        # concurrent index mutations never collide or lose entries
+        seq = int(await self.ioctx.execute(
+            log_oid, "rgw_bilog", "append",
+            pickle.dumps({"entry": entry, "max": self.BILOG_MAX})))
+        await self.ioctx.omap_set(self.DATALOG_OID,
+                                  {bucket: str(seq).encode()})
+
+    async def bilog_window(self, bucket: str) -> Tuple[int, int]:
+        """(tail, head) seq bounds of the retained log (0, 0) = empty."""
+        log_oid = self._bilog_oid(bucket)
+        try:
+            head = int(await self.ioctx.getxattr(log_oid, "bilog.head"))
+        except (KeyError, FileNotFoundError, IOError):
+            return 0, 0
+        try:
+            tail = int(await self.ioctx.getxattr(log_oid, "bilog.tail"))
+        except (KeyError, FileNotFoundError, IOError):
+            tail = 0
+        return tail, head
+
+    async def bilog_entries(self, bucket: str, after: int) -> List[Tuple[int, Dict]]:
+        """Entries with seq > after, oldest first."""
+        try:
+            om = await self.ioctx.omap_get(self._bilog_oid(bucket))
+        except (FileNotFoundError, IOError):
+            return []
+        out = []
+        for k, blob in sorted(om.items()):
+            seq = int(k)
+            if seq > after:
+                out.append((seq, pickle.loads(blob)))
+        return out
+
+    async def datalog(self) -> Dict[str, int]:
+        """bucket -> latest change seq (reference data changes log)."""
+        try:
+            om = await self.ioctx.omap_get(self.DATALOG_OID)
+        except (FileNotFoundError, IOError):
+            return {}
+        return {b: int(v) for b, v in om.items()}
 
     @staticmethod
     def _data_oid(bucket: str, key: str) -> str:
@@ -81,7 +154,7 @@ class RGW:
         # O(buckets) via the registry omap, not O(pool objects)
         try:
             return sorted(await self.ioctx.omap_get(self.BUCKETS_OID))
-        except FileNotFoundError:
+        except (FileNotFoundError, IOError):
             return []
 
     async def _index(self, bucket: str) -> Dict[str, bytes]:
@@ -95,21 +168,29 @@ class RGW:
 
     async def put_object(self, bucket: str, key: str, data: bytes,
                          content_type: str = "application/octet-stream",
-                         user_meta: Optional[Dict[str, str]] = None) -> str:
+                         user_meta: Optional[Dict[str, str]] = None,
+                         origin: Optional[str] = None,
+                         meta: Optional[ObjectMeta] = None) -> str:
+        """``origin``/``meta`` are the multisite apply path: the sync
+        agent preserves the source zone's metadata (etag/mtime) and
+        stamps the entry's TRUE origin for echo suppression."""
         try:
             await self.ioctx.stat(self._index_oid(bucket))  # must exist
         except FileNotFoundError:
             raise FileNotFoundError(f"bucket {bucket}")
-        etag = hashlib.md5(data).hexdigest()
-        meta = ObjectMeta(key=key, size=len(data), etag=etag,
-                          mtime=time.time(), content_type=content_type,
-                          user_meta=dict(user_meta or {}))
+        if meta is None:
+            etag = hashlib.md5(data).hexdigest()
+            meta = ObjectMeta(key=key, size=len(data), etag=etag,
+                              mtime=time.time(),
+                              content_type=content_type,
+                              user_meta=dict(user_meta or {}))
         await self.ioctx.write_full(self._data_oid(bucket, key), data)
         # index update AFTER the payload lands (cls_rgw prepares/completes
         # around the data write for the same reason)
         await self.ioctx.omap_set(self._index_oid(bucket),
                                   {key: pickle.dumps(meta)})
-        return etag
+        await self._bilog_append(bucket, "put", key, origin)
+        return meta.etag
 
     async def head_object(self, bucket: str, key: str) -> ObjectMeta:
         idx = await self._index(bucket)
@@ -124,10 +205,12 @@ class RGW:
         data = await self.ioctx.read(self._data_oid(bucket, key))
         return meta, data
 
-    async def delete_object(self, bucket: str, key: str) -> None:
+    async def delete_object(self, bucket: str, key: str,
+                            origin: Optional[str] = None) -> None:
         await self.head_object(bucket, key)  # 404 when absent
         await self.ioctx.remove(self._data_oid(bucket, key))
         await self.ioctx.omap_rmkeys(self._index_oid(bucket), [key])
+        await self._bilog_append(bucket, "delete", key, origin)
 
     async def list_objects(self, bucket: str, prefix: str = "",
                            marker: str = "",

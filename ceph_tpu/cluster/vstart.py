@@ -35,18 +35,26 @@ class Cluster:
     clients: List[RadosClient] = field(default_factory=list)
     mgr: Optional[MgrDaemon] = None
     mgr_addr: Optional[tuple] = None
-    mds: Optional[object] = None       # MDSDaemon (cluster/mds.py)
+    mds: Optional[object] = None       # rank-0 MDSDaemon (cluster/mds.py)
     mds_addr: Optional[tuple] = None
+    mdss: Optional[dict] = None        # rank -> MDSDaemon (multi-active)
 
     async def start_mds(self, meta_pool: int, data_pool: int,
                         rank: int = 0):
-        """Start (or restart) the active MDS over existing pools."""
+        """Start (or restart) an active MDS rank over existing pools
+        (multiple ranks = multi-active, subtree-partitioned)."""
         from ceph_tpu.cluster.mds import MDSDaemon
 
-        self.mds = MDSDaemon(self.mon_addr, meta_pool, data_pool,
-                             config=self.config, rank=rank)
-        self.mds_addr = await self.mds.start()
-        return self.mds
+        daemon = MDSDaemon(self.mon_addr, meta_pool, data_pool,
+                           config=self.config, rank=rank)
+        addr = await daemon.start()
+        if self.mdss is None:
+            self.mdss = {}
+        self.mdss[rank] = daemon
+        if rank == 0 or self.mds is None:
+            self.mds = daemon
+            self.mds_addr = addr
+        return daemon
 
     @property
     def mon(self) -> Monitor:
@@ -127,7 +135,10 @@ class Cluster:
     async def stop(self) -> None:
         for c in self.clients:
             await c.shutdown()
-        if self.mds is not None:
+        for d in (self.mdss or {}).values():
+            await d.stop()
+        if self.mds is not None and self.mds not in \
+                (self.mdss or {}).values():
             await self.mds.stop()
         if self.mgr is not None:
             await self.mgr.stop()

@@ -156,7 +156,8 @@ class Incremental:
     new_primary_temp: Dict["PGid", int] = field(default_factory=dict)
     new_primary_affinity: Dict[int, int] = field(default_factory=dict)
     new_mgr_addr: object = None  # mgr registration (reference MgrMap)
-    new_mds_addr: object = None  # active MDS (MDSMap-lite)
+    new_mds_addr: object = None  # active rank-0 MDS (MDSMap-lite)
+    new_mds_addrs: Dict[int, object] = field(default_factory=dict)
     new_revoked: Tuple[str, ...] = ()  # cephx entities to revoke
     old_pools: Tuple[int, ...] = ()    # pool deletions
     # cluster-log events riding the same Paxos stream (the reference's
@@ -174,7 +175,9 @@ class OSDMap:
         self.osd_up = [True] * self.max_osd
         self.osd_weight = [0x10000] * self.max_osd  # in/out weight
         self.mgr_addr = None  # active mgr (reference MgrMap active addr)
-        self.mds_addr = None  # active MDS (MDSMap-lite, mds beacons)
+        self.mds_addr = None  # active rank-0 MDS (MDSMap-lite, beacons)
+        # multi-active MDS ranks (reference MDSMap mds_info): rank -> addr
+        self.mds_addrs = {}
         # cephx entities refused ticket issuance (replicated through
         # Paxos like every map mutation, so revocation survives mon
         # failover AND restarts via the persisted map)
@@ -270,6 +273,11 @@ class OSDMap:
             self.mgr_addr = tuple(inc.new_mgr_addr)
         if inc.new_mds_addr is not None:
             self.mds_addr = tuple(inc.new_mds_addr)
+            self.mds_addrs[0] = tuple(inc.new_mds_addr)
+        for r, a in getattr(inc, "new_mds_addrs", {}).items():
+            self.mds_addrs[r] = tuple(a)
+            if r == 0:
+                self.mds_addr = tuple(a)
         if inc.new_revoked:
             self.revoked_entities |= set(inc.new_revoked)
         for pg, temp in inc.new_pg_temp.items():

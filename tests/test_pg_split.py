@@ -21,7 +21,7 @@ def run(coro):
     return asyncio.run(coro)
 
 
-@contention_retry(attempts=3)
+@contention_retry(attempts=4)
 def test_pg_split_doubles_under_load_and_scrubs_clean():
     async def scenario():
         cluster = await start_cluster(3)

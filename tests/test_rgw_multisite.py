@@ -1,0 +1,118 @@
+"""RGW multisite sync (VERDICT r4 missing #5; reference rgw_sync.cc /
+rgw_data_sync.cc): two zones, bilog-driven incremental sync, full-sync
+bootstrap after trim, and active-active without echo loops."""
+
+import asyncio
+
+from ceph_tpu.cluster.rgw import RGW
+from ceph_tpu.cluster.rgw_sync import RGWSyncAgent
+from ceph_tpu.cluster.vstart import start_cluster
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+async def _zones(cluster):
+    client = await cluster.client()
+    pa = await client.pool_create("zone_a", "replicated", pg_num=4, size=2)
+    pb = await client.pool_create("zone_b", "replicated", pg_num=4, size=2)
+    za = RGW(client.ioctx(pa), zone="a")
+    zb = RGW(client.ioctx(pb), zone="b")
+    return za, zb
+
+
+def test_incremental_and_full_sync():
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            za, zb = await _zones(cluster)
+            await za.create_bucket("bkt")
+            for i in range(5):
+                await za.put_object("bkt", f"k{i}", b"v%d" % i,
+                                    user_meta={"n": str(i)})
+            agent = RGWSyncAgent(za, zb)
+            n = await agent.sync_once()
+            assert n == 5
+            # bucket + objects + metadata (incl. etag) replicated
+            assert await zb.list_buckets() == ["bkt"]
+            meta, data = await zb.get_object("bkt", "k3")
+            assert data == b"v3" and meta.user_meta == {"n": "3"}
+            src_meta = await za.head_object("bkt", "k3")
+            assert meta.etag == src_meta.etag
+
+            # incremental: only NEW changes apply on the next pass
+            await za.put_object("bkt", "k5", b"v5")
+            await za.delete_object("bkt", "k0")
+            n = await agent.sync_once()
+            assert n == 2
+            assert (await zb.get_object("bkt", "k5"))[1] == b"v5"
+            try:
+                await zb.head_object("bkt", "k0")
+                raise AssertionError("delete did not sync")
+            except FileNotFoundError:
+                pass
+            # idempotent: nothing new -> nothing applied
+            assert await agent.sync_once() == 0
+
+            # full-sync bootstrap: a FRESH destination whose marker is
+            # behind a trimmed log window
+            za.BILOG_MAX = 3
+            for i in range(8):
+                await za.put_object("bkt", f"burst{i}", b"b%d" % i)
+            client = await cluster.client("second")
+            pc = await client.pool_create("zone_c", "replicated",
+                                          pg_num=4, size=2)
+            zc = RGW(client.ioctx(pc), zone="c")
+            agent2 = RGWSyncAgent(za, zc)
+            await agent2.sync_once()
+            assert agent2.stats["full_syncs"] >= 1
+            listing = await zc.list_objects("bkt")
+            assert {m.key for m in listing.keys} == \
+                {m.key for m in (await za.list_objects("bkt")).keys}
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+def test_active_active_no_echo():
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            za, zb = await _zones(cluster)
+            await za.create_bucket("aa")
+            ab = RGWSyncAgent(za, zb)   # a -> b
+            ba = RGWSyncAgent(zb, za)   # b -> a
+            await za.put_object("aa", "from_a", b"A")
+            await ab.sync_once()
+            await zb.put_object("aa", "from_b", b"B")
+            # several rounds both ways: converged, no ping-pong growth
+            for _ in range(4):
+                na = await ab.sync_once()
+                nb = await ba.sync_once()
+            assert (await za.get_object("aa", "from_b"))[1] == b"B"
+            assert (await zb.get_object("aa", "from_a"))[1] == b"A"
+            # steady state: no further applies in either direction
+            assert await ab.sync_once() == 0
+            assert await ba.sync_once() == 0
+            assert ab.stats["skipped_echo"] >= 1 or \
+                ba.stats["skipped_echo"] >= 1
+
+            # background daemons converge a live write
+            ab.interval = ba.interval = 0.1
+            ab.start(); ba.start()
+            await za.put_object("aa", "live", b"L")
+            for _ in range(100):
+                try:
+                    if (await zb.get_object("aa", "live"))[1] == b"L":
+                        break
+                except FileNotFoundError:
+                    pass
+                await asyncio.sleep(0.1)
+            assert (await zb.get_object("aa", "live"))[1] == b"L"
+            await ab.stop(); await ba.stop()
+        finally:
+            await cluster.stop()
+
+    run(scenario())
