@@ -173,7 +173,6 @@ class MDSDaemon(Dispatcher):
             om = {}
         if not om:
             if create:
-                await io.write_full(SUBTREE_OID, b"")
                 await io.omap_set(SUBTREE_OID, {"/": b"0"})
             om = {"/": b"0"}
         self.subtrees = {p: int(r) for p, r in om.items()}
@@ -245,21 +244,10 @@ class MDSDaemon(Dispatcher):
         data_id = await self._data_io.selfmanaged_snap_create()
         rec = {"dir": dirpath, "meta_id": meta_id, "data_id": data_id,
                "stamp": time.time()}
-        io = self._meta_io
-        try:
-            await io.stat(self.SNAPTABLE_OID)
-        except FileNotFoundError:
-            await io.write_full(self.SNAPTABLE_OID, b"")
-        await io.omap_set(self.SNAPTABLE_OID, {name: pickle.dumps(rec)})
+        # omap_set auto-creates (the meta txn touches the object)
+        await self._meta_io.omap_set(self.SNAPTABLE_OID,
+                                     {name: pickle.dumps(rec)})
         await self._load_snaptable()
-        # lease barrier: clients cache stat replies (and the data snapc
-        # they carry) up to lease_ttl, and OTHER active ranks only adopt
-        # the new snaptable on their beacon tick — by the time we reply,
-        # every rank has refreshed AND every lease it issued pre-refresh
-        # has expired, so no write can miss the new COW context (the
-        # reference revokes caps; we wait them out)
-        await asyncio.sleep(self.lease_ttl +
-                            self.config.mds_beacon_interval)
         return data_id
 
     async def _snap_rm(self, dirpath: str, name: str) -> None:
@@ -422,6 +410,16 @@ class MDSDaemon(Dispatcher):
                         tid=msg.tid, result=-116, error=str(owner)))
                     self.perf.inc("mds_bounced")
                     return True
+            if msg.op in _MUTATING:
+                # snapshots are a read-only namespace: a literal '.snap'
+                # component in a mutation would create a shadowed dentry
+                # (the reference returns EPERM from the snap realm check)
+                for a in msg.args[:2 if msg.op == "rename" else 1]:
+                    if ".snap" in [p for p in str(a).split("/") if p]:
+                        await conn.send(MClientReply(
+                            tid=msg.tid, result=-1,
+                            error=".snap is a reserved name"))
+                        return True
             if msg.op == "rename":
                 if self._owner_rank(msg.args[0]) != \
                         self._owner_rank(msg.args[1]):
@@ -438,6 +436,16 @@ class MDSDaemon(Dispatcher):
                     if cached is not None:
                         self.perf.inc("mds_dup_requests")
                         await conn.send(cached)
+                        return True
+                    # authority can flip while we queued for the lock
+                    # (export_dir is lock-serialized too): re-check, or
+                    # two ranks could mutate one subtree unserialized
+                    if msg.args and self._owner_rank(
+                            str(msg.args[0])) != self.rank:
+                        await conn.send(MClientReply(
+                            tid=msg.tid, result=-116,
+                            error=str(self._owner_rank(
+                                str(msg.args[0])))))
                         return True
                     self._seq += 1
                     seq = self._seq
@@ -473,6 +481,7 @@ class MDSDaemon(Dispatcher):
                 # durable admin mutations: dup-cached like journal ops,
                 # so a retry after a lost reply gets the ORIGINAL answer
                 # instead of a spurious EEXIST/ENOENT
+                barrier = 0.0
                 async with self._lock:
                     cached = self._completed.get(dup_key)
                     if cached is not None:
@@ -484,6 +493,16 @@ class MDSDaemon(Dispatcher):
                                                        msg.args[1])
                         reply = MClientReply(tid=msg.tid, result=0,
                                              data=data)
+                        # lease barrier OUTSIDE the lock: clients cache
+                        # stat replies (and their data snapc) up to
+                        # lease_ttl, and other ranks adopt the snaptable
+                        # on their beacon tick — by reply time every rank
+                        # refreshed and every pre-refresh lease expired,
+                        # so no write can miss the new COW context (caps
+                        # revocation by timeout).  The lock is NOT held:
+                        # this rank's own snapc is already installed.
+                        barrier = self.lease_ttl + \
+                            self.config.mds_beacon_interval
                     elif msg.op == "snap_rm":
                         await self._snap_rm(msg.args[0], msg.args[1])
                         reply = MClientReply(tid=msg.tid, result=0)
@@ -491,6 +510,9 @@ class MDSDaemon(Dispatcher):
                         await self._export_dir(msg.args[0],
                                                int(msg.args[1]))
                         reply = MClientReply(tid=msg.tid, result=0)
+                    self._completed[dup_key] = reply
+                if barrier:
+                    await asyncio.sleep(barrier)
             else:
                 reply = MClientReply(tid=msg.tid, result=-95,
                                      error=f"bad op {msg.op}")

@@ -78,11 +78,8 @@ class RGW:
         entry = pickle.dumps({"op": op, "key": key,
                               "origin": origin or self.zone,
                               "stamp": time.time()})
-        try:
-            await self.ioctx.stat(log_oid)
-        except FileNotFoundError:
-            await self.ioctx.write_full(log_oid, b"")
-        # cls-atomic append (cls_rgw bilog semantics): seq allocation +
+        # cls-atomic append (cls_rgw bilog semantics): the exec txn
+        # touches (auto-creates) the log object; seq allocation +
         # entry + trim run as one transaction under PG serialization, so
         # concurrent index mutations never collide or lose entries
         seq = int(await self.ioctx.execute(

@@ -57,10 +57,7 @@ class RGWSyncAgent:
                 if k.startswith(pref)}
 
     async def _set_marker(self, bucket: str, seq: int) -> None:
-        try:
-            await self.dst.ioctx.stat(SYNC_STATUS_OID)
-        except FileNotFoundError:
-            await self.dst.ioctx.write_full(SYNC_STATUS_OID, b"")
+        # omap_set auto-creates (the meta txn touches the object)
         await self.dst.ioctx.omap_set(
             SYNC_STATUS_OID,
             {f"{self.src.zone}/{bucket}": str(seq).encode()})
@@ -93,6 +90,7 @@ class RGWSyncAgent:
 
     async def _incremental(self, bucket: str, marker: int) -> int:
         n = 0
+        last = None
         for seq, e in await self.src.bilog_entries(bucket, marker):
             if e.get("origin") == self.dst.zone:
                 # our own change reflected back: consume without applying
@@ -100,7 +98,11 @@ class RGWSyncAgent:
             else:
                 await self._apply(bucket, e)
                 n += 1
-            await self._set_marker(bucket, seq)
+            last = seq
+        if last is not None:
+            # ONE marker write per pass: _apply is idempotent under
+            # re-replay, so a crash mid-pass only re-applies this page
+            await self._set_marker(bucket, last)
         return n
 
     async def _apply(self, bucket: str, e: Dict) -> None:

@@ -394,47 +394,68 @@ class ErasureCodeLrc(ErasureCode):
         coding_pos = self.chunk_mapping[self.data_chunk_count:]
         return data_pos, coding_pos
 
-    def encode_batch(self, data):
-        """(B, k, S) logical data -> (B, m, S) coding chunks, device-resident.
+    def _flat_coding_matrix(self) -> "np.ndarray":
+        """Compose the layer walk into ONE (m_total, k) GF(2^8) matrix
+        over the logical data chunks (round 5).
 
-        Applies every layer in order like encode_chunks: each layer gathers
-        its data-position subset and computes its parities with the layer
-        codec's batched MXU path (reference encode_chunks routing,
-        ErasureCodeLrc.cc:744 — but over the whole stripe batch at once,
-        the whole walk traced into ONE jitted dispatch).
-
-        CRITICAL: the per-layer encode bit-matrices are passed as jit
-        ARGUMENTS, never captured by the trace — a jit closure over a
-        device-resident array permanently degrades every subsequent
-        dispatch in the process on the axon platform (~150x).
-        """
-        import jax
-
-        if self._enc_jit is None:
-            self._enc_jit = jax.jit(self._encode_batch_impl)
-        mats = tuple(layer.erasure_code.engine._enc_bitmat
-                     for layer in self.layers)
-        return self._enc_jit(data, mats)
-
-    def _encode_batch_impl(self, data, mats):
-        import jax.numpy as jnp
+        Every LRC parity — global or local — is a linear function of the
+        data (local layers that read global parities compose through
+        them), so the whole layered encode collapses to a single MXU
+        matmul.  The honest benchmark showed the per-layer walk paying
+        tiny-K matmuls plus scatter materializations for 8.9 GB/s; the
+        flattened matrix runs at the plain-RS rate.  encode_chunks keeps
+        the literal layer walk (it IS the reference semantics the goldens
+        pin); this matrix is algebraically identical by construction."""
+        import numpy as np
 
         from ceph_tpu.ops import gf8
 
-        data = jnp.asarray(data, dtype=jnp.uint8)
-        b, k, s = data.shape
-        n = self.chunk_count
+        k = self.data_chunk_count
         data_pos, coding_pos = self._positions()
-        full = jnp.zeros((b, n, s), dtype=jnp.uint8)
-        full = full.at[:, jnp.asarray(data_pos), :].set(data)
-        for layer, bitmat in zip(self.layers, mats):
-            sub = full[:, jnp.asarray(layer.data), :]
-            lk = len(layer.data)
-            cols = sub.transpose(1, 0, 2).reshape(lk, b * s)
-            out = gf8.bitmatrix_matmul(bitmat, cols)
-            parity = out.reshape(out.shape[0], b, s).transpose(1, 0, 2)
-            full = full.at[:, jnp.asarray(layer.coding), :].set(parity)
-        return full[:, jnp.asarray(coding_pos), :]
+        expr = {c: np.zeros(k, dtype=np.uint8) for c in
+                range(self.chunk_count)}
+        for i, c in enumerate(data_pos):
+            expr[c][i] = 1
+        for layer in self.layers:
+            lm = layer.erasure_code.engine.coding  # (lm, lk) bytes
+            for r, cout in enumerate(layer.coding):
+                acc = np.zeros(k, dtype=np.uint8)
+                for j, cin in enumerate(layer.data):
+                    coef = int(lm[r, j])
+                    if coef:
+                        acc ^= gf8.gf_mul(coef, expr[cin])
+                expr[cout] = acc
+        return np.stack([expr[c] for c in coding_pos])
+
+    def encode_batch(self, data):
+        """(B, k, S) logical data -> (B, m, S) coding chunks,
+        device-resident, as ONE flattened-generator MXU matmul (see
+        _flat_coding_matrix).
+
+        CRITICAL: the encode bit-matrix stays HOST numpy and is passed
+        as a jit ARGUMENT — a jit closure over a device-resident array
+        permanently degrades every subsequent dispatch in the process on
+        the axon platform (~150x).
+        """
+        import jax
+
+        from ceph_tpu.ops import gf8
+
+        if self._enc_jit is None:
+            flat_bitmat = gf8.expand_bitmatrix(self._flat_coding_matrix())
+
+            def impl(data, bitmat):
+                import jax.numpy as jnp
+
+                data = jnp.asarray(data, dtype=jnp.uint8)
+                b, k, s = data.shape
+                cols = data.transpose(1, 0, 2).reshape(k, b * s)
+                out = gf8.bitmatrix_matmul(bitmat, cols)
+                return out.reshape(out.shape[0], b, s).transpose(1, 0, 2)
+
+            self._enc_jit = (jax.jit(impl), flat_bitmat)
+        fn, bitmat = self._enc_jit
+        return fn(data, bitmat)
 
     def decode_batch(self, erasures, chunks, want=None):
         """Batched single-pattern reconstruction, walking layers bottom-up
@@ -451,23 +472,48 @@ class ErasureCodeLrc(ErasureCode):
         key = (tuple(erasures), tuple(want))
         cached = self._dec_jit.get(key)
         if cached is None:
-            # resolve the layer plan AND every recovery bit-matrix on the
-            # host once per pattern; matrices flow in as jit arguments
-            # (never closure constants — see encode_batch)
-            steps, out_pos = self._decode_plan(key[0], key[1])
-            mats = tuple(
-                layer.erasure_code.engine.decode_bitmat(
-                    self._layer_src(layer, local_erasures), local_erasures)
-                for layer, local_erasures, _ in steps)
-            plan = tuple((tuple(layer.chunks),
-                          self._layer_src(layer, local_erasures),
-                          tuple(layer_erased))
-                         for layer, local_erasures, layer_erased in steps)
-            fn = jax.jit(lambda chunks, mats: self._decode_batch_impl(
-                chunks, plan, out_pos, mats))
-            cached = self._dec_jit[key] = (fn, mats)
-        fn, mats = cached
-        return fn(chunks, mats)
+            cached = self._dec_jit[key] = self._build_flat_decode(key)
+        fn, bitmat, src_ids = cached
+        return fn(bitmat, jax.numpy.asarray(chunks), src_ids)
+
+    def _build_flat_decode(self, key):
+        """Compose the bottom-up layer walk for one erasure pattern into
+        ONE recovery matrix over the AVAILABLE logical chunks (round 5;
+        same flattening as encode — the walk is linear, so the per-step
+        tiny-K matmuls + scatters collapse to a single gather+matmul).
+        Host-side per pattern, cached like the reference decode tables."""
+        import numpy as np
+
+        from ceph_tpu.ec.codec import _gather_encode_batch_jit
+        from ceph_tpu.ops import gf8
+
+        erasures, want = key
+        steps, out_pos = self._decode_plan(erasures, want)
+        logical_to_pos = list(self.chunk_mapping)
+        avail_logical = tuple(e for e in range(self.chunk_count)
+                              if e not in erasures)
+        basis = {logical_to_pos[e]: i
+                 for i, e in enumerate(avail_logical)}
+        expr: dict = {}
+        for p, i in basis.items():
+            row = np.zeros(len(avail_logical), dtype=np.uint8)
+            row[i] = 1
+            expr[p] = row
+        for layer, local_erasures, layer_erased in steps:
+            src = self._layer_src(layer, local_erasures)
+            rmat = layer.erasure_code.engine.decode_matrix(
+                src, local_erasures)              # (out, src) bytes
+            for r, out_local in enumerate(local_erasures):
+                acc = np.zeros(len(avail_logical), dtype=np.uint8)
+                for j, s_local in enumerate(src):
+                    coef = int(rmat[r, j])
+                    if coef:
+                        acc ^= gf8.gf_mul(coef,
+                                          expr[layer.chunks[s_local]])
+                expr[layer.chunks[out_local]] = acc
+        flat = np.stack([expr[p] for p in out_pos])
+        bitmat = gf8.expand_bitmatrix(flat)
+        return _gather_encode_batch_jit, bitmat, avail_logical
 
     @staticmethod
     def _layer_src(layer, local_erasures):
@@ -502,26 +548,6 @@ class ErasureCodeLrc(ErasureCode):
                 f"unable to reconstruct positions {sorted(erased_pos & want_pos)}")
         out_pos = tuple(logical_to_pos[e] for e in want)
         return steps, out_pos
-
-    def _decode_batch_impl(self, chunks, plan, out_pos, mats):
-        import jax.numpy as jnp
-
-        from ceph_tpu.ops import gf8
-
-        chunks = jnp.asarray(chunks, dtype=jnp.uint8)
-        b, n, s = chunks.shape
-        logical_to_pos = list(self.chunk_mapping)
-        # repack into positional order
-        full = jnp.zeros((b, n, s), dtype=jnp.uint8)
-        full = full.at[:, jnp.asarray(logical_to_pos), :].set(chunks)
-        for (layer_chunks, src, layer_erased), bitmat in zip(plan, mats):
-            srcs_global = [layer_chunks[i] for i in src]
-            sub = full[:, jnp.asarray(srcs_global), :]
-            cols = sub.transpose(1, 0, 2).reshape(len(src), b * s)
-            out = gf8.bitmatrix_matmul(bitmat, cols)
-            out = out.reshape(out.shape[0], b, s).transpose(1, 0, 2)
-            full = full.at[:, jnp.asarray(list(layer_erased)), :].set(out)
-        return full[:, jnp.asarray(list(out_pos)), :]
 
     # -- CRUSH rule generation ----------------------------------------------
 
