@@ -850,10 +850,22 @@ class Monitor(Dispatcher):
                     "num_osds": m.max_osd,
                     "num_up": sum(m.osd_up),
                     "num_in": sum(1 for w in m.osd_weight if w > 0),
-                    "pools": {p.name or pid: {"id": pid, "size": p.size,
-                                              "pg_num": p.pg_num,
-                                              "type": p.type}
-                              for pid, p in m.pools.items()},
+                    "pools": {p.name or pid: {
+                        "id": pid, "size": p.size,
+                        "pg_num": p.pg_num, "pgp_num": p.pgp_num,
+                        "type": p.type,
+                        **({"tier_of": p.tier_of,
+                            "cache_mode": p.cache_mode}
+                           if p.is_tier() else {}),
+                        **({"tiers": list(p.tiers),
+                            "read_tier": p.read_tier,
+                            "write_tier": p.write_tier}
+                           if p.tiers else {}),
+                    } for pid, p in m.pools.items()},
+                    "mds_ranks": {r: list(a) for r, a in
+                                  sorted(getattr(m, "mds_addrs",
+                                                 {}).items())},
+                    "clog_entries": len(self.cluster_log),
                     # surfaced per round-3 verdict weakness #5: probing
                     # the MAP SHAPE (cached on the map) tells the truth
                     # even though batched placement runs in tools/OSDs,
