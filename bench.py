@@ -106,59 +106,18 @@ def _bench(fn, args, iters, repeats=5, warmup=2):
     return statistics.median(times), min(times), max(times)
 
 
-def _bench_device_loop(step, feedback, data, repeats=3, L1=300, L2=1200):
+def _bench_device_loop(step, feedback, data, repeats=3, L1=300, L2=1200,
+                       tag=None):
     """Seconds-per-step with the repeat loop ON DEVICE, floor-cancelled.
 
-    Builds two scan programs that chain L1 and L2 iterations of ``step``
-    inside one dispatch — each iteration feeds its output back into the
-    next via ``feedback`` (a cheap xor, <2% of the GF matmul work) so XLA
-    cannot hoist or dedupe the loop body — and forces completion with a
-    one-element host readback (`block_until_ready` is enqueue-ack only on
-    the axon tunnel; see module docstring).  The per-iteration time is
-    the slope (t_L2 - t_L1) / (L2 - L1), which cancels the dispatch +
-    readback floor (~100 ms over the tunnel) exactly.  Returns
-    (median_slope, best_slope, worst_slope) across conservative pairings
-    of the repeat samples.
-    """
-    import jax
-    import numpy as np
+    The scan + slope harness now lives in ceph_tpu.ops.profiling
+    (device_loop_slope) so library code and ad-hoc profiling share one
+    honest-timing implementation; ``tag`` records the median into the
+    process-wide device-kernel counters (KERNELS ``t_<tag>``)."""
+    from ceph_tpu.ops.profiling import device_loop_slope
 
-    tinyfn = jax.jit(lambda d: jax.tree_util.tree_leaves(d)[0].ravel()[:1])
-
-    def make(L):
-        @jax.jit
-        def loop(d0):
-            def body(d, _):
-                out = step(d)
-                return feedback(d, out), ()
-
-            d, _ = jax.lax.scan(body, d0, None, length=L)
-            return d
-
-        return loop
-
-    loops = {L: make(L) for L in (L1, L2)}
-
-    def run(L):
-        np.asarray(tinyfn(loops[L](data)))
-
-    ts = {}
-    for L in (L1, L2):
-        run(L)  # compile + warm
-        samples = []
-        for _ in range(repeats):
-            t0 = time.perf_counter()
-            run(L)
-            samples.append(time.perf_counter() - t0)
-        ts[L] = samples
-    dL = L2 - L1
-    # clamp against timing noise driving a slope to <= 0 (a negative or
-    # infinite GB/s must never become the number of record)
-    med = max((statistics.median(ts[L2]) - statistics.median(ts[L1])) / dL,
-              1e-12)
-    best = max((min(ts[L2]) - max(ts[L1])) / dL, 1e-12)
-    worst = max((max(ts[L2]) - min(ts[L1])) / dL, 1e-12)
-    return med, best, worst
+    return device_loop_slope(step, feedback, data, repeats=repeats,
+                             L1=L1, L2=L2, tag=tag)
 
 
 def bench_ec(profile, batch, chunk, workload="encode", erasures=(0,), iters=20,
@@ -190,7 +149,8 @@ def bench_ec(profile, batch, chunk, workload="encode", erasures=(0,), iters=20,
     if workload == "encode":
         try:
             med, lo, hi = _bench_device_loop(
-                codec.encode_batch, feedback, data, repeats)
+                codec.encode_batch, feedback, data, repeats,
+                tag="ec_encode")
         except Exception:
             mode = "pipelined_untrusted"
             med, lo, hi = _bench(codec.encode_batch, (data,), iters, repeats)
@@ -204,7 +164,7 @@ def bench_ec(profile, batch, chunk, workload="encode", erasures=(0,), iters=20,
         try:
             med, lo, hi = _bench_device_loop(
                 lambda c: codec.decode_batch(tuple(erasures), c),
-                feedback, full, repeats)
+                feedback, full, repeats, tag="ec_decode")
         except Exception:
             mode = "pipelined_untrusted"
             med, lo, hi = _bench(
@@ -246,7 +206,7 @@ def bench_crush(n_osds=10_000, n_pgs=1_000_000, repeats=3):
 
     # L tuned down: one iteration maps `n` pgs (a lot of work already)
     med, lo, hi = _bench_device_loop(step, feedback, xs, repeats,
-                                     L1=10, L2=40)
+                                     L1=10, L2=40, tag="crush_map")
     return n / med, n / hi, n / lo
 
 
@@ -263,7 +223,8 @@ def bench_crc32c(batch=4096, length=4096, repeats=3):
     def feedback(d, crcs):
         return d ^ (crcs & 0xFF).astype(jnp.uint8)[:, None]
 
-    med, lo, hi = _bench_device_loop(crc32c_batch, feedback, data, repeats)
+    med, lo, hi = _bench_device_loop(crc32c_batch, feedback, data, repeats,
+                                     tag="crc32c_batch")
     nbytes = batch * length
     return nbytes / med / 1e9, nbytes / hi / 1e9, nbytes / lo / 1e9
 
@@ -292,7 +253,7 @@ EC_CONFIGS = [
 ]
 
 
-def bench_cluster_io(secs_write=4.0, secs_read=3.0):
+def bench_cluster_io(secs_write=4.0, secs_read=3.0, perf_dump=False):
     """End-to-end cluster I/O (the reference `rados bench` run,
     src/tools/rados/rados.cc:103): a live 3-OSD vstart cluster with an
     EC k2m1 pool, measured through the full client->primary->EC
@@ -322,11 +283,19 @@ def bench_cluster_io(secs_write=4.0, secs_read=3.0):
                                   cleanup=False)
             r = await rados_bench(io, secs_read, "rand",
                                   concurrency=16, block_size=1 << 20)
-            return w, r
+            dumps = {}
+            if perf_dump:
+                # each daemon's perf dump rides the bench artifact so
+                # BENCH_r*.json trajectories carry counter context
+                # (kernel invocations, op latencies, histograms)
+                for oid, osd in cluster.osds.items():
+                    dumps[f"osd.{oid}"] = osd.perfcoll.dump()
+                dumps["mon"] = cluster.mon.perf.dump()
+            return w, r, dumps
         finally:
             await cluster.stop()
 
-    w, r = asyncio.run(scenario())
+    w, r, dumps = asyncio.run(scenario())
     rows = []
     for tag, rep in (("write", w), ("rand_read", r)):
         rows.append({
@@ -337,6 +306,9 @@ def bench_cluster_io(secs_write=4.0, secs_read=3.0):
             "lat_p50_ms": round(rep["lat_p50_ms"], 2),
             "lat_p95_ms": round(rep["lat_p95_ms"], 2),
             "iops": round(rep["iops"], 1)})
+    if perf_dump:
+        rows.append({"metric": "cluster_perf_dump", "unit": "json",
+                     "dumps": dumps})
     return rows
 
 
@@ -348,6 +320,9 @@ def main():
                     help="skip the full metric set, print only the headline")
     ap.add_argument("--iterations", type=int, default=20)
     ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--perf-dump", action="store_true",
+                    help="append daemon perf dumps + device-kernel "
+                         "counters to the artifact")
     args = ap.parse_args()
 
     results = []
@@ -388,10 +363,18 @@ def main():
             print(json.dumps({"metric": "crush_map_10kosd_1Mpg",
                               "error": repr(e)}), file=sys.stderr)
         try:
-            results.extend(bench_cluster_io())
+            results.extend(bench_cluster_io(perf_dump=args.perf_dump))
         except Exception as e:
             print(json.dumps({"metric": "cluster_io", "error": repr(e)}),
                   file=sys.stderr)
+        if args.perf_dump:
+            # process-wide kernel counters accumulated across every
+            # bench above (calls, bytes, padding waste, honest t_* from
+            # the device-loop harness)
+            from ceph_tpu.utils.perf import KERNELS
+
+            results.append({"metric": "device_kernel_counters",
+                            "unit": "json", "counters": KERNELS.dump()})
         for r in results:
             print(json.dumps(r))
 

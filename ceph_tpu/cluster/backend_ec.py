@@ -65,6 +65,7 @@ class ECBackendMixin:
         ECBackend pipeline, ECBackend::start_rmw wait queue; our domain is
         the whole PG, like the reference's PG lock)."""
         from ceph_tpu.ec import stripe as stripemod
+        from ceph_tpu.cluster.optracker import mark_current
 
         codec = self._codec(pool)
         sinfo = self._sinfo(pool, codec)
@@ -76,8 +77,10 @@ class ECBackendMixin:
             # write_full: replace the object
             new_size = len(data)
             chunk_off = 0
+            mark_current("ec_encode")
             shards = await self._compute(
                 stripemod.encode_stripes, codec, sinfo, data)
+            mark_current("ec_encoded")
         else:
             sa = self.store.getattr(coll, oid, "size")
             old_size = int(sa) if sa else 0
@@ -91,8 +94,10 @@ class ECBackendMixin:
             merged = stripemod.merge_range(
                 old_bytes, old_in_range, offset - off0, data)
             new_size = max(old_size, offset + len(data))
+            mark_current("ec_encode")
             shards = await self._compute(
                 stripemod.encode_stripes, codec, sinfo, merged)
+            mark_current("ec_encoded")
 
         shard_size = sinfo.shard_size(new_size)
         hinfo = {"size": new_size, "version": version}
@@ -115,6 +120,7 @@ class ECBackendMixin:
             self._apply_shard(st.pgid, oid, my_shard,
                               shards[my_shard].tobytes(), chunk_off,
                               shard_size, hinfo, pre_ops=pre_ops)
+            mark_current("store:journal_queued")
         entry = self._log_mutation(st, "modify", oid, eversion)
         if peers:
             fut = self._make_waiter(reqid, len(peers))
@@ -128,6 +134,7 @@ class ECBackendMixin:
                         epoch=self.osdmap.epoch))
                 except (ConnectionError, OSError, RuntimeError):
                     self._waiter_dec(reqid)
+            mark_current("ec_sub_write_sent")
             try:
                 if not fut.done():
                     await asyncio.wait_for(
@@ -138,6 +145,7 @@ class ECBackendMixin:
                 self._pending.pop(reqid, None)
         # every shard acked: this version can never roll back now
         self._advance_last_complete(st, eversion)
+        mark_current("commit")
         return 0
 
     def _apply_shard(self, pgid: PGid, oid: str, shard: int, data: bytes,

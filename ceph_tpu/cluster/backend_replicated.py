@@ -114,7 +114,10 @@ class ReplicatedBackendMixin:
         """Apply locally + fan out with the log entry; commit when all
         acting replicas ack (reference PrimaryLogPG::issue_repop,
         PrimaryLogPG.cc:9173)."""
+        from ceph_tpu.cluster.optracker import mark_current
+
         self.store.queue_transaction(txn)
+        mark_current("store:journal_queued")
         entry = self._log_mutation(st, op, oid, version)
         peers = [o for o in st.acting
                  if o != self.osd_id and o != CRUSH_ITEM_NONE]
@@ -134,6 +137,7 @@ class ReplicatedBackendMixin:
                     # delta-recovers the peer at rejoin (reference: the
                     # acting set shrinks, missing grows)
                     self._waiter_dec(reqid)
+            mark_current("sub_op_sent")
             try:
                 if not fut.done():
                     await asyncio.wait_for(
@@ -144,6 +148,7 @@ class ReplicatedBackendMixin:
                 self._pending.pop(reqid, None)
         # all acting members acked: advance the never-roll-back watermark
         self._advance_last_complete(st, version)
+        mark_current("commit")
         return 0
 
     async def _op_delete(self, pool: PGPool, st: PGState, oid: str,

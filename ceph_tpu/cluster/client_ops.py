@@ -141,10 +141,21 @@ class ClientOpsMixin:
                     reqid=msg.reqid, result=-1, epoch=m.epoch))
                 return
         self.perf.inc("osd_client_ops")
+        # absorb the client-side trace header so this op's historic dump
+        # shows the objecter/messenger timeline ahead of OSD events
         top = self.tracker.create(
             f"osd_op({msg.reqid[0]}:{msg.reqid[1]} {msg.oid} "
-            f"{[o[0] for o in msg.ops]})")
+            f"{[o[0] for o in msg.ops]})",
+            trace=getattr(msg, "trace", None))
         top.mark("dispatched")
+        in_bytes = sum(len(args.get("data", b""))
+                       for opname, args in msg.ops
+                       if opname in self._MUTATING_OPS)
+        if in_bytes:
+            self.perf.hinc("osd_op_in_bytes_hist", in_bytes)
+        from ceph_tpu.cluster.optracker import CURRENT_OP
+
+        token = CURRENT_OP.set(top)
         try:
             if any(o[0] in self._MUTATING_OPS for o in msg.ops):
                 await self._execute_mutation_dedup(conn, msg, m, pool, st,
@@ -152,7 +163,11 @@ class ClientOpsMixin:
             else:
                 await self._execute_client_ops(conn, msg, m, pool, st, top)
         finally:
+            CURRENT_OP.reset(token)
             top.finish()
+            if top.duration is not None:
+                self.perf.tinc("osd_op_lat", top.duration)
+                self.perf.hinc("osd_op_lat_hist", top.duration)
 
     async def _execute_mutation_dedup(self, conn, msg, m, pool, st, top):
         reqid = tuple(msg.reqid)

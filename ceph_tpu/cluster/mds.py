@@ -113,6 +113,15 @@ class MDSDaemon(Dispatcher):
         self.meta_pool = meta_pool
         self.data_pool = data_pool
         self.perf = PerfCounters(f"mds.{rank}")
+        from ceph_tpu.utils import AdminSocket
+
+        self.asok = AdminSocket()
+        self.asok.register_common(self.perf, self.config)
+        self.asok.register(
+            "status", lambda cmd: {"rank": self.rank,
+                                   "meta_pool": self.meta_pool,
+                                   "data_pool": self.data_pool},
+            "this MDS rank's identity")
         self._client = None               # our own RADOS client
         self.fs: Optional[FileSystem] = None
         self._lock = asyncio.Lock()       # the single-MDS big lock
@@ -389,6 +398,14 @@ class MDSDaemon(Dispatcher):
     # -- request serving ---------------------------------------------------
 
     async def ms_dispatch(self, conn: Connection, msg) -> bool:
+        from ceph_tpu.cluster import messages as _M
+
+        if isinstance(msg, _M.MCommand):
+            # 'ceph daemon mds.N ...' admin surface
+            result, data = await self.asok.dispatch(msg.cmd)
+            await conn.send(_M.MCommandReply(
+                tid=msg.tid, result=result, data=data))
+            return True
         if not isinstance(msg, MClientRequest):
             return False
         self.perf.inc("mds_requests")

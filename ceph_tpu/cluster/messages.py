@@ -33,10 +33,12 @@ class MOSDFailure(Message):
 
 @dataclass
 class MOSDAlive(Message):
-    """OSD beacon (reference MOSDBeacon): liveness + store usage."""
+    """OSD beacon (reference MOSDBeacon): liveness + store usage +
+    blocked-op telemetry for the mon's SLOW_OPS health check."""
 
     osd_id: int = -1
     statfs: Optional[Tuple[int, int]] = None   # (total_bytes, used_bytes)
+    slow_ops: Optional[Tuple[int, float]] = None  # (count, oldest_age_s)
 
 
 # op verbs that mutate object state — shared by the OSD's dedup/caps

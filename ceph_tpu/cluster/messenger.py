@@ -37,6 +37,7 @@ import pickle
 import struct
 import hmac as _hmac
 import hashlib
+import time as _time
 
 from collections import OrderedDict
 from dataclasses import dataclass, field
@@ -58,11 +59,19 @@ class EntityName:
 
 @dataclass
 class Message:
-    """Base message; src/seq/sid are stamped by the sending messenger."""
+    """Base message; src/seq/sid are stamped by the sending messenger.
+
+    ``trace`` is the op-lifecycle trace header (round 6 telemetry): a
+    {"id", "events": [(name, wall_ts), ...]} dict minted by the objecter
+    and stamped by each messenger hop, absorbed into the receiving
+    daemon's TrackedOp so dump_historic_ops shows the op's cross-daemon
+    timeline (reference: the OpRequest's event list + blkin-style trace
+    propagation)."""
 
     src: Optional[EntityName] = field(default=None, init=False)
     seq: int = field(default=0, init=False)
     sid: int = field(default=0, init=False)
+    trace: Optional[dict] = field(default=None, init=False)
 
 
 @dataclass
@@ -534,6 +543,11 @@ class Messenger:
             msg.src = self.name
             msg.seq = sess.seq
             msg.sid = self.sid
+            if msg.trace is not None:
+                # messenger hop stamp: the trace header records when this
+                # endpoint put the message on the wire
+                msg.trace.setdefault("events", []).append(
+                    (f"msgr:{self.name}:send", _time.time()))
             payload = pickle.dumps(msg)
             # buffer the UNSIGNED payload and sign at write time with the
             # connection's key: a cephx ticket renewal mints a new session

@@ -6,18 +6,84 @@ src/mgr/MgrClient.cc:232), the mgr keeps per-daemon state
 (DaemonState/DaemonPerfCounters, src/mgr/DaemonState.h:65) and serves
 aggregated views over admin commands — the substrate the reference's
 dashboard/restful python modules sit on.
+
+Round 6: a Prometheus-style exporter (the reference's mgr prometheus
+module, src/pybind/mgr/prometheus/module.py) renders every reported
+daemon's counters in the Prometheus text exposition format with
+``daemon`` labels — u64 counters as plain gauges, time/avg counters as
+``_sum``/``_count`` pairs, perf histograms as cumulative ``_bucket``
+series — served both over the admin socket (``prometheus metrics``) and
+an optional HTTP endpoint (``serve_exporter``).
 """
 
 from __future__ import annotations
 
 import asyncio
 import time
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 from ceph_tpu.cluster import messages as M
 from ceph_tpu.cluster.messenger import Addr, Connection, Dispatcher, EntityName, Messenger
 from ceph_tpu.cluster.monclient import MonTargeter
-from ceph_tpu.utils import Config, PerfCounters
+from ceph_tpu.utils import AdminSocket, Config, KERNELS, PerfCountersCollection
+
+
+def _prom_name(counter: str) -> str:
+    """Counter -> Prometheus metric name (the exporter module's
+    sanitization: [a-zA-Z0-9_] only, 'ceph_' prefix)."""
+    safe = "".join(c if c.isalnum() or c == "_" else "_"
+                   for c in counter)
+    return f"ceph_{safe}"
+
+
+def render_prometheus(daemons: Dict[str, Dict]) -> str:
+    """Render {daemon_name: {counter: value}} as Prometheus text format.
+
+    Values may be ints (u64 counters), {"avgcount","sum",...} dicts
+    (time/avg counters -> _sum + _count), or {"buckets","lower_bounds",
+    ...} dicts (perf histograms -> cumulative _bucket + _sum + _count).
+    Pure function so the format is testable without a cluster.
+    """
+    by_metric: Dict[str, list] = {}
+    for daemon in sorted(daemons):
+        counters = daemons[daemon]
+        for name in sorted(counters):
+            val = counters[name]
+            metric = _prom_name(name)
+            label = f'daemon="{daemon}"'
+            if isinstance(val, dict) and "buckets" in val:
+                rows = by_metric.setdefault(metric, [])
+                cum = 0
+                # le bounds must be in the SAME units as _sum (the raw
+                # recorded value): un-apply the histogram's bucketing
+                # scale (e.g. 1e6 for microsecond-bucketed latencies)
+                scale = val.get("scale", 1.0) or 1.0
+                for count, lb in zip(val["buckets"],
+                                     val["lower_bounds"]):
+                    cum += count
+                    # bucket upper bound: the NEXT bucket's lower bound
+                    # (bucket 0 spans scaled [0, 2), so its bound is 2)
+                    ub = (lb * 2 if lb else 2) / scale
+                    rows.append((f'{metric}_bucket{{{label},'
+                                 f'le="{ub:g}"}}', cum))
+                rows.append((f'{metric}_bucket{{{label},le="+Inf"}}',
+                             val["count"]))
+                rows.append((f"{metric}_count{{{label}}}", val["count"]))
+                rows.append((f"{metric}_sum{{{label}}}", val["sum"]))
+            elif isinstance(val, dict) and "avgcount" in val:
+                rows = by_metric.setdefault(metric, [])
+                rows.append((f"{metric}_count{{{label}}}",
+                             val["avgcount"]))
+                rows.append((f"{metric}_sum{{{label}}}", val["sum"]))
+            elif isinstance(val, (int, float)):
+                by_metric.setdefault(metric, []).append(
+                    (f"{metric}{{{label}}}", val))
+    lines = []
+    for metric in sorted(by_metric):
+        lines.append(f"# TYPE {metric} untyped")
+        for series, value in by_metric[metric]:
+            lines.append(f"{series} {value}")
+    return "\n".join(lines) + "\n"
 
 
 class MgrDaemon(Dispatcher):
@@ -33,10 +99,49 @@ class MgrDaemon(Dispatcher):
             auth=self.config.cephx_context(f"mgr.{rank}"))
         self.messenger.add_dispatcher(self)
         self.monc = MonTargeter(self.messenger, mon_addr)
-        self.perf = PerfCounters(f"mgr.{rank}")
+        self.perfcoll = PerfCountersCollection()
+        self.perf = self.perfcoll.create(f"mgr.{rank}")
+        self.perfcoll.register(KERNELS)
         # daemon -> {counters, last_report} (DaemonStateIndex analog)
         self.daemons: Dict[str, Dict] = {}
         self._stopped = False
+        self._exporter = None
+        self.exporter_addr: Optional[Tuple[str, int]] = None
+        self.asok = self._build_admin_socket()
+
+    def _build_admin_socket(self) -> AdminSocket:
+        asok = AdminSocket()
+        asok.register_common(self.perfcoll, self.config)
+        asok.register("mgr status",
+                      lambda cmd: {
+                          "daemons": sorted(self.daemons),
+                          "reports": self.perf.get("mgr_reports"),
+                      }, "reporting daemons + report count")
+        asok.register("counter dump",
+                      lambda cmd: {d: s["counters"]
+                                   for d, s in self.daemons.items()},
+                      "every reported daemon's raw counters")
+        asok.register("counter sum", self._counter_sum,
+                      "aggregate one counter across daemons")
+        asok.register("prometheus metrics",
+                      lambda cmd: self.prometheus_metrics(),
+                      "Prometheus text-format exposition of all "
+                      "daemons' counters")
+        return asok
+
+    def _counter_sum(self, cmd):
+        name = cmd.get("counter", "")
+        return sum(s["counters"].get(name, 0)
+                   for s in self.daemons.values()
+                   if isinstance(s["counters"].get(name, 0),
+                                 (int, float)))
+
+    def prometheus_metrics(self) -> str:
+        """Every reported daemon's counters + the mgr's own, labeled."""
+        all_daemons = {d: s["counters"] for d, s in self.daemons.items()}
+        for name, counters in self.perfcoll.dump().items():
+            all_daemons.setdefault(name, counters)
+        return render_prometheus(all_daemons)
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> Addr:
         addr = await self.messenger.bind(host, port)
@@ -49,6 +154,44 @@ class MgrDaemon(Dispatcher):
             self._beacon_loop(addr))
         return addr
 
+    async def serve_exporter(self, host: str = "127.0.0.1",
+                             port: int = 0) -> Tuple[str, int]:
+        """Start the HTTP scrape endpoint (the prometheus module's
+        StandbyModule server analog): GET anything -> text metrics."""
+        self._exporter = await asyncio.start_server(
+            self._serve_scrape, host, port)
+        self.exporter_addr = self._exporter.sockets[0].getsockname()[:2]
+        return self.exporter_addr
+
+    async def _serve_scrape(self, reader, writer) -> None:
+        try:
+            # drain the request head; the path is irrelevant (every
+            # scrape gets the full exposition).  Bounded: a client that
+            # connects and never finishes its head must not wedge the
+            # handler task for the life of the mgr
+            async def _head():
+                while True:
+                    line = await reader.readline()
+                    if not line or line in (b"\r\n", b"\n"):
+                        return
+
+            await asyncio.wait_for(_head(), timeout=5.0)
+            body = self.prometheus_metrics().encode()
+            writer.write(
+                b"HTTP/1.1 200 OK\r\n"
+                b"Content-Type: text/plain; version=0.0.4\r\n"
+                b"Content-Length: " + str(len(body)).encode() +
+                b"\r\nConnection: close\r\n\r\n" + body)
+            await writer.drain()
+            self.perf.inc("mgr_scrapes")
+        except (ConnectionError, OSError, asyncio.TimeoutError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
     async def _beacon_loop(self, addr: Addr) -> None:
         while not self._stopped:
             await asyncio.sleep(max(1.0, self.config.mon_lease_interval * 4))
@@ -58,7 +201,10 @@ class MgrDaemon(Dispatcher):
         self._stopped = True
         if getattr(self, "_beacon_task", None):
             self._beacon_task.cancel()
+        if self._exporter is not None:
+            self._exporter.close()
         await self.messenger.shutdown()
+        self.perfcoll.remove(self.perf.name)
 
     async def ms_dispatch(self, conn: Connection, msg) -> bool:
         if isinstance(msg, M.MMgrReport):
@@ -69,22 +215,7 @@ class MgrDaemon(Dispatcher):
             self.perf.inc("mgr_reports")
             return True
         if isinstance(msg, M.MCommand):
-            result, data = 0, None
-            prefix = msg.cmd.get("prefix")
-            if prefix == "mgr status":
-                data = {
-                    "daemons": sorted(self.daemons),
-                    "reports": self.perf.get("mgr_reports"),
-                }
-            elif prefix == "counter dump":
-                data = {d: s["counters"] for d, s in self.daemons.items()}
-            elif prefix == "counter sum":
-                # aggregate one counter across daemons
-                name = msg.cmd.get("counter", "")
-                data = sum(s["counters"].get(name, 0)
-                           for s in self.daemons.values())
-            else:
-                result = -22
+            result, data = await self.asok.dispatch(msg.cmd)
             await conn.send(M.MCommandReply(tid=msg.tid, result=result,
                                             data=data))
             return True

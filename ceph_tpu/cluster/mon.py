@@ -76,7 +76,11 @@ class Monitor(Dispatcher):
         self.last_beacon: Dict[int, float] = {}
         # per-osd (total, used) bytes from beacons ('ceph df' feed)
         self.osd_statfs: Dict[int, Tuple[int, int]] = {}
+        # per-osd blocked-op telemetry from beacons: feeds the SLOW_OPS
+        # health warning and clears as soon as beacons report drain
+        self.osd_slow_ops: Dict[int, Tuple[int, float]] = {}
         self.perf = PerfCounters("mon")
+        self.asok = self._build_admin_socket()
         self._tick_task: Optional[asyncio.Task] = None
         self._log: List[Tuple[str, object]] = []  # committed proposal log
         # cluster log (reference LogMonitor, src/mon/LogMonitor.h:39): a
@@ -159,6 +163,56 @@ class Monitor(Dispatcher):
         if self.store is not None:
             self.db = None
             self.store.umount()
+
+    def _health_data(self) -> Dict:
+        """Reference health checks (OSD_DOWN, OSD_OUT, OSD_FULL,
+        SLOW_OPS): the SLOW_OPS warning is fed by the OSD beacon stream
+        and clears on drain exactly like the reference's
+        'N slow ops, oldest one blocked for X sec' check
+        (OSDMap::check_health SLOW_OPS)."""
+        m = self.osdmap
+        checks = {}
+        down = [o for o in range(m.max_osd)
+                if m.osd_exists[o] and not m.osd_up[o]]
+        out = [o for o in range(m.max_osd)
+               if m.osd_exists[o] and m.osd_weight[o] == 0]
+        if down:
+            checks["OSD_DOWN"] = f"{len(down)} osds down: {down}"
+        if out:
+            checks["OSD_OUT"] = f"{len(out)} osds out: {out}"
+        full = [o for o, (tot, used) in self.osd_statfs.items()
+                if tot and used / tot > 0.95]
+        if full:
+            checks["OSD_FULL"] = f"osds near full: {full}"
+        slow = {o: s for o, s in self.osd_slow_ops.items()
+                if o < m.max_osd and m.osd_up[o]}
+        if slow:
+            total = sum(n for n, _ in slow.values())
+            oldest = max(age for _, age in slow.values())
+            checks["SLOW_OPS"] = (
+                f"{total} slow ops, oldest age {oldest:.2f}s "
+                f"(osds: {sorted(slow)})")
+        status = "HEALTH_OK" if not checks else (
+            "HEALTH_ERR" if full or len(down) >= m.max_osd
+            else "HEALTH_WARN")
+        return {"status": status, "checks": checks}
+
+    def _build_admin_socket(self):
+        """The mon's 'ceph daemon mon.X' command table (reference
+        Monitor::_add_bootstrap_peer_hint et al. asok registration)."""
+        from ceph_tpu.utils import AdminSocket
+
+        asok = AdminSocket()
+        asok.register_common(self.perf, self.config)
+        asok.register("health", lambda cmd: self._health_data(),
+                      "cluster health status + checks")
+        asok.register("quorum_status",
+                      lambda cmd: {"rank": self.rank,
+                                   "leader": self.leader_rank,
+                                   "is_leader": self.is_leader,
+                                   "n_mons": self.n_mons},
+                      "this monitor's view of the quorum")
+        return asok
 
     @staticmethod
     def _placement_path(m) -> str:
@@ -500,6 +554,14 @@ class Monitor(Dispatcher):
                 self.last_beacon[msg.osd_id] = time.monotonic()
                 if getattr(msg, "statfs", None) is not None:
                     self.osd_statfs[msg.osd_id] = tuple(msg.statfs)
+                slow = getattr(msg, "slow_ops", None)
+                if slow is not None:
+                    if slow[0]:
+                        self.osd_slow_ops[msg.osd_id] = tuple(slow)
+                    else:
+                        # drained: the health warning clears with the
+                        # next 'health' evaluation
+                        self.osd_slow_ops.pop(msg.osd_id, None)
             return True
         if isinstance(msg, M.MOSDMapMsg):
             newmap = pickle.loads(msg.osdmap_blob)
@@ -561,6 +623,16 @@ class Monitor(Dispatcher):
             # inbound connections
             self._sub_conns[tuple(msg.addr)] = conn
             await self._send_map(tuple(msg.addr), since=msg.since)
+            return True
+        if isinstance(msg, M.MCommand):
+            # daemon-directed admin command ('ceph daemon mon.X ...'):
+            # served from the local admin socket, never Paxos-forwarded
+            result, data = await self.asok.dispatch(msg.cmd)
+            try:
+                await conn.send(M.MCommandReply(
+                    tid=msg.tid, result=result, data=data))
+            except (ConnectionError, OSError):
+                pass
             return True
         if isinstance(msg, M.MMonCommand):
             await self._handle_command(conn, msg)
@@ -873,25 +945,7 @@ class Monitor(Dispatcher):
                     "placement_path": self._placement_path(m),
                 }
             elif prefix == "health":
-                # reference health checks (OSD_DOWN, OSD_OUT, MON_DOWN)
-                m = self.osdmap
-                checks = {}
-                down = [o for o in range(m.max_osd)
-                        if m.osd_exists[o] and not m.osd_up[o]]
-                out = [o for o in range(m.max_osd)
-                       if m.osd_exists[o] and m.osd_weight[o] == 0]
-                if down:
-                    checks["OSD_DOWN"] = f"{len(down)} osds down: {down}"
-                if out:
-                    checks["OSD_OUT"] = f"{len(out)} osds out: {out}"
-                full = [o for o, (tot, used) in self.osd_statfs.items()
-                        if tot and used / tot > 0.95]
-                if full:
-                    checks["OSD_FULL"] = f"osds near full: {full}"
-                status = "HEALTH_OK" if not checks else (
-                    "HEALTH_ERR" if full or len(down) >= m.max_osd
-                    else "HEALTH_WARN")
-                data = {"status": status, "checks": checks}
+                data = self._health_data()
             elif prefix == "df":
                 # 'ceph df' analog from beacon statfs
                 per = {o: {"total": t, "used": u, "avail": t - u}

@@ -52,6 +52,7 @@ class Objecter(Dispatcher):
         self.osdmap: Optional[OSDMap] = None
         self._map_event = asyncio.Event()
         self._tid = 0
+        self._trace_seq = 0
         self._inflight: Dict[Tuple[str, int], asyncio.Future] = {}
         self._mon_tid = 0
         self._mon_inflight: Dict[int, asyncio.Future] = {}
@@ -195,6 +196,14 @@ class Objecter(Dispatcher):
         deadline = asyncio.get_event_loop().time() + timeout
         backoff = 0.05
         explicit_pgid = pgid
+        # op-lifecycle trace header: one id for the op across resends;
+        # the events ride the MOSDOp into the OSD's TrackedOp so
+        # dump_historic_ops shows the client-side timeline too
+        import time as _time
+
+        self._trace_seq += 1
+        trace_id = f"{self.client_name}:op{self._trace_seq}"
+        trace_events = [("objecter:submit", _time.time())]
         while True:
             # re-resolve the overlay every attempt: a tier/overlay change
             # mid-retry must re-target (the redirect is map state)
@@ -211,6 +220,9 @@ class Objecter(Dispatcher):
                 msg = M.MOSDOp(reqid=reqid, pgid=pgid, oid=oid, ops=ops,
                                epoch=self.osdmap.epoch,
                                snapc=snapc, snapid=snapid)
+                msg.trace = {"id": trace_id,
+                             "events": trace_events +
+                             [("objecter:send", _time.time())]}
                 try:
                     await self.messenger.send_message(msg, tuple(addr))
                     # outwait the OSD's own replica-ack timeout: abandoning

@@ -6,24 +6,65 @@ timestamped events from arrival to completion; the tracker keeps the
 in-flight set plus ring buffers of the most recent and the slowest
 completed ops, served by the admin commands dump_ops_in_flight /
 dump_historic_ops / dump_historic_slow_ops.
+
+Cross-layer tracing (round 6): an op minted client-side carries a trace
+header (id + pre-arrival events stamped by the objecter and each
+messenger hop); TrackedOp absorbs it so one ``dump_historic_ops`` entry
+shows the op's whole life — objecter submit, messenger send, OSD
+dispatch, encode/journal/commit — across daemons.  ``CURRENT_OP`` lets
+deep layers (backends, stores) mark the op being served without
+threading the handle through every call.
+
+Slow-op semantics (reference osd_op_complaint_time, default 30s): the
+slowest-completed ring only admits ops at/above ``slow_threshold``
+(0 disables it entirely — the old behavior of 0 admitting EVERY op made
+the ring a second history buffer), and ``slow_in_flight()`` reports
+currently-blocked ops past the threshold for the health-warning path
+("N slow ops, oldest age X").
 """
 
 from __future__ import annotations
 
+import contextvars
 import itertools
 import time
 from collections import deque
-from typing import Deque, Dict, List, Optional
+from typing import Deque, Dict, List, Optional, Tuple
+
+# the op currently being served on this task's context (reference: the
+# OpRequest threaded through do_op/do_osd_ops; a contextvar keeps the
+# deep layers' signatures unchanged)
+CURRENT_OP: contextvars.ContextVar[Optional["TrackedOp"]] = \
+    contextvars.ContextVar("ceph_tpu_current_op", default=None)
+
+
+def mark_current(event: str) -> None:
+    """Record an event on the op being served, if any (no-op outside a
+    tracked dispatch — recovery, scrub, internal ops)."""
+    op = CURRENT_OP.get()
+    if op is not None:
+        op.mark(event)
 
 
 class TrackedOp:
-    def __init__(self, tracker: "OpTracker", desc: str):
+    def __init__(self, tracker: "OpTracker", desc: str,
+                 trace: Optional[Dict] = None):
         self._tracker = tracker
         self.seq = next(tracker._seq)
         self.desc = desc
         self.start = time.monotonic()
+        self.wall_start = time.time()
         self.events: List[tuple] = [(0.0, "initiated")]
         self.duration: Optional[float] = None
+        self.trace_id: Optional[str] = None
+        if trace:
+            self.trace_id = trace.get("id")
+            # inherited events carry wall-clock stamps from upstream
+            # layers (objecter, messenger hops); rebase them onto this
+            # op's clock — loopback daemons share the wall clock, so
+            # negative offsets faithfully mean "before OSD arrival"
+            for name, ts in trace.get("events", ()):
+                self.events.append((ts - self.wall_start, name))
 
     def mark(self, event: str) -> None:
         self.events.append((time.monotonic() - self.start, event))
@@ -34,20 +75,29 @@ class TrackedOp:
             self.duration = time.monotonic() - self.start
             self._tracker._finished(self)
 
+    def age(self) -> float:
+        return time.monotonic() - self.start
+
     def dump(self) -> Dict:
-        return {
+        out = {
             "seq": self.seq,
             "description": self.desc,
             "age": time.monotonic() - self.start,
             "duration": self.duration,
             "type_data": {"events": [
-                {"time": round(t, 6), "event": e} for t, e in self.events]},
+                {"time": round(t, 6), "event": e}
+                for t, e in sorted(self.events, key=lambda ev: ev[0])]},
         }
+        if self.trace_id is not None:
+            out["trace_id"] = self.trace_id
+        return out
 
 
 class OpTracker:
     def __init__(self, history_size: int = 20, slow_size: int = 20,
-                 slow_threshold: float = 0.0):
+                 slow_threshold: float = 30.0):
+        """``slow_threshold`` mirrors osd_op_complaint_time (reference
+        default 30s); 0 disables slow-op tracking."""
         self._seq = itertools.count(1)
         self._in_flight: Dict[int, TrackedOp] = {}
         self._history: Deque[TrackedOp] = deque(maxlen=history_size)
@@ -55,19 +105,41 @@ class OpTracker:
         self._slow_size = slow_size
         self.slow_threshold = slow_threshold
 
-    def create(self, desc: str) -> TrackedOp:
-        op = TrackedOp(self, desc)
+    def create(self, desc: str, trace: Optional[Dict] = None) -> TrackedOp:
+        op = TrackedOp(self, desc, trace=trace)
         self._in_flight[op.seq] = op
         return op
 
     def _finished(self, op: TrackedOp) -> None:
         self._in_flight.pop(op.seq, None)
         self._history.append(op)
-        if op.duration is not None and \
+        if self.slow_threshold > 0 and op.duration is not None and \
                 op.duration >= self.slow_threshold:
             self._slowest.append(op)
             self._slowest.sort(key=lambda o: -(o.duration or 0))
             del self._slowest[self._slow_size:]
+
+    def resize(self, history_size: Optional[int] = None,
+               slow_size: Optional[int] = None) -> None:
+        """Apply runtime knob changes (injectargs on
+        osd_op_history_size / osd_op_history_slow_op_size) to the live
+        rings, keeping the newest entries."""
+        if history_size is not None and \
+                history_size != self._history.maxlen:
+            self._history = deque(self._history, maxlen=history_size)
+        if slow_size is not None:
+            self._slow_size = slow_size
+            del self._slowest[slow_size:]
+
+    def slow_in_flight(self) -> Tuple[int, float]:
+        """(count, oldest_age) of in-flight ops blocked past the
+        complaint threshold — the 'N slow ops, oldest age X' health feed
+        (reference OpTracker::check_ops_in_flight)."""
+        if self.slow_threshold <= 0:
+            return 0, 0.0
+        ages = [op.age() for op in self._in_flight.values()]
+        slow = [a for a in ages if a >= self.slow_threshold]
+        return len(slow), max(slow) if slow else 0.0
 
     # -- admin-command surfaces (reference dump_historic_ops et al.) --------
 

@@ -89,10 +89,25 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
             subscribe_since=lambda: self.osdmap.epoch if self.osdmap else 0)
         self.osdmap: Optional[OSDMap] = None
         self.pgs: Dict[PGid, PGState] = {}
-        self.perf = PerfCounters(f"osd.{osd_id}")
+        # per-daemon counter registry: own counters + the process-wide
+        # device-kernel counters, all served by one 'perf dump'
+        from ceph_tpu.utils import KERNELS, PerfCountersCollection
+
+        self.perfcoll = PerfCountersCollection()
+        self.perf = self.perfcoll.create(f"osd.{osd_id}")
+        self.perfcoll.register(KERNELS)
+        self._declare_perf_schema()
         from ceph_tpu.cluster.optracker import OpTracker
 
-        self.tracker = OpTracker()
+        self.tracker = OpTracker(
+            history_size=self.config.osd_op_history_size,
+            slow_size=self.config.osd_op_history_slow_op_size,
+            slow_threshold=self.config.osd_op_complaint_time)
+        # last slow-op count surfaced to the cluster log (warn on rise,
+        # log clearance on drain — the mon health check itself keys off
+        # the beacon stream)
+        self._slow_warned = 0
+        self.asok = self._build_admin_socket()
         self._codecs: Dict[int, object] = {}
         self._pending: Dict[Tuple, Tuple[asyncio.Future, List]] = {}
         self._tid = 0
@@ -181,6 +196,8 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                                  return_exceptions=True)
         await self.messenger.shutdown()
         self.store.umount()
+        # deregister our counters (the shared KERNELS registry stays)
+        self.perfcoll.remove(self.perf.name)
 
     def _next_reqid(self) -> Tuple[str, int]:
         self._tid += 1
@@ -400,38 +417,77 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
             return True
         return False
 
+    def _declare_perf_schema(self) -> None:
+        """Typed schemas + histograms for the op path (reference
+        OSD::create_logger, src/osd/osd_perf_counters.cc)."""
+        from ceph_tpu.utils import perf as perfmod
+
+        self.perf.add_u64("osd_client_ops", prio=perfmod.PRIO_CRITICAL,
+                          desc="client ops served")
+        self.perf.add_u64("osd_rep_ops", desc="replica sub-ops applied")
+        self.perf.add_u64("osd_ec_sub_writes",
+                          desc="EC shard sub-writes applied")
+        self.perf.add_u64("osd_ec_sub_reads",
+                          desc="EC shard sub-reads served")
+        self.perf.add_time("osd_op_lat", prio=perfmod.PRIO_CRITICAL,
+                           desc="client op latency (arrival to reply)")
+        # microsecond-bucketed latency + byte-bucketed payload size
+        # (reference perf histogram axes on osd_op_*_latency)
+        self.perf.add_histogram(
+            "osd_op_lat_hist", scale=1e6, unit=perfmod.UNIT_SECONDS,
+            prio=perfmod.PRIO_INTERESTING,
+            desc="client op latency, log2 microsecond buckets")
+        self.perf.add_histogram(
+            "osd_op_in_bytes_hist", unit=perfmod.UNIT_BYTES,
+            prio=perfmod.PRIO_INTERESTING,
+            desc="mutation payload size, log2 byte buckets")
+
+    def _build_admin_socket(self):
+        """Register this daemon's command table (reference OSD::asok_
+        command registration, src/osd/OSD.cc admin_socket hooks)."""
+        from ceph_tpu.utils import AdminSocket
+
+        asok = AdminSocket()
+        asok.register_common(self.perfcoll, self.config)
+
+        def _inject(cmd):
+            self.config.injectargs(cmd.get("args", {}))
+            self.perf.inc("osd_injectargs")
+            # complaint-time/history knobs apply to the live tracker
+            self.tracker.slow_threshold = \
+                self.config.osd_op_complaint_time
+            self.tracker.resize(
+                history_size=self.config.osd_op_history_size,
+                slow_size=self.config.osd_op_history_slow_op_size)
+
+        asok.register("injectargs", _inject, "runtime config mutation")
+        asok.register("dump_ops_in_flight",
+                      lambda cmd: self.tracker.dump_ops_in_flight(),
+                      "ops currently being served")
+        asok.register("dump_historic_ops",
+                      lambda cmd: self.tracker.dump_historic_ops(),
+                      "recently completed ops with event timelines")
+        asok.register("dump_historic_slow_ops",
+                      lambda cmd: self.tracker.dump_historic_slow_ops(),
+                      "slowest completed ops past the complaint time")
+
+        async def _scrub(cmd):
+            reports = {}
+            for pgid, st in list(self.pgs.items()):
+                if st.primary == self.osd_id:
+                    reports[str(pgid)] = await self.scrub_pg(st)
+            return reports
+
+        asok.register("scrub", _scrub, "scrub every primary PG")
+        return asok
+
     async def _handle_admin_command(self, conn: Connection,
                                     msg: M.MCommand) -> None:
         """Admin-socket surface (reference AdminSocket commands: perf
-        dump, dump_historic_ops, config show, injectargs, scrub)."""
-        cmd = msg.cmd
-        prefix = cmd.get("prefix")
-        result, data = 0, None
-        try:
-            if prefix == "perf dump":
-                data = self.perf.dump()
-            elif prefix == "dump_ops_in_flight":
-                data = self.tracker.dump_ops_in_flight()
-            elif prefix == "dump_historic_ops":
-                data = self.tracker.dump_historic_ops()
-            elif prefix == "dump_historic_slow_ops":
-                data = self.tracker.dump_historic_slow_ops()
-            elif prefix == "config show":
-                data = self.config.show()
-            elif prefix == "injectargs":
-                self.config.injectargs(cmd.get("args", {}))
-                self.perf.inc("osd_injectargs")
-            elif prefix == "scrub":
-                reports = {}
-                for pgid, st in list(self.pgs.items()):
-                    if st.primary == self.osd_id:
-                        reports[str(pgid)] = await self.scrub_pg(st)
-                data = reports
-            else:
-                result = -22
-        except Exception as e:
-            result, data = -22, repr(e)
-        if msg.tid or prefix != "injectargs":
+        dump, dump_historic_ops, config show, injectargs, scrub),
+        routed through the per-daemon command table."""
+        result, data = await self.asok.dispatch(msg.cmd)
+        if msg.tid or msg.cmd.get("prefix") != "injectargs":
             try:
                 await conn.send(M.MCommandReply(
                     tid=msg.tid, result=result, data=data))
@@ -674,10 +730,23 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
             now = time.monotonic()
             # beacon to the mon (reference MOSDBeacon): lets the mon mark
             # us down even when no peer reporters survive; never let a
-            # transport hiccup kill the heartbeat task
+            # transport hiccup kill the heartbeat task.  The beacon also
+            # carries blocked-op telemetry: the mon raises/clears the
+            # SLOW_OPS health warning from this stream, so clearance on
+            # drain needs no extra message.
+            slow_n, slow_oldest = self.tracker.slow_in_flight()
+            if slow_n and slow_n != self._slow_warned:
+                self.clog("WRN", f"{slow_n} slow ops, oldest age "
+                                 f"{slow_oldest:.2f}s "
+                                 f"(complaint time "
+                                 f"{self.tracker.slow_threshold}s)")
+            elif not slow_n and self._slow_warned:
+                self.clog("INF", "slow ops cleared")
+            self._slow_warned = slow_n
             try:
                 await self._mon_send(M.MOSDAlive(
-                    osd_id=self.osd_id, statfs=self.store.statfs()))
+                    osd_id=self.osd_id, statfs=self.store.statfs(),
+                    slow_ops=(slow_n, slow_oldest)))
             except Exception:
                 pass
             # perf-counter stream to the active mgr (MgrClient::send_report)

@@ -52,6 +52,20 @@ class RGW:
     def __init__(self, ioctx: IoCtx, zone: str = "default"):
         self.ioctx = ioctx
         self.zone = zone
+        # gateway telemetry + admin surface (reference radosgw perf
+        # counters 'rgw.*' + its admin socket): the frontend and sync
+        # agent share this gateway's counters
+        from ceph_tpu.utils import AdminSocket, PerfCounters
+        from ceph_tpu.utils import perf as perfmod
+
+        self.perf = PerfCounters(f"rgw.{zone}")
+        self.perf.add_u64("rgw_put", desc="object puts")
+        self.perf.add_u64("rgw_get", desc="object gets")
+        self.perf.add_histogram(
+            "rgw_obj_bytes_hist", unit=perfmod.UNIT_BYTES,
+            desc="object payload size, log2 byte buckets")
+        self.asok = AdminSocket()
+        self.asok.register_common(self.perf)
 
     BUCKETS_OID = ".buckets.list"   # registry of buckets (omap)
     DATALOG_OID = ".datalog"        # bucket -> latest bilog seq (omap)
@@ -182,6 +196,8 @@ class RGW:
                               content_type=content_type,
                               user_meta=dict(user_meta or {}))
         await self.ioctx.write_full(self._data_oid(bucket, key), data)
+        self.perf.inc("rgw_put")
+        self.perf.hinc("rgw_obj_bytes_hist", len(data))
         # index update AFTER the payload lands (cls_rgw prepares/completes
         # around the data write for the same reason)
         await self.ioctx.omap_set(self._index_oid(bucket),
@@ -200,6 +216,7 @@ class RGW:
                          key: str) -> Tuple[ObjectMeta, bytes]:
         meta = await self.head_object(bucket, key)
         data = await self.ioctx.read(self._data_oid(bucket, key))
+        self.perf.inc("rgw_get")
         return meta, data
 
     async def delete_object(self, bucket: str, key: str,

@@ -75,6 +75,42 @@ class Cluster:
         self.clients.append(c)
         return c
 
+    def daemon_addr(self, name: str):
+        """Resolve a daemon name ('osd.2', 'mon', 'mon.1', 'mgr',
+        'mds.0') to its messenger address — the 'ceph daemon <name>'
+        target-resolution seam."""
+        kind, _, num = name.partition(".")
+        if kind == "mon":
+            rank = int(num) if num else self.mons[0].rank
+            return self.mon_addrs[rank]
+        if kind == "osd":
+            osd = self.osds.get(int(num))
+            if osd is None:
+                raise KeyError(f"no such daemon {name}")
+            return osd.messenger.my_addr
+        if kind == "mgr":
+            if self.mgr_addr is None:
+                raise KeyError("no mgr running")
+            return self.mgr_addr
+        if kind == "mds":
+            rank = int(num) if num else 0
+            daemon = (self.mdss or {}).get(rank)
+            if daemon is None:
+                raise KeyError(f"no such daemon {name}")
+            return daemon.messenger.my_addr
+        raise KeyError(f"unknown daemon kind {kind!r}")
+
+    async def daemon_command(self, name: str, cmd, timeout: float = 30.0):
+        """'ceph daemon <name> <cmd>' against this cluster: route an
+        MCommand to the daemon's admin socket (cmd: prefix string or
+        full command dict)."""
+        if isinstance(cmd, str):
+            cmd = {"prefix": cmd}
+        if not self.clients:
+            await self.client()
+        return await self.clients[0].objecter.daemon_command(
+            self.daemon_addr(name), cmd, timeout=timeout)
+
     async def kill_mon(self, rank: int) -> None:
         """Hard-stop a monitor (mon_thrash analog)."""
         await self.mons[rank].stop()

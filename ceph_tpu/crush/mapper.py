@@ -858,10 +858,14 @@ class TensorMapper:
                       choose_args=None):
         """Map a batch of x values; returns (N, result_max) int32 with
         CRUSH_ITEM_NONE padding, plus lengths, matching crush_do_rule."""
+        from ceph_tpu.utils.perf import KERNELS
+
         fn, tensors = self.compiled_rule(ruleno, result_max, choose_args)
         xs = jnp.asarray(xs, dtype=U32)
         weights = jnp.asarray(weights, dtype=U32)
         n = xs.shape[0]
+        KERNELS.inc("crush_map_calls")
+        KERNELS.inc("crush_map_pgs", int(n))
         outs = []
         lens = []
         for start in range(0, n, self.chunk):
@@ -870,6 +874,8 @@ class TensorMapper:
             if part.shape[0] < self.chunk and n > self.chunk:
                 pad = self.chunk - part.shape[0]
                 part = jnp.pad(part, (0, pad))
+                # padded lanes run the full rule VM for discarded output
+                KERNELS.inc("crush_map_pad_lanes", pad)
             res, rl = fn(part, weights, tensors)
             if pad:
                 res = res[:-pad]

@@ -32,6 +32,22 @@ from ceph_tpu.ec.base import ErasureCode
 from ceph_tpu.ec.interface import ECError
 from ceph_tpu.ec.table_cache import DecodeTableCache
 from ceph_tpu.ops import gf8, gfw
+from ceph_tpu.utils.perf import KERNELS
+
+
+def _record_kernel(kind: str, bitmat_shape, nbytes: int) -> None:
+    """Device-kernel telemetry: invocation count, payload bytes, and the
+    MXU shape-padding waste (a (R, K) GF(2) matmul occupies 128-multiple
+    tiles; the unused lanes are throughput the shape leaves on the
+    floor — see BENCH_NOTES.md 'where the encode time actually goes')."""
+    KERNELS.inc(f"{kind}_calls")
+    KERNELS.inc(f"{kind}_bytes", int(nbytes))
+    r, k = int(bitmat_shape[0]), int(bitmat_shape[1])
+    tiles = (-(-r // 128) * 128) * (-(-k // 128) * 128)
+    used = r * k
+    if used:
+        KERNELS.inc(f"{kind}_mxu_pad_bytes",
+                    int(nbytes * (tiles - used) / used))
 
 
 @functools.lru_cache(maxsize=64)
@@ -135,12 +151,15 @@ class _DeviceMatrixEngine:
         self._decode_cache = DecodeTableCache()
 
     def _apply(self, bitmat, data: np.ndarray) -> np.ndarray:
+        _record_kernel("ec_matmul", bitmat.shape, data.size)
         if self.w == 8:
             return np.asarray(_encode_cols(bitmat, jnp.asarray(data)))
         return np.asarray(
             gfw.bitmatrix_matmul_w(bitmat, jnp.asarray(data), self.word_bytes))
 
     def _apply_batch(self, bitmat, data):
+        _record_kernel("ec_matmul", bitmat.shape,
+                       int(np.prod(data.shape)))
         if self.w == 8:
             return _encode_batch_jit(bitmat, jnp.asarray(data))
         return gfw.encode_batch_w(bitmat, jnp.asarray(data), self.word_bytes)
@@ -224,6 +243,8 @@ class _DeviceMatrixEngine:
         and gathers src rows inside one jitted dispatch."""
         bitmat = self.decode_bitmat(src_rows, out_rows)
         chunks = jnp.asarray(chunks)
+        _record_kernel("ec_matmul", bitmat.shape,
+                       int(np.prod(chunks.shape)))
         if self.w == 8:
             return _gather_encode_batch_jit(bitmat, chunks, tuple(src_rows))
         return _gather_encode_batch_w_jit(
@@ -401,6 +422,7 @@ class BitmatrixCodec(MatrixCodec):
 
     def _apply_bitmat(self, m01: np.ndarray, rows: np.ndarray) -> np.ndarray:
         lane = _lane_expand(m01.tobytes(), m01.shape)
+        _record_kernel("ec_matmul", lane.shape, rows.size)
         return np.asarray(_encode_cols(lane, jnp.asarray(rows)))
 
     # -- single-stripe paths ------------------------------------------------
@@ -439,6 +461,8 @@ class BitmatrixCodec(MatrixCodec):
         self._check_layout(data.shape[2])
         m01 = self._encode_bits()
         lane = _lane_expand(m01.tobytes(), m01.shape)
+        _record_kernel("ec_matmul", lane.shape,
+                       int(np.prod(data.shape)))
         return _pkt_batch_apply(lane, data, self.w, self.packetsize)
 
     def decode_batch(self, erasures: Tuple[int, ...], chunks,
@@ -451,4 +475,6 @@ class BitmatrixCodec(MatrixCodec):
         self._check_layout(chunks.shape[2])
         m01 = self._decode_bits(src, tuple(want))
         lane = _lane_expand(m01.tobytes(), m01.shape)
+        _record_kernel("ec_matmul", lane.shape,
+                       int(np.prod(chunks.shape)))
         return _pkt_batch_apply(lane, chunks, self.w, self.packetsize, src)

@@ -100,6 +100,12 @@ def encode_stripes(codec, sinfo: StripeInfo, data: bytes) -> np.ndarray:
     if bb != nstripes:
         batch = np.concatenate(
             [batch, np.zeros((bb - nstripes, k, unit), dtype=np.uint8)])
+    # padding-waste telemetry: stripe-boundary zero fill + the power-of-2
+    # batch bucket rows are bytes the device encodes but nobody stores
+    from ceph_tpu.utils.perf import KERNELS
+
+    KERNELS.inc("ec_stripe_pad_bytes",
+                (padded - len(data)) + (bb - nstripes) * k * unit)
     parity = np.asarray(codec.encode_batch(batch))[:nstripes]
     full = np.concatenate([batch[:nstripes], parity], axis=1)  # (ns, n, unit)
     return full.transpose(1, 0, 2).reshape(n, nstripes * unit)

@@ -211,11 +211,15 @@ def crc32c_batch(data, seed: int = 0xFFFFFFFF):
     """
     import jax.numpy as jnp
 
+    from ceph_tpu.utils.perf import KERNELS
+
     global _batch_jit
     if _batch_jit is None:
         _batch_jit = _crc32c_batch_jit()
     data = jnp.asarray(data)
     n, block = data.shape
+    KERNELS.inc("crc32c_batch_calls")
+    KERNELS.inc("crc32c_batch_bytes", int(n) * int(block))
     bitmat = _message_bitmat_dev(block)
     const = np.uint32(crc32c_zeros(seed, block))
     return _batch_jit(bitmat, data, const)

@@ -183,8 +183,13 @@ def bitmatrix_matmul(bitmat, data):
 
 def gf_matmul(m, data):
     """Convenience: device GF matmul from a byte matrix (host expand + jit)."""
+    from ceph_tpu.utils.perf import KERNELS
+
     bitmat = jnp.asarray(expand_bitmatrix(m))
-    return bitmatrix_matmul(bitmat, jnp.asarray(data))
+    data = jnp.asarray(data)
+    KERNELS.inc("gf8_matmul_calls")
+    KERNELS.inc("gf8_matmul_bytes", int(np.prod(data.shape)))
+    return bitmatrix_matmul(bitmat, data)
 
 
 # ---------------------------------------------------------------------------

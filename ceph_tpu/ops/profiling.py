@@ -1,0 +1,81 @@
+"""Honest device-kernel timing: the on-device scan + slope harness.
+
+This is the BENCH_NOTES.md round-5 methodology as a library: on the axon
+tunnel ``jax.block_until_ready`` returns on enqueue-ack, NOT device
+completion, so any direct timing measures host/tunnel dispatch rate.
+The only trustworthy figure is the SLOPE between two ``lax.scan``
+programs chaining L1 and L2 iterations of the workload inside one
+dispatch (each iteration feeding a cheap xor of its output back into
+the next so nothing can be hoisted), completion forced by a one-element
+host readback — the dispatch/readback floor cancels exactly.
+
+``device_loop_slope`` is that harness; bench.py and ad-hoc profiling
+both call it, and a ``tag`` records the honest per-step seconds into
+the process-wide KERNELS registry (``t_<tag>`` time counters) so
+``perf dump`` carries real device timings next to the invocation/byte
+counters.
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+from typing import Optional
+
+from ceph_tpu.utils.perf import KERNELS
+
+
+def device_loop_slope(step, feedback, data, repeats: int = 3,
+                      L1: int = 300, L2: int = 1200,
+                      tag: Optional[str] = None):
+    """Seconds-per-step of ``step`` with the repeat loop ON DEVICE.
+
+    Builds two jitted scan programs chaining L1 and L2 iterations —
+    each iteration feeds its output back into the next via ``feedback``
+    (a cheap xor, <2% of the workload) — and forces completion with a
+    one-element readback.  The per-iteration time is the slope
+    ``(t_L2 - t_L1) / (L2 - L1)``.  Returns (median, best, worst)
+    across conservative pairings of the repeat samples; ``tag`` also
+    tincs the median into KERNELS as ``t_<tag>``.
+    """
+    import jax
+    import numpy as np
+
+    tinyfn = jax.jit(lambda d: jax.tree_util.tree_leaves(d)[0].ravel()[:1])
+
+    def make(L):
+        @jax.jit
+        def loop(d0):
+            def body(d, _):
+                out = step(d)
+                return feedback(d, out), ()
+
+            d, _ = jax.lax.scan(body, d0, None, length=L)
+            return d
+
+        return loop
+
+    loops = {L: make(L) for L in (L1, L2)}
+
+    def run(L):
+        np.asarray(tinyfn(loops[L](data)))
+
+    ts = {}
+    for L in (L1, L2):
+        run(L)  # compile + warm
+        samples = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            run(L)
+            samples.append(time.perf_counter() - t0)
+        ts[L] = samples
+    dL = L2 - L1
+    # clamp against timing noise driving a slope to <= 0 (a negative or
+    # infinite rate must never become the number of record)
+    med = max((statistics.median(ts[L2]) - statistics.median(ts[L1])) / dL,
+              1e-12)
+    best = max((min(ts[L2]) - max(ts[L1])) / dL, 1e-12)
+    worst = max((max(ts[L2]) - min(ts[L1])) / dL, 1e-12)
+    if tag is not None:
+        KERNELS.tinc(f"t_{tag}", med)
+    return med, best, worst

@@ -1,4 +1,10 @@
-"""Common runtime: typed config schema, perf counters."""
+"""Common runtime: typed config schema, perf counters, admin socket."""
 
+from ceph_tpu.utils.admin_socket import AdminSocket  # noqa: F401
 from ceph_tpu.utils.config import Config, Option  # noqa: F401
-from ceph_tpu.utils.perf import PerfCounters  # noqa: F401
+from ceph_tpu.utils.perf import (  # noqa: F401
+    KERNELS,
+    PerfCounters,
+    PerfCountersCollection,
+    PerfHistogram,
+)

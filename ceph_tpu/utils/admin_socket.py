@@ -1,0 +1,80 @@
+"""AdminSocket-style command router (reference src/common/admin_socket.cc).
+
+Every daemon owns one AdminSocket and registers command handlers into it
+(AdminSocket::register_command analog); the daemon's MCommand dispatch
+becomes one ``dispatch()`` call instead of a per-daemon if/elif ladder,
+and the ``ceph daemon <name> <cmd>`` CLI path reaches any daemon through
+the same table.
+
+Handlers take the full command dict and return the reply payload; they
+may be sync or async (the reference's equivalent seam is AdminSocketHook
+::call running on the admin socket thread).  Errors surface as
+(-EINVAL, repr(e)) like the daemons' previous inline handling.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Any, Callable, Dict, Tuple
+
+from ceph_tpu.utils.perf import PerfCounters, PerfCountersCollection
+
+
+class AdminSocket:
+    def __init__(self):
+        self._commands: Dict[str, Tuple[Callable, str]] = {}
+        self.register("help", lambda cmd: self.commands(),
+                      "list registered commands")
+
+    def register(self, prefix: str, handler: Callable[[Dict], Any],
+                 desc: str = "") -> None:
+        """Bind ``prefix`` -> handler(cmd_dict) -> reply payload."""
+        self._commands[prefix] = (handler, desc)
+
+    def commands(self) -> Dict[str, str]:
+        return {p: d for p, (_, d) in sorted(self._commands.items())}
+
+    def has(self, prefix: str) -> bool:
+        return prefix in self._commands
+
+    async def dispatch(self, cmd: Dict) -> Tuple[int, Any]:
+        """Run the handler for cmd['prefix']; returns (result, data)
+        with -22/EINVAL for unknown commands or handler errors."""
+        entry = self._commands.get(cmd.get("prefix"))
+        if entry is None:
+            return -22, f"unknown command {cmd.get('prefix')!r} " \
+                        f"(try 'help')"
+        handler, _ = entry
+        try:
+            data = handler(cmd)
+            if inspect.isawaitable(data):
+                data = await data
+        except Exception as e:
+            return -22, repr(e)
+        return 0, data
+
+    # -- the standard per-daemon command set --------------------------------
+
+    def register_common(self, perf, config=None) -> None:
+        """Register the commands every daemon serves: the perf family
+        (reference perf dump / perf schema / perf histogram dump /
+        perf reset) and config show/injectargs.  ``perf`` is a
+        PerfCounters or a PerfCountersCollection."""
+        assert isinstance(perf, (PerfCounters, PerfCountersCollection))
+        self.register("perf dump", lambda cmd: perf.dump(),
+                      "dump perf counter values")
+        self.register("perf schema", lambda cmd: perf.dump_schema(),
+                      "dump perf counter types/units/priorities")
+        self.register("perf histogram dump",
+                      lambda cmd: perf.dump_histograms(),
+                      "dump histogram counters only")
+        self.register("perf reset",
+                      lambda cmd: perf.reset() or "reset",
+                      "zero perf counter values (schemas kept)")
+        if config is not None:
+            self.register("config show", lambda cmd: config.show(),
+                          "dump the daemon's config values")
+            self.register(
+                "injectargs",
+                lambda cmd: config.injectargs(cmd.get("args", {})),
+                "runtime config mutation")

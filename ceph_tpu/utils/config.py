@@ -50,6 +50,14 @@ OPTIONS: List[Option] = [
            "background scrub period per primary PG (0 disables)"),
     Option("osd_op_queue", str, "fifo",
            "client op scheduling: fifo | mclock (dmClock QoS)"),
+    Option("osd_op_complaint_time", float, 30.0,
+           "ops blocked this long raise 'slow ops' warnings "
+           "(reference osd_op_complaint_time; 0 disables)", min=0),
+    Option("osd_op_history_size", int, 20,
+           "completed ops kept for dump_historic_ops", min=0),
+    Option("osd_op_history_slow_op_size", int, 20,
+           "slowest completed ops kept for dump_historic_slow_ops",
+           min=0),
     Option("osd_mclock_default_reservation", float, 0.0),
     Option("osd_mclock_default_weight", float, 1.0),
     Option("osd_mclock_default_limit", float, 0.0),

@@ -1,0 +1,109 @@
+"""OpTracker slow-op semantics, blocked-op accounting, and cross-layer
+trace absorption (reference src/common/TrackedOp.cc +
+osd_op_complaint_time health feed)."""
+
+import time
+
+from ceph_tpu.cluster.optracker import (
+    CURRENT_OP,
+    OpTracker,
+    mark_current,
+)
+
+
+def test_slow_threshold_zero_disables():
+    t = OpTracker(slow_threshold=0.0)
+    for i in range(5):
+        t.create(f"op{i}").finish()
+    assert t.dump_historic_slow_ops()["num_ops"] == 0
+    assert t.slow_in_flight() == (0, 0.0)
+
+
+def test_slow_ring_admits_only_slow_ops():
+    t = OpTracker(slow_threshold=0.02, slow_size=2)
+    fast = t.create("fast")
+    fast.finish()
+    slows = []
+    for i in range(3):
+        op = t.create(f"slow{i}")
+        op.start -= 0.05  # age it past the threshold
+        op.finish()
+        slows.append(op)
+    dump = t.dump_historic_slow_ops()
+    # ring keeps only slow_size ops, slowest first, fast op excluded
+    assert dump["num_ops"] == 2
+    assert all("slow" in o["description"] for o in dump["ops"])
+    assert dump["ops"][0]["duration"] >= dump["ops"][1]["duration"]
+    # history still has everything
+    assert t.dump_historic_ops()["num_ops"] == 4
+
+
+def test_slow_in_flight_counts_blocked_ops():
+    t = OpTracker(slow_threshold=0.02)
+    op = t.create("stuck")
+    assert t.slow_in_flight() == (0, 0.0)
+    op.start -= 0.1   # now blocked past the complaint time
+    n, oldest = t.slow_in_flight()
+    assert n == 1 and oldest >= 0.1
+    op.finish()
+    assert t.slow_in_flight() == (0, 0.0)
+    # the completed stuck op landed in the slow ring
+    assert t.dump_historic_slow_ops()["num_ops"] == 1
+
+
+def test_trace_absorption_and_event_ordering():
+    t = OpTracker()
+    now = time.time()
+    trace = {"id": "client.x#ab:op7",
+             "events": [("objecter:submit", now - 0.02),
+                        ("msgr:client.1:send", now - 0.01)]}
+    op = t.create("osd_op(...)", trace=trace)
+    op.mark("dispatched")
+    op.mark("commit")
+    op.finish()
+    d = t.dump_historic_ops()["ops"][0]
+    assert d["trace_id"] == "client.x#ab:op7"
+    names = [e["event"] for e in d["type_data"]["events"]]
+    # client-side events sort before OSD arrival/marks: the full
+    # objecter -> messenger -> osd timeline in one dump
+    assert names.index("objecter:submit") < \
+        names.index("msgr:client.1:send") < names.index("initiated")
+    assert names.index("initiated") < names.index("dispatched") < \
+        names.index("commit") < names.index("done")
+    times = [e["time"] for e in d["type_data"]["events"]]
+    assert times == sorted(times)
+
+
+def test_resize_applies_runtime_knobs():
+    t = OpTracker(history_size=10, slow_size=10, slow_threshold=0.001)
+    for i in range(8):
+        op = t.create(f"op{i}")
+        op.start -= 0.01
+        op.finish()
+    assert t.dump_historic_ops()["num_ops"] == 8
+    t.resize(history_size=3, slow_size=2)
+    hist = t.dump_historic_ops()
+    assert hist["num_ops"] == 3   # newest kept
+    assert hist["ops"][-1]["description"] == "op7"
+    assert t.dump_historic_slow_ops()["num_ops"] == 2
+    # growing works too
+    t.resize(history_size=5)
+    t.create("op8").finish()
+    assert t.dump_historic_ops()["num_ops"] == 4
+
+
+def test_mark_current_contextvar():
+    t = OpTracker()
+    mark_current("ignored")  # no current op: must be a no-op
+    op = t.create("op")
+    token = CURRENT_OP.set(op)
+    try:
+        mark_current("ec_encode")
+        mark_current("commit")
+    finally:
+        CURRENT_OP.reset(token)
+    mark_current("also_ignored")
+    op.finish()
+    names = [e["event"] for e in op.dump()["type_data"]["events"]]
+    assert "ec_encode" in names and "commit" in names
+    assert "ignored" not in names and "also_ignored" not in names

@@ -1,0 +1,215 @@
+"""Perf-counter schemas, histograms, collection thread-safety, and the
+Prometheus rendering (reference src/common/perf_counters.cc +
+perf_histogram.h + the mgr prometheus module's text format)."""
+
+import json
+import threading
+
+from ceph_tpu.utils import perf as perfmod
+from ceph_tpu.utils.admin_socket import AdminSocket
+from ceph_tpu.utils.perf import (
+    PerfCounters,
+    PerfCountersCollection,
+    PerfHistogram,
+)
+from ceph_tpu.cluster.mgr import render_prometheus
+
+
+def test_u64_and_time_counters():
+    pc = PerfCounters("d")
+    pc.add_u64("ops", unit=perfmod.UNIT_NONE,
+               prio=perfmod.PRIO_CRITICAL, desc="ops served")
+    pc.inc("ops", 3)
+    pc.tinc("lat", 0.25)
+    pc.tinc("lat", 0.75)
+    d = pc.dump()["d"]
+    assert d["ops"] == 3
+    assert d["lat"]["avgcount"] == 2
+    assert d["lat"]["sum"] == 1.0
+    assert d["lat"]["last"] == 0.75
+    assert d["lat"]["min"] == 0.25
+    assert d["lat"]["max"] == 0.75
+    schema = pc.dump_schema()["d"]
+    assert schema["ops"]["priority"] == perfmod.PRIO_CRITICAL
+    assert schema["ops"]["type"] == "u64"
+    # undeclared counters still get an inferred schema entry
+    assert schema["lat"]["type"] == "time_avg"
+    assert schema["lat"]["unit"] == perfmod.UNIT_SECONDS
+
+
+def test_histogram_buckets_power_of_two():
+    h = PerfHistogram(buckets=8, scale=1.0)
+    for v in (0, 1, 2, 3, 500, 10 ** 9):
+        h.add(v)
+    d = h.dump()
+    assert d["count"] == 6
+    assert d["buckets"][0] == 2          # 0 and 1
+    assert d["buckets"][1] == 2          # 2 and 3
+    assert d["buckets"][7] == 2          # 500 (2^8 cap) and 1e9 clamp
+    assert d["lower_bounds"][:3] == [0, 2, 4]
+    assert sum(d["buckets"]) == d["count"]
+
+
+def test_histogram_scale_and_reset():
+    pc = PerfCounters("d")
+    pc.add_histogram("lat_hist", buckets=16, scale=1e6,
+                     unit=perfmod.UNIT_SECONDS)
+    pc.hinc("lat_hist", 0.000001)   # 1 us -> bucket 0
+    pc.hinc("lat_hist", 0.001)      # 1000 us -> bucket 9
+    d = pc.dump()["d"]["lat_hist"]
+    assert d["buckets"][0] == 1
+    assert d["buckets"][9] == 1
+    assert pc.dump_histograms()["d"]["lat_hist"]["count"] == 2
+    pc.reset()
+    d = pc.dump()["d"]["lat_hist"]
+    assert d["count"] == 0 and sum(d["buckets"]) == 0
+    # hinc on an undeclared name auto-creates a default histogram
+    pc.hinc("adhoc", 7)
+    assert pc.dump()["d"]["adhoc"]["count"] == 1
+    # everything dumped must be JSON-clean (the admin-socket contract)
+    json.dumps(pc.dump())
+    json.dumps(pc.dump_schema())
+
+
+def test_collection_thread_safety_and_remove():
+    coll = PerfCountersCollection()
+    errors = []
+
+    def churn(i):
+        try:
+            for j in range(200):
+                pc = coll.create(f"d{i}_{j}")
+                pc.inc("x")
+                coll.dump()
+                coll.remove(f"d{i}_{j}")
+        except Exception as e:   # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=churn, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert coll.dump() == {}
+    pc = PerfCounters("kept")
+    coll.register(pc)
+    pc.inc("y", 2)
+    assert coll.dump()["kept"]["y"] == 2
+    assert coll.get("kept") is pc
+    coll.remove("kept")
+    assert coll.get("kept") is None
+
+
+def test_collection_reset_spares_shared_registries():
+    """One daemon's 'perf reset' must not wipe the process-wide shared
+    registry (KERNELS) that every other daemon dumps too."""
+    coll = PerfCountersCollection()
+    own = coll.create("osd.9")
+    own.inc("ops", 5)
+    shared = PerfCounters("device_kernels_test")
+    shared.inc("calls", 7)
+    coll.register(shared)          # shared=True default
+    coll.reset()
+    assert own.get("ops") == 0
+    assert shared.get("calls") == 7
+    # non-shared registration resets normally
+    coll.register(shared, shared=False)
+    coll.reset()
+    assert shared.get("calls") == 0
+
+
+def test_admin_socket_router():
+    import asyncio
+
+    from ceph_tpu.utils import Config
+
+    pc = PerfCounters("d")
+    pc.inc("ops", 4)
+    asok = AdminSocket()
+    asok.register_common(pc, Config())
+
+    async def scenario():
+        r, data = await asok.dispatch({"prefix": "perf dump"})
+        assert r == 0 and data["d"]["ops"] == 4
+        r, data = await asok.dispatch({"prefix": "perf schema"})
+        assert r == 0 and "ops" in data["d"]
+        r, data = await asok.dispatch({"prefix": "config show"})
+        assert r == 0 and "osd_op_complaint_time" in data
+        r, data = await asok.dispatch({"prefix": "perf reset"})
+        assert r == 0
+        r, data = await asok.dispatch({"prefix": "nope"})
+        assert r == -22
+        r, data = await asok.dispatch({"prefix": "help"})
+        assert r == 0 and "perf dump" in data
+
+        async def boom(cmd):
+            raise ValueError("x")
+
+        asok.register("boom", boom)
+        r, data = await asok.dispatch({"prefix": "boom"})
+        assert r == -22 and "ValueError" in data
+
+    asyncio.run(scenario())
+    assert pc.get("ops") == 0  # reset really zeroed
+
+
+def test_prometheus_rendering():
+    daemons = {
+        "osd.0": {
+            "ops": 5,
+            "lat": {"avgcount": 2, "sum": 0.5, "last": 0.3,
+                    "min": 0.2, "max": 0.3},
+            "lat_hist": {"buckets": [1, 2, 0, 1],
+                         "lower_bounds": [0, 2, 4, 8],
+                         "scale": 1.0, "count": 4, "sum": 11.0},
+        },
+        "osd.1": {"ops": 7},
+    }
+    text = render_prometheus(daemons)
+    assert 'ceph_ops{daemon="osd.0"} 5' in text
+    assert 'ceph_ops{daemon="osd.1"} 7' in text
+    assert 'ceph_lat_count{daemon="osd.0"} 2' in text
+    assert 'ceph_lat_sum{daemon="osd.0"} 0.5' in text
+    # histogram buckets are CUMULATIVE with le labels + +Inf terminal;
+    # bucket 0 spans scaled [0, 2) so its bound is the next bucket's
+    # lower bound, 2
+    assert 'ceph_lat_hist_bucket{daemon="osd.0",le="2"} 1' in text
+    assert 'ceph_lat_hist_bucket{daemon="osd.0",le="4"} 3' in text
+    assert 'ceph_lat_hist_bucket{daemon="osd.0",le="+Inf"} 4' in text
+    assert 'ceph_lat_hist_count{daemon="osd.0"} 4' in text
+    # every metric family carries one TYPE header
+    assert text.count("# TYPE ceph_ops untyped") == 1
+
+
+def test_prometheus_le_bounds_unscale_to_sum_units():
+    """A microsecond-bucketed latency histogram (scale=1e6) must emit
+    le bounds in SECONDS — the same units as its _sum series — or
+    histogram_quantile and rate(_sum)/rate(_count) disagree by 1e6."""
+    text = render_prometheus({
+        "osd.0": {"lat_hist": {
+            "buckets": [3, 1], "lower_bounds": [0, 2],
+            "scale": 1e6, "count": 4, "sum": 0.004}}})
+    assert 'le="2e-06"' in text          # 2 us bucket bound in seconds
+    assert 'le="4e-06"' in text
+    assert 'ceph_lat_hist_sum{daemon="osd.0"} 0.004' in text
+
+
+def test_kernel_counters_record_ec_dispatch():
+    import numpy as np
+
+    from ceph_tpu.ec import factory
+    from ceph_tpu.utils.perf import KERNELS
+
+    codec = factory({"plugin": "jerasure", "technique": "reed_sol_van",
+                     "k": "2", "m": "1"})
+    before = KERNELS.get("ec_matmul_calls")
+    before_bytes = KERNELS.get("ec_matmul_bytes")
+    data = np.zeros((4, 2, 256), dtype=np.uint8)
+    codec.encode_batch(data)
+    assert KERNELS.get("ec_matmul_calls") == before + 1
+    assert KERNELS.get("ec_matmul_bytes") - before_bytes == data.size
+    # the MXU pad-waste counter moved too (a (8, 16) bitmat is far off
+    # the 128x128 tile)
+    assert KERNELS.get("ec_matmul_mxu_pad_bytes") > 0
