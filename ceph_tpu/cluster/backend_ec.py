@@ -54,6 +54,13 @@ class ECBackendMixin:
     # decode of the whole touched stripe range happens in one batched TPU
     # dispatch; partial writes are read-modify-write over stripe bounds
     # (reference ECBackend::start_rmw, ECBackend.cc:1785-1886).
+    #
+    # Round-6 layout contract: between those host boundaries the stripe
+    # batch lives in the bit-planar device layout (ec/planar.py) — the
+    # encode/decode/RMW-delta hops are planar GF(2) matmuls and a batch is
+    # converted (transposed) at most once per direction per client op.
+    # Byte layout appears only where bytes must: the store transaction and
+    # the sub-write wire format.
 
     async def _ec_write(self, pool: PGPool, st: PGState, oid: str,
                         data: bytes, offset: Optional[int],
@@ -427,10 +434,13 @@ class ECBackendMixin:
         if len(avail) < k:
             self.perf.inc("osd_unrecoverable")
             return False
-        data = await self._compute(
-            stripemod.decode_stripes, codec, sinfo, avail, size)
+        # decode + re-encode in ONE planar round trip: the stripe batch
+        # is converted to the bit-planar device layout once, missing data
+        # chunks are reconstructed and parity re-derived as planar
+        # matmuls, and the shards convert back once for the store/wire
+        # boundary (round-6 layout contract, ec/planar.py)
         chunks = await self._compute(
-            stripemod.encode_stripes, codec, sinfo, data)
+            stripemod.reencode_stripes, codec, sinfo, avail, size)
         version = max((self.store.get_version(_coll(st.pgid), oid)), 1)
         hinfo = {"size": size, "version": version}
         for shard, osd in enumerate(st.acting):

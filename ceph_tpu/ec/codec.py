@@ -289,6 +289,19 @@ class _DeviceBitEngine:
         return rmat
 
 
+def _planar_rows_matmul(lane_bitmat, rows):
+    """Byte-operand GF(2) matmul for packet-planar rows (the 8x expansion
+    rides in the lane-expanded matrix): fused Pallas kernel on TPU
+    backends, the XLA path elsewhere.  Bit-exact either way."""
+    from ceph_tpu.ops import gf8_pallas
+
+    _record_kernel("ec_matmul", lane_bitmat.shape,
+                   int(np.prod(rows.shape)))
+    if gf8_pallas.available():
+        return gf8_pallas.bitmatrix_matmul(lane_bitmat, rows)
+    return _encode_cols(lane_bitmat, rows)
+
+
 class MatrixCodec(ErasureCode):
     """Bytewise GF(2^w) matrix code; subclasses supply the coding matrix."""
 
@@ -302,6 +315,54 @@ class MatrixCodec(ErasureCode):
     def prepare(self) -> None:
         self.engine = _DeviceMatrixEngine(
             self.k, self.m, self.build_coding_matrix(), w=self.w)
+
+    # -- bit-planar device layout (round 6 layout contract) -----------------
+    #
+    # Stripe batches stay in packed bit-planar form (ec/planar.py) across
+    # encode -> parity -> decode -> RMW; each hop is ONE planar GF(2)
+    # matmul (gf8.planar_matmul: K-stacked Pallas kernel on TPU), and the
+    # byte layout exists only at the host boundary.
+
+    def planar_supported(self, chunk_size: int) -> bool:
+        from ceph_tpu.ec.planar import PlanarBatch
+
+        return PlanarBatch.supported(chunk_size, self.w)
+
+    def to_planar(self, batch) -> "PlanarBatch":
+        """(B, k-or-n, S) byte batch -> device PlanarBatch (one convert)."""
+        from ceph_tpu.ec.planar import PlanarBatch
+
+        return PlanarBatch.from_batch(batch, w=self.w)
+
+    def encode_planar(self, pb) -> "PlanarBatch":
+        """PlanarBatch of the k data chunks -> PlanarBatch of m parity
+        chunks.  No expansion, no pack: one matmul on packed planes."""
+        from ceph_tpu.ops import gf8
+
+        return pb.with_planes(
+            gf8.planar_matmul(self.engine._enc_bitmat, pb.planes), self.m)
+
+    def _planar_decode_plan(self, erasures, want):
+        """(recovery bit-matrix, source chunk ids) for one erasure
+        pattern; MDS codes take the first k available chunks (overridden
+        by non-MDS families)."""
+        avail = tuple(i for i in range(self.k + self.m)
+                      if i not in erasures)
+        src = avail[: self.k]
+        return self.engine.decode_bitmat(src, tuple(want)), src
+
+    def decode_planar(self, erasures, pb, want=None) -> "PlanarBatch":
+        """Planar reconstruction: ``pb`` holds all n chunks (erased rows
+        ignored); returns a PlanarBatch of ``want`` (default: erasures)."""
+        from ceph_tpu.ec.planar import _select_chunk_rows
+        from ceph_tpu.ops import gf8
+
+        if want is None:
+            want = tuple(erasures)
+        bitmat, src = self._planar_decode_plan(tuple(erasures), tuple(want))
+        src_planes = _select_chunk_rows(pb.planes, self.w, tuple(src))
+        return pb.with_planes(gf8.planar_matmul(bitmat, src_planes),
+                              len(want))
 
     # -- single-stripe paths (reference-API compatible) ---------------------
 
@@ -335,8 +396,12 @@ class MatrixCodec(ErasureCode):
         return self.engine.encode_parity_batch(data)
 
     def stripe_unit(self, default: int) -> int:
-        wb = self.w // 8
-        return ((default + wb - 1) // wb) * wb
+        # round to the planar packing quantum (w BYTES: one packed plane
+        # byte spans 8 field words) so cluster stripe batches always
+        # satisfy the bit-planar layout contract; this is a superset of
+        # the old word-size (w/8) alignment
+        q = self.w
+        return ((default + q - 1) // q) * q
 
     def decode_batch(self, erasures: Tuple[int, ...], chunks,
                      want: Tuple[int, ...] = None) -> np.ndarray:
@@ -478,3 +543,43 @@ class BitmatrixCodec(MatrixCodec):
         _record_kernel("ec_matmul", lane.shape,
                        int(np.prod(chunks.shape)))
         return _pkt_batch_apply(lane, chunks, self.w, self.packetsize, src)
+
+    # -- packet-planar layout (round 6) --------------------------------------
+    #
+    # Packet-interleaved chunks are ALREADY bit-planar: jerasure's w packets
+    # of p bytes per super-block are packed bit-planes of the w-bit symbols.
+    # The planar form is therefore the packet-row matrix (c*w, B*ns*p) of
+    # raw bytes, and the matmul keeps the byte-lane Kronecker trick — no
+    # second-level packing conversion on top.
+
+    def planar_supported(self, chunk_size: int) -> bool:
+        from ceph_tpu.ec.planar import PlanarBatch
+
+        return PlanarBatch.supported(chunk_size, self.w, "packet",
+                                     self.packetsize)
+
+    def to_planar(self, batch):
+        from ceph_tpu.ec.planar import PlanarBatch
+
+        batch = jnp.asarray(batch)
+        self._check_layout(int(batch.shape[2]))
+        return PlanarBatch.from_batch(batch, w=self.w, layout="packet",
+                                      packetsize=self.packetsize)
+
+    def encode_planar(self, pb):
+        m01 = self._encode_bits()
+        lane = _lane_expand(m01.tobytes(), m01.shape)
+        return pb.with_planes(_planar_rows_matmul(lane, pb.planes), self.m)
+
+    def decode_planar(self, erasures, pb, want=None):
+        from ceph_tpu.ec.planar import _select_chunk_rows
+
+        if want is None:
+            want = tuple(erasures)
+        avail = tuple(i for i in range(self.k + self.m) if i not in erasures)
+        src = avail[: self.k]
+        m01 = self._decode_bits(src, tuple(want))
+        lane = _lane_expand(m01.tobytes(), m01.shape)
+        src_rows = _select_chunk_rows(pb.planes, self.w, src)
+        return pb.with_planes(_planar_rows_matmul(lane, src_rows),
+                              len(want))

@@ -8,6 +8,14 @@ signature (ErasureCodeIsaTableCache.h:48).  The m=1 XOR special case falls
 out naturally: the first vandermonde parity row is all ones, and a
 multiply-by-1 bit-matrix block is the identity, so the MXU matmul *is* the
 region XOR.
+
+Round 6: as a MatrixCodec this plugin carries the bit-planar layout
+contract (ec/planar.py) — cluster stripe batches stay packed bit-planar
+across encode/decode/RMW (``to_planar``/``encode_planar``/
+``decode_planar``), which is what takes the k8m4 headline encode from the
+HBM-bound 8x-expansion path to the K-stacked fused kernel.  The 32-byte
+ISA address alignment is already a multiple of the planar packing quantum
+(w = 8 bytes), so every legal ISA chunk geometry rides the contract.
 """
 
 from __future__ import annotations

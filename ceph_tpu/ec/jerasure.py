@@ -13,6 +13,16 @@ liberation,
 blaum_roth, liber8tion (native minimal-density GF(2) bit-matrices with
 packetsize semantics — see ceph_tpu.ec.liberation for the constructions
 and the liber8tion byte-compat caveat).
+
+Round 6: both halves of the family carry the bit-planar layout contract
+(ec/planar.py).  The matrix codes (reed_sol_*) pack chunks into w
+bit-planes (``bitpack`` flavor) and their per-technique alignment rules
+(k*w*4-byte multiples) already guarantee planar-compatible chunk sizes
+for every w in {8, 16, 32}.  The packet-interleaved codes
+(cauchy/liberation) ARE bit-planar natively — jerasure's w packets of
+``packetsize`` bytes per super-block are packed bit-planes — so their
+planar form is the packet-row matrix (``packet`` flavor) and no
+second-level packing is applied.
 """
 
 from __future__ import annotations

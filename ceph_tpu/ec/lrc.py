@@ -62,6 +62,7 @@ class ErasureCodeLrc(ErasureCode):
         # dispatch — eager per-layer gathers/scatters cost a runtime round
         # trip each, which dominates end-to-end throughput
         self._enc_jit = None
+        self._enc_planar_bitmat = None
         self._dec_jit: Dict = {}
 
     # -- profile parsing ----------------------------------------------------
@@ -512,8 +513,62 @@ class ErasureCodeLrc(ErasureCode):
                                           expr[layer.chunks[s_local]])
                 expr[layer.chunks[out_local]] = acc
         flat = np.stack([expr[p] for p in out_pos])
+        # round 6 (locality): drop all-zero columns so the device gather
+        # reads ONLY the chunks the composed recovery actually uses — a
+        # single local erasure pulls its l+1-group, not all n-1 survivors
+        # (the reference's minimum_to_decode read set, ErasureCodeLrc.cc:572,
+        # applied to the batched matmul).  Coefficients are untouched, so
+        # the result stays bit-identical; only the source set shrinks.
+        used = np.flatnonzero(flat.any(axis=0))
+        if used.size == 0:
+            used = np.arange(min(1, len(avail_logical)))
+        flat = np.ascontiguousarray(flat[:, used])
+        src_ids = tuple(avail_logical[int(i)] for i in used)
         bitmat = gf8.expand_bitmatrix(flat)
-        return _gather_encode_batch_jit, bitmat, avail_logical
+        return _gather_encode_batch_jit, bitmat, src_ids
+
+    # -- bit-planar device layout (round 6) ---------------------------------
+    #
+    # LRC's layer walk is flattened to single matrices (encode: the
+    # composed generator; decode: the composed pruned recovery), so the
+    # planar path is the same one-matmul story as the plain matrix codes:
+    # packed planes in, packed planes out, conversion only at the host
+    # boundary.  LRC layers are w=8 matrix codes, so w is always 8 here.
+
+    def planar_supported(self, chunk_size: int) -> bool:
+        from ceph_tpu.ec.planar import PlanarBatch
+
+        return PlanarBatch.supported(chunk_size, 8)
+
+    def to_planar(self, batch):
+        from ceph_tpu.ec.planar import PlanarBatch
+
+        return PlanarBatch.from_batch(batch, w=8)
+
+    def encode_planar(self, pb):
+        from ceph_tpu.ops import gf8
+
+        if self._enc_planar_bitmat is None:
+            self._enc_planar_bitmat = gf8.expand_bitmatrix(
+                self._flat_coding_matrix())
+        planes = gf8.planar_matmul(self._enc_planar_bitmat, pb.planes)
+        return pb.with_planes(planes, self.chunk_count -
+                              self.data_chunk_count)
+
+    def decode_planar(self, erasures, pb, want=None):
+        from ceph_tpu.ec.planar import _select_chunk_rows
+        from ceph_tpu.ops import gf8
+
+        if want is None:
+            want = tuple(erasures)
+        key = (tuple(erasures), tuple(want))
+        cached = self._dec_jit.get(key)
+        if cached is None:
+            cached = self._dec_jit[key] = self._build_flat_decode(key)
+        _, bitmat, src_ids = cached
+        src_planes = _select_chunk_rows(pb.planes, 8, src_ids)
+        return pb.with_planes(gf8.planar_matmul(bitmat, src_planes),
+                              len(want))
 
     @staticmethod
     def _layer_src(layer, local_erasures):

@@ -411,11 +411,25 @@ class ErasureCodeShec(MatrixCodec):
 
         if want is None:
             want = tuple(erasures)
-        cache_key = (tuple(erasures), tuple(want))
+        bitmat, src_list = self._batch_plan(tuple(erasures), tuple(want))
+        return _apply(bitmat, src_list)
+
+    def _planar_decode_plan(self, erasures, want):
+        """Planar decode rides the same non-MDS plan construction (the
+        MatrixCodec default of 'first k available' can be singular for
+        SHEC's punctured coding matrix)."""
+        return self._batch_plan(erasures, want)
+
+    def _batch_plan(self, erasures: Tuple[int, ...],
+                    want: Tuple[int, ...]):
+        """(recovery bit-matrix, source ids) for one erasure pattern,
+        cached like the reference decode tables."""
+        cache_key = (erasures, want)
         cached = self._batch_cache.get(cache_key)
         if cached is not None:
-            bitmat, src_list = cached
-            return _apply(bitmat, src_list)
+            return cached
+        import jax.numpy as jnp
+
         n = self.k + self.m
         avails = [0 if i in erasures else 1 for i in range(n)]
         want_vec = [1 if i in want else 0 for i in range(n)]
@@ -464,7 +478,7 @@ class ErasureCodeShec(MatrixCodec):
         else:
             bitmat = jnp.asarray(gfw.expand_bitmatrix_w(rmat, self.w))
         self._batch_cache[cache_key] = (bitmat, tuple(src_list))
-        return _apply(bitmat, src_list)
+        return bitmat, tuple(src_list)
 
 
 def make_shec(profile: ErasureCodeProfile):

@@ -80,11 +80,21 @@ def _bucket(n: int) -> int:
     return 1 << (n - 1).bit_length()
 
 
+def _planar_ok(codec, unit: int) -> bool:
+    """Does this codec carry the round-6 bit-planar layout contract for
+    this stripe unit?  (Mesh adapters and odd geometries fall back to the
+    byte batch path — same math, just without the layout residency.)"""
+    sup = getattr(codec, "planar_supported", None)
+    return bool(sup and sup(unit))
+
+
 def encode_stripes(codec, sinfo: StripeInfo, data: bytes) -> np.ndarray:
     """Encode a stripe-aligned-or-padded byte range in one device dispatch.
 
     Returns (k+m, nstripes * unit) uint8: shard rows, chunk-per-stripe
     concatenated.  ``data`` is zero-padded to the next stripe boundary.
+    The stripe batch rides the bit-planar device layout (ec/planar.py):
+    ONE conversion in, one parity conversion out at the host boundary.
     """
     k = sinfo.k
     unit = sinfo.chunk_size
@@ -106,7 +116,11 @@ def encode_stripes(codec, sinfo: StripeInfo, data: bytes) -> np.ndarray:
 
     KERNELS.inc("ec_stripe_pad_bytes",
                 (padded - len(data)) + (bb - nstripes) * k * unit)
-    parity = np.asarray(codec.encode_batch(batch))[:nstripes]
+    if _planar_ok(codec, unit):
+        pb = codec.to_planar(batch)
+        parity = np.asarray(codec.encode_planar(pb).to_batch())[:nstripes]
+    else:
+        parity = np.asarray(codec.encode_batch(batch))[:nstripes]
     full = np.concatenate([batch[:nstripes], parity], axis=1)  # (ns, n, unit)
     return full.transpose(1, 0, 2).reshape(n, nstripes * unit)
 
@@ -158,14 +172,75 @@ def decode_stripes(
         if bb != nstripes:
             full = np.concatenate(
                 [full, np.zeros((bb - nstripes, n, unit), dtype=np.uint8)])
-        recovered = np.asarray(
-            codec.decode_batch(erasures, full, want=want))[:nstripes]
+        if _planar_ok(codec, unit):
+            pb = codec.to_planar(full)
+            recovered = np.asarray(
+                codec.decode_planar(erasures, pb, want=want)
+                .to_batch())[:nstripes]
+        else:
+            recovered = np.asarray(
+                codec.decode_batch(erasures, full, want=want))[:nstripes]
         for idx, e in enumerate(want):
             data_rows[e] = recovered[:, idx, :].reshape(shard_len)
     stacked = np.stack([data_rows[s].reshape(nstripes, unit)
                         for s in range(k)], axis=1)
     return stacked.reshape(nstripes * sinfo.stripe_width)[
         :logical_size].tobytes()
+
+
+def reencode_stripes(
+    codec,
+    sinfo: StripeInfo,
+    shards: Mapping[int, np.ndarray],
+    logical_size: int,
+) -> np.ndarray:
+    """Recovery fast path: rebuild ALL shard rows from >= k shard rows
+    WITHOUT leaving the planar domain between decode and re-encode.
+
+    The batch is converted to bit-planar once, missing data chunks are
+    reconstructed planar, parity is re-derived planar, and the result is
+    converted back once — so a recovery op transposes the stripe batch
+    exactly once in each direction (the ECBackend::run_recovery_op analog
+    used to round-trip through logical bytes, paying the layout
+    conversion twice more).  Returns (k+m, nstripes * unit) uint8.
+    """
+    k = sinfo.k
+    unit = sinfo.chunk_size
+    n = codec.get_chunk_count()
+    nstripes = sinfo.object_stripes(logical_size)
+    if nstripes == 0:
+        return np.zeros((n, 0), dtype=np.uint8)
+    if len(shards) < k:
+        raise ValueError(f"only {len(shards)} of {k} shards")
+    if not _planar_ok(codec, unit):
+        data = decode_stripes(codec, sinfo, shards, logical_size)
+        return encode_stripes(codec, sinfo, data)
+    shard_len = nstripes * unit
+    full = np.zeros((nstripes, n, unit), dtype=np.uint8)
+    for s in shards:
+        arr = np.asarray(shards[s], dtype=np.uint8)
+        if arr.shape[0] != shard_len:
+            raise ValueError(
+                f"shard {s}: {arr.shape[0]} bytes, want {shard_len}")
+        full[:, s, :] = arr.reshape(nstripes, unit)
+    bb = _bucket(nstripes)
+    if bb != nstripes:
+        full = np.concatenate(
+            [full, np.zeros((bb - nstripes, n, unit), dtype=np.uint8)])
+    pb = codec.to_planar(full)
+    missing_data = tuple(s for s in range(k) if s not in shards)
+    if missing_data:
+        erasures = tuple(s for s in range(n) if s not in shards)
+        dec = codec.decode_planar(erasures, pb, want=missing_data)
+        combined = pb.concat(dec)
+        order = tuple(n + missing_data.index(j) if j in missing_data else j
+                      for j in range(k))
+        data_pb = combined.select(order)
+    else:
+        data_pb = pb.select(tuple(range(k)))
+    parity_pb = codec.encode_planar(data_pb)
+    out = np.asarray(data_pb.concat(parity_pb).to_batch())[:nstripes]
+    return out.transpose(1, 0, 2).reshape(n, shard_len)
 
 
 def merge_range(old: bytes, old_size: int, offset: int, data: bytes) -> bytes:

@@ -193,6 +193,99 @@ def gf_matmul(m, data):
 
 
 # ---------------------------------------------------------------------------
+# Bit-planar layout (round 6): the internal device format for EC batches
+# ---------------------------------------------------------------------------
+#
+# A shard row of L bytes is stored as 8 PACKED bit-planes: plane t, packed
+# byte i holds bit t of source bytes 8i..8i+7, with byte 8i+u at bit u.
+# Rows are chunk-major — plane row j*8+t is bit-plane t of chunk j — which
+# matches expand_bitmatrix's row blocks, so the planar GF(2) matmul uses
+# the SAME bit-matrix as the byte path, no permutation.  Total size equals
+# the byte layout (L bytes per chunk), so keeping batches planar costs no
+# HBM capacity; what it buys is that encode/decode between conversions is
+# a pure matmul — the per-call 8x {0,1} expansion and re-pack that
+# dominated the round-5 HBM traffic (BENCH_NOTES.md) happens at most once
+# per client op, at the host boundary.
+
+
+@jax.jit
+def bytes_to_planar(data):
+    """(c, L) uint8 bytes -> (8c, L/8) packed bit-planes, chunk-major rows.
+
+    planar[j*8 + t, i] bit u  ==  bit t of data[j, 8i + u].
+    """
+    c, l = data.shape
+    nb = l // 8
+    d = data.reshape(c, nb, 8)                               # (c, i, u)
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (d[:, None, :, :] >> shifts[None, :, None, None]) & jnp.uint8(1)
+    weights = (1 << jnp.arange(8, dtype=jnp.int32))          # weight by u
+    planes = jnp.sum(bits.astype(jnp.int32) * weights[None, None, None, :],
+                     axis=3)                                 # (c, t, i)
+    return planes.reshape(c * 8, nb).astype(jnp.uint8)
+
+
+@jax.jit
+def planar_to_bytes(planes):
+    """(8c, nb) packed bit-planes -> (c, 8*nb) bytes (bytes_to_planar^-1)."""
+    c8, nb = planes.shape
+    c = c8 // 8
+    p = planes.reshape(c, 8, nb)                             # (c, t, i)
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (p[:, :, :, None] >> shifts[None, None, None, :]) & jnp.uint8(1)
+    weights = (1 << jnp.arange(8, dtype=jnp.int32))          # weight by t
+    by = jnp.sum(bits.astype(jnp.int32) * weights[None, :, None, None],
+                 axis=1)                                     # (c, i, u)
+    return by.reshape(c, nb * 8).astype(jnp.uint8)
+
+
+@jax.jit
+def planar_matmul_xla(bitmat, planes):
+    """GF(2) matmul directly on packed bit-planes (XLA reference path).
+
+    bitmat: (rw, kw) {0,1} bit-matrix (chunk-major blocks, any w).
+    planes: (kw, nb) packed bit-planes; returns (rw, nb) packed planes.
+    Bit-exact with the byte path: planar_to_bytes(out) ==
+    pack_bits of bitmatrix_matmul on the corresponding byte data.
+    """
+    kw, nb = planes.shape
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = ((planes[:, :, None] >> shifts[None, None, :]) & jnp.uint8(1))
+    bits = bits.reshape(kw, nb * 8).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        bitmat.astype(jnp.int8), bits,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    a = (acc & 1).reshape(acc.shape[0], nb, 8)
+    weights = (1 << jnp.arange(8, dtype=jnp.int32))
+    return jnp.sum(a * weights[None, None, :], axis=2).astype(jnp.uint8)
+
+
+def planar_matmul(bitmat, planes):
+    """Planar GF(2) matmul entry point: packed bit-planes in AND out.
+
+    Routes to the fused, K-stacked Pallas kernel on real TPU backends
+    (gf8_pallas.planar_matmul: block-diagonal matrix stacking feeds the
+    MXU a >=128-wide K dimension and the {0,1} expansion lives in VMEM
+    only) and to planar_matmul_xla elsewhere.  Both paths are bit-exact.
+    Works for any word width w — the operand is bit-rows x packed
+    columns, w only determines how the caller packed the planes.
+    """
+    from ceph_tpu.ops import gf8_pallas
+    from ceph_tpu.ops.profiling import record_planar_matmul
+
+    planes = jnp.asarray(planes)
+    use_pallas = gf8_pallas.planar_available()
+    record_planar_matmul(tuple(bitmat.shape), int(np.prod(planes.shape)),
+                         gf8_pallas.stack_groups(int(bitmat.shape[1]))
+                         if use_pallas else 1)
+    if use_pallas:
+        return gf8_pallas.planar_matmul(bitmat, planes)
+    return planar_matmul_xla(jnp.asarray(bitmat), planes)
+
+
+# ---------------------------------------------------------------------------
 # Matrix inversion (decode-matrix construction; host, k x k bytes)
 # ---------------------------------------------------------------------------
 

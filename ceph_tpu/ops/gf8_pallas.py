@@ -120,6 +120,137 @@ def bitmatrix_matmul(bitmat, data):
         jnp.concatenate(parts, axis=1)
 
 
+# ---------------------------------------------------------------------------
+# v3 (round 6): bit-planar kernel with block-diagonal K-stacking
+# ---------------------------------------------------------------------------
+#
+# Consumes PACKED bit-planes (gf8.bytes_to_planar layout: chunk-major rows
+# j*w+t, packed byte i holding source positions 8i..8i+7) and produces
+# packed parity planes — the storage format the round-6 layout contract
+# keeps stripe batches in end-to-end.  Two changes over v2 attack the two
+# measured walls at once:
+#
+#   * HBM: the {0,1} 8x expansion never leaves VMEM.  Per grid step the
+#     kernel reads a (kw, TILE_P) PACKED tile (payload bytes only) and
+#     writes (rw, TILE_P) packed parity planes — the byte path's ~270 MB
+#     of materialized planes per 16.7 MB step becomes ~25 MB.
+#   * MXU: the coding bit-matrix is stacked block-diagonally g =
+#     max(1, 128 // kw) times and the tile's packed columns are split
+#     into g segments stacked along K, so the dot feeds a g*kw-wide K
+#     (128 for the ISA k8m4 headline's kw=64 instead of 64) and g*rw
+#     output rows per pass — 2x fewer MXU column passes for the same
+#     bytes.  The stacking is a pure reindexing: results are bit-exact
+#     with gf8.planar_matmul_xla.
+#
+# Unpack is 8 shift-and slabs concatenated along LANES (packed byte u-bit
+# -> lane u*seg + i), pack is 8 shift-or lane folds — no reshapes, the
+# Mosaic lesson from v2 carried over.
+
+_TILE_P = 2048            # packed columns per grid step (= 16 KiB of
+                          # source bytes per chunk row)
+
+
+def stack_groups(kw: int) -> int:
+    """Block-diagonal stacking factor: fill the MXU's 128-wide K.
+
+    Rounded DOWN to a power of two so the stacking always divides the
+    column tile evenly (kw=24 would otherwise yield g=5 and a ragged
+    segment split)."""
+    g = max(1, 128 // max(1, kw))
+    while g & (g - 1):
+        g &= g - 1
+    return g
+
+
+def _planar_kernel(bm_ref, p_ref, o_ref, *, g: int, rw: int):
+    tp = p_ref.shape[-1]
+    seg = tp // g
+    d32 = p_ref[:].astype(jnp.int32)                       # (kw, TILE_P)
+    slabs = []
+    for h in range(g):
+        dh = d32[:, h * seg:(h + 1) * seg]
+        slabs.append(jnp.concatenate(
+            [((dh >> u) & 1).astype(jnp.int8) for u in range(8)],
+            axis=1))                                       # (kw, seg*8)
+    op = slabs[0] if g == 1 else jnp.concatenate(slabs, axis=0)
+    acc = jax.lax.dot_general(
+        bm_ref[:], op,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )                                                      # (g*rw, seg*8)
+    outs = []
+    for h in range(g):
+        a = acc[h * rw:(h + 1) * rw]
+        packed = jnp.zeros((rw, seg), jnp.int32)
+        for u in range(8):
+            packed = packed | ((a[:, u * seg:(u + 1) * seg] & 1) << u)
+        outs.append(packed)
+    out = outs[0] if g == 1 else jnp.concatenate(outs, axis=1)
+    o_ref[:] = out.astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _planar_tiled(bitmat, planes, rw: int, kw: int, g: int):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    # block-diagonal stack (tiny: (g*rw, g*kw) int8); built inside the jit
+    # so the device constant is derived from the ARGUMENT bitmat — no jit
+    # closure over a device array (the axon dispatch-poisoning rule)
+    stacked = jnp.kron(jnp.eye(g, dtype=jnp.int8), bitmat.astype(jnp.int8))
+    npk = planes.shape[1]
+    grid = (npk // _TILE_P,)
+    return pl.pallas_call(
+        functools.partial(_planar_kernel, g=g, rw=rw),
+        out_shape=jax.ShapeDtypeStruct((rw, npk), jnp.uint8),
+        grid_spec=pl.GridSpec(
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((rw * g, kw * g), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((kw, _TILE_P), lambda i: (0, i),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((rw, _TILE_P), lambda i: (0, i),
+                                   memory_space=pltpu.VMEM),
+        ),
+    )(stacked, planes)
+
+
+def planar_matmul(bitmat, planes):
+    """Drop-in for gf8.planar_matmul_xla on TPU backends; the ragged tail
+    (npk % TILE_P) falls back to the XLA planar path and concatenates."""
+    from ceph_tpu.ops import gf8
+
+    planes = jnp.asarray(planes)
+    rw, kw = int(bitmat.shape[0]), int(bitmat.shape[1])
+    g = stack_groups(kw)
+    bm = jnp.asarray(bitmat)
+    npk = planes.shape[1]
+    main = (npk // _TILE_P) * _TILE_P
+    parts = []
+    if main:
+        parts.append(_planar_tiled(bm, planes[:, :main], rw, kw, g))
+    if main < npk:
+        parts.append(gf8.planar_matmul_xla(bm, planes[:, main:]))
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+
+
+@functools.lru_cache(maxsize=1)
+def planar_available() -> bool:
+    """Probe once: does the planar kernel compile+run on this backend?"""
+    try:
+        if jax.default_backend() not in ("tpu", "axon"):
+            return False
+        bm = jnp.asarray(np.eye(8, dtype=np.int8))
+        p = jnp.zeros((8, _TILE_P), dtype=jnp.uint8)
+        out = _planar_tiled(bm, p, 8, 8, stack_groups(8))
+        jax.block_until_ready(out)
+        return out.shape == (8, _TILE_P)
+    except Exception:
+        return False
+
+
 @functools.lru_cache(maxsize=1)
 def available() -> bool:
     """Probe once: does a tiny kernel compile+run on this backend?"""

@@ -202,6 +202,52 @@ def bitmatrix_matmul_w(bitmat, data, word_bytes: int):
     return pack_bits_w(acc & 1, word_bytes)
 
 
+# ---------------------------------------------------------------------------
+# Bit-planar layout for w-bit words (round 6; see gf8.py for the w=8 story)
+# ---------------------------------------------------------------------------
+#
+# A shard row of L bytes = L/(w/8) little-endian w-bit words is stored as w
+# PACKED bit-planes of L/w bytes each: plane t, packed byte i holds bit t of
+# words 8i..8i+7 (word 8i+u at bit u), where bit t of a word is bit t%8 of
+# byte t//8.  Rows are chunk-major (plane row j*w + t), matching
+# expand_bitmatrix_w's row blocks, so gf8.planar_matmul serves every width —
+# the operand is just bit-rows x packed columns.  Total bytes equal the byte
+# layout for every w.
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def bytes_to_planar_w(data, w: int):
+    """(c, L) uint8 -> (c*w, L/w) packed bit-planes of w-bit words."""
+    c, l = data.shape
+    wb = w // 8
+    npk = l // w                    # packed bytes per plane (= words/8)
+    words = data.reshape(c, npk, 8, wb)                      # (c, i, u, byte)
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (words[..., None] >> shifts) & jnp.uint8(1)       # (c,i,u,byte,bit)
+    bits = bits.reshape(c, npk, 8, w)                        # t = byte*8+bit
+    weights = (1 << jnp.arange(8, dtype=jnp.int32))          # weight by u
+    planes = jnp.sum(bits.astype(jnp.int32) * weights[None, None, :, None],
+                     axis=2)                                 # (c, i, t)
+    return planes.transpose(0, 2, 1).reshape(c * w, npk).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def planar_to_bytes_w(planes, w: int):
+    """(c*w, npk) packed bit-planes -> (c, npk*w) bytes (inverse)."""
+    cw, npk = planes.shape
+    c = cw // w
+    wb = w // 8
+    p = planes.reshape(c, w, npk)                            # (c, t, i)
+    shifts = jnp.arange(8, dtype=jnp.uint8)
+    bits = (p[..., None] >> shifts) & jnp.uint8(1)           # (c, t, i, u)
+    bits = bits.reshape(c, wb, 8, npk, 8)                    # (c,byte,bit,i,u)
+    weights = (1 << jnp.arange(8, dtype=jnp.int32))          # weight by bit
+    by = jnp.sum(bits.astype(jnp.int32) *
+                 weights[None, None, :, None, None], axis=2)  # (c,byte,i,u)
+    return by.transpose(0, 2, 3, 1).reshape(c, npk * 8 * wb) \
+        .astype(jnp.uint8)
+
+
 @functools.partial(jax.jit, static_argnums=2)
 def encode_batch_w(bitmat, data, word_bytes: int):
     """(B, k, S) -> (B, r, S) through the word-generalized matmul."""

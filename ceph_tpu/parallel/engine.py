@@ -212,7 +212,16 @@ class MeshCodecAdapter:
             mesh, k, n - k, np.asarray(codec.engine.coding))
         self._data_axis = mesh.shape["data"]
 
+    # the bit-planar entry points are single-device (the mesh engine
+    # shards BYTE batches); hiding them steers ec/stripe.py's planar
+    # routing back to encode_batch/decode_batch so mesh pools keep the
+    # multi-chip data plane
+    _SINGLE_DEVICE_ONLY = frozenset(
+        {"planar_supported", "to_planar", "encode_planar", "decode_planar"})
+
     def __getattr__(self, name):
+        if name in self._SINGLE_DEVICE_ONLY:
+            raise AttributeError(name)
         return getattr(self._codec, name)
 
     def _pad(self, arr):

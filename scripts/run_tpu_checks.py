@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Driver-invocable TPU validation hook (round 6).
+
+Runs the real-device checks the CPU test suite cannot (the Pallas
+bit-exactness assertions that ``tests/test_gf8.py`` skips without a TPU
+backend, and the K-stacked planar kernel of the round-6 layout contract)
+plus the backend-agnostic bit-planar round-trip/codec-equivalence checks,
+and RECORDS the outcome as a JSON artifact alongside the BENCH_r*.json
+trajectory so a bench number is never published without its
+bit-exactness witness:
+
+    python scripts/run_tpu_checks.py [--out TPU_CHECKS_rNN.json]
+
+The default output name follows the highest existing BENCH round
+(BENCH_r05.json -> TPU_CHECKS_r06.json).  Exit status is nonzero iff any
+check FAILS; SKIP (no TPU attached) is not a failure — the artifact
+records it honestly.
+"""
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _next_round() -> int:
+    rounds = [0]
+    for path in glob.glob(os.path.join(REPO, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if m:
+            rounds.append(int(m.group(1)))
+    return max(rounds) + 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=None,
+                    help="artifact path (default TPU_CHECKS_r<next>.json)")
+    args = ap.parse_args()
+    out_path = args.out or os.path.join(
+        REPO, f"TPU_CHECKS_r{_next_round():02d}.json")
+
+    import jax
+
+    from scripts import tpu_checks
+
+    backend = jax.default_backend()
+    doc = {"backend": backend,
+           "devices": [str(d) for d in jax.devices()],
+           "checks": {}}
+    failed = False
+    for name, fn in tpu_checks.CHECKS:
+        try:
+            fn()
+            # the pallas checks self-skip off-TPU; record that distinctly
+            if name.startswith("pallas_"):
+                from ceph_tpu.ops import gf8_pallas
+
+                avail = (gf8_pallas.planar_available()
+                         if name == "pallas_planar"
+                         else gf8_pallas.available())
+                doc["checks"][name] = "OK" if avail else "SKIP"
+            else:
+                doc["checks"][name] = "OK"
+        except Exception as e:  # noqa: BLE001 — record, don't crash
+            doc["checks"][name] = f"FAIL: {e!r}"
+            failed = True
+    doc["ok"] = not failed
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+        f.write("\n")
+    print(json.dumps(doc))
+    print(f"wrote {out_path}")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
