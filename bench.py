@@ -25,6 +25,14 @@ r3->r4 "regression" was tunnel noise — where the true device throughput
 is ~50 GB/s.  Numbers from this harness are 10x smaller than r4's and
 are real.
 
+The measured regions are lint-guarded: `scripts/graftlint.py` (rule
+family jax-hygiene, a tier-1 gate) statically rejects host syncs —
+np.asarray/float()/.block_until_ready()/time.* — and tracer branching
+inside every jitted function, scan body, and the step/feedback
+callables handed to `_bench_device_loop`, so the device loop cannot
+silently degrade into per-iteration host round-trips (see
+BENCH_NOTES.md "graftlint guards the device-loop timing trust model").
+
 Baselines (round 4): vs_baseline denominators are MEASURED on this host —
 scripts/cpu_baseline/ implements the reference's SIMD EC kernels
 (gf-complete split-table + isa-l GFNI paths, best-of), its 3-way hardware

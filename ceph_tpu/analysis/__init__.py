@@ -1,0 +1,30 @@
+"""graftlint: whole-repo AST static analysis (the denc/lockdep of this
+port, moved to lint time).
+
+The reference ships correctness tooling that turns latent bugs into loud
+failures (src/common/lockdep.cc, the denc round-trip asserts); our
+runtime half (`ceph_tpu.utils.lockdep`) only fires on orderings a test
+happens to execute.  This package finds the same bug classes
+structurally, before anything runs:
+
+- ``lockgraph``     lock-order graph extraction over every ``DepLock``
+                    nesting; merged with the runtime lockdep edges the
+                    whole-program graph must stay acyclic.
+- ``jax_hygiene``   host syncs / tracer leaks inside jitted code and
+                    the bench device loops (the timing trust model).
+- ``symmetry``      encode/decode field symmetry for wire structs and
+                    codec plans (the denc analog).
+- ``asyncio_rules`` blocking calls inside ``async def`` and bare
+                    ``asyncio.Lock`` in cluster/ escaping lockdep.
+
+`engine.run_lint` drives the rules over a file set; `baseline` carries
+per-finding suppressions so accepted pre-existing findings don't block
+the tier-1 gate while anything NEW fails loudly.
+"""
+
+from ceph_tpu.analysis.engine import (  # noqa: F401
+    Finding, Report, run_lint, last_report, default_paths,
+)
+from ceph_tpu.analysis.baseline import (  # noqa: F401
+    load_baseline, write_baseline,
+)

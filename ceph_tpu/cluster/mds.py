@@ -39,7 +39,7 @@ from ceph_tpu.cluster.messenger import (
     EntityName,
     Messenger,
 )
-from ceph_tpu.utils import Config, PerfCounters
+from ceph_tpu.utils import Config, DepLock, PerfCounters
 
 JOURNAL_OID = "mds_journal.0"   # rank 0 (kept name: store compat)
 SUBTREE_OID = "mds_subtrees"    # omap {dir path: owner rank} (auth table)
@@ -124,7 +124,7 @@ class MDSDaemon(Dispatcher):
             "this MDS rank's identity")
         self._client = None               # our own RADOS client
         self.fs: Optional[FileSystem] = None
-        self._lock = asyncio.Lock()       # the single-MDS big lock
+        self._lock = DepLock("mds.big_lock")  # the single-MDS big lock
         self._tasks: List[asyncio.Task] = []
         self._stopped = False
         self.lease_ttl = self.config.mds_lease_ttl

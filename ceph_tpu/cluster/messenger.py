@@ -43,6 +43,8 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ceph_tpu.utils.lockdep import DepLock
+
 Addr = Tuple[str, int]
 
 _SID = itertools.count(1)
@@ -123,7 +125,10 @@ class _Session:
         self.seq = 0
         self.unacked: "OrderedDict[int, bytes]" = OrderedDict()
         self.overflowed = False
-        self.lock = asyncio.Lock()
+        # unique attribute name on purpose: graftlint's static lock
+        # resolver binds attr -> lock name, and PGState already owns
+        # the bare attr `lock`
+        self.order_lock = DepLock("messenger.session")
 
     def buffer(self, seq: int, frame: bytes) -> None:
         self.unacked[seq] = frame
@@ -150,7 +155,7 @@ class Connection:
         self.writer = writer
         self.peer = peer
         self.peer_addr = peer_addr
-        self._send_lock = asyncio.Lock()
+        self._send_lock = DepLock("messenger.conn_send")
         self._seq = 0
         self.closed = False
         # cephx session state (set by the authorizer handshake):
@@ -538,7 +543,7 @@ class Messenger:
         sess = self._sessions.get(addr)
         if sess is None:
             sess = self._sessions[addr] = _Session()
-        async with sess.lock:
+        async with sess.order_lock:
             sess.seq += 1
             msg.src = self.name
             msg.seq = sess.seq

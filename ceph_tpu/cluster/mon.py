@@ -37,7 +37,7 @@ from ceph_tpu.osdmap.osdmap import (
     POOL_TYPE_ERASURE,
     POOL_TYPE_REPLICATED,
 )
-from ceph_tpu.utils import Config, PerfCounters
+from ceph_tpu.utils import Config, DepLock, PerfCounters
 
 
 class Monitor(Dispatcher):
@@ -100,7 +100,7 @@ class Monitor(Dispatcher):
         self.paxos = None
         self.is_leader = n_mons == 1
         self.leader_rank: Optional[int] = 0 if n_mons == 1 else None
-        self._map_mutex = asyncio.Lock()
+        self._map_mutex = DepLock("mon.map_mutex")
         self._lease_task: Optional[asyncio.Task] = None
         self._last_lease = 0.0
         self._fwd: Dict[int, Tuple[Connection, int]] = {}

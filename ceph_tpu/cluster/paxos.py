@@ -28,6 +28,7 @@ import pickle
 from typing import Awaitable, Callable, Dict, List, Optional, Tuple
 
 from ceph_tpu.cluster import messages as M
+from ceph_tpu.utils.lockdep import DepLock
 
 
 class Elector:
@@ -184,7 +185,7 @@ class Paxos:
         self.leading = False
         self.active = False               # collect finished, may propose
         self.quorum: List[int] = []
-        self._propose_lock = asyncio.Lock()
+        self._propose_lock = DepLock("paxos.propose")
         self._round_waiter: Optional[asyncio.Future] = None
         self._round_acks: set = set()
         self._round_key: Tuple = ()

@@ -19,6 +19,7 @@ from ceph_tpu.cluster import pglog
 from ceph_tpu.cluster.pglog import LogEntry, PGInfo, PGLog
 from ceph_tpu.cluster.store import Transaction
 from ceph_tpu.osdmap.osdmap import PGid, ceph_stable_mod
+from ceph_tpu.utils.lockdep import DepLock
 
 # the client reqid whose op vector is currently executing (set around
 # _execute_client_ops by the mutation-dedup wrapper); _log_mutation stamps
@@ -53,8 +54,12 @@ class PGState:
     log: PGLog = field(default_factory=PGLog)
     # per-PG op serialization domain (reference PG lock / ShardedOpWQ,
     # src/osd/OSD.h:1599): mutations hold this across their whole
-    # fan-out so concurrent writes order identically on all replicas
-    lock: asyncio.Lock = field(default_factory=asyncio.Lock)
+    # fan-out so concurrent writes order identically on all replicas.
+    # DepLock so orderings against the daemon/messenger locks enter the
+    # lockdep graphs; all PGs share one name — per-task nesting of two
+    # PG locks is self-ordering lockdep cannot model, and the reference
+    # likewise registers one lockdep id per lock NAME
+    lock: DepLock = field(default_factory=lambda: DepLock("pg.lock"))
     # reqid -> cached replies of completed mutations (reference pg_log
     # dup tracking, osd_pg_log_dups_tracked): a resent non-idempotent op
     # (exec, delete, ...) returns its original reply instead of

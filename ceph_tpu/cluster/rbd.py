@@ -19,6 +19,8 @@ import pickle
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
+from ceph_tpu.utils.lockdep import DepLock
+
 from ceph_tpu.cluster.objecter import IoCtx
 from ceph_tpu.cluster.striper import (
     FileLayout,
@@ -269,7 +271,7 @@ class Image:
             for obj_off, blob in parts])
 
     async def _copyup(self, oid: str, objno: int) -> None:
-        lock = self._copyup_locks.setdefault(objno, asyncio.Lock())
+        lock = self._copyup_locks.setdefault(objno, DepLock("rbd.copyup"))
         async with lock:
             try:
                 await self._io.stat(oid)

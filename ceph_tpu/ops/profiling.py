@@ -71,6 +71,14 @@ def device_loop_slope(step, feedback, data, repeats: int = 3,
     ``(t_L2 - t_L1) / (L2 - L1)``.  Returns (median, best, worst)
     across conservative pairings of the repeat samples; ``tag`` also
     tincs the median into KERNELS as ``t_<tag>``.
+
+    Lint contract: graftlint's jax-hygiene rule treats the ``step`` and
+    ``feedback`` callables passed to THIS FUNCTION (matched by the
+    names ``device_loop_slope`` / ``_bench_device_loop``) as traced
+    code and statically rejects host syncs inside them — the measured
+    region's timing trust model (BENCH_NOTES.md).  Renaming this
+    function requires updating analysis/jax_hygiene.py or coverage is
+    silently lost.
     """
     import jax
     import numpy as np

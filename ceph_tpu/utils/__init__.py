@@ -2,6 +2,7 @@
 
 from ceph_tpu.utils.admin_socket import AdminSocket  # noqa: F401
 from ceph_tpu.utils.config import Config, Option  # noqa: F401
+from ceph_tpu.utils.lockdep import DepLock, LockCycleError, LockDep  # noqa: F401
 from ceph_tpu.utils.perf import (  # noqa: F401
     KERNELS,
     PerfCounters,

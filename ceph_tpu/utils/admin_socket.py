@@ -78,3 +78,47 @@ class AdminSocket:
                 "injectargs",
                 lambda cmd: config.injectargs(cmd.get("args", {})),
                 "runtime config mutation")
+        self.register("lockdep dump", _lockdep_dump,
+                      "dump the observed runtime lock-ordering graph")
+        self.register("graftlint report", _graftlint_report,
+                      "last static-analysis summary (lint runs on "
+                      "first request)")
+
+
+def _lockdep_dump(cmd):
+    """The live runtime lock graph; feed it to `scripts/graftlint.py
+    --runtime-edges` to merge with the static graph."""
+    from ceph_tpu.utils.lockdep import LockDep
+
+    return LockDep.instance().dump()
+
+
+async def _graftlint_report(cmd):
+    """The cached graftlint summary; a live cluster's first request (or
+    cmd={"refresh": true}) runs the whole-repo lint — pure AST walking,
+    but ~seconds of CPU over 150+ files, so it runs in an executor: the
+    daemon's event loop must keep serving heartbeats/ops meanwhile
+    (stalling it would be exactly the asyncio-blocking bug class this
+    subsystem lints for)."""
+    import asyncio
+
+    from ceph_tpu import analysis
+
+    loop = asyncio.get_event_loop()
+    if cmd.get("refresh"):
+        from ceph_tpu.analysis.baseline import default_baseline_path, \
+            load_baseline
+        from ceph_tpu.utils.lockdep import LockDep
+
+        # a refresh also folds the CURRENT runtime edges into the
+        # merged-graph acyclicity check
+        baseline = load_baseline(default_baseline_path())
+        edges = LockDep.instance().dump()["edges"]
+        report = await loop.run_in_executor(
+            None, lambda: analysis.run_lint(baseline=baseline,
+                                            runtime_edges=edges))
+        return report.summary()
+    cached = analysis.last_report(run_if_missing=False)
+    if cached is not None:
+        return cached
+    return await loop.run_in_executor(None, analysis.last_report)

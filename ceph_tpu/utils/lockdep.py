@@ -64,6 +64,18 @@ class LockDep:
     def reset(self) -> None:
         self.edges.clear()
 
+    def dump(self) -> Dict[str, object]:
+        """The observed runtime lock graph, JSON-shaped for the admin
+        socket (`lockdep dump`) and for merging into graftlint's static
+        graph (scripts/graftlint.py --runtime-edges)."""
+        return {
+            "edges": {h: sorted(nxt) for h, nxt in sorted(self.edges.items())},
+            "locks": sorted(set(self.edges) |
+                            {n for nxt in self.edges.values() for n in nxt}),
+            "held": {str(k): list(v) for k, v in DepLock._held.items() if v},
+            "enabled": self.enabled,
+        }
+
 
 class DepLock:
     """An asyncio.Lock with lockdep tracking (named, per-task held set)."""
@@ -88,8 +100,13 @@ class DepLock:
     async def __aexit__(self, *exc):
         key = self._task_key()
         held = DepLock._held.get(key, [])
-        if self.name in held:
-            held.remove(self.name)
+        # pop the MOST RECENT occurrence: releases unwind LIFO, and
+        # list.remove would drop the first (outermost) entry, corrupting
+        # the per-task stack whenever same-named locks nest
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == self.name:
+                del held[i]
+                break
         if not held:
             DepLock._held.pop(key, None)
         self._lock.release()

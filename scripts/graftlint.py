@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""graftlint CLI: whole-repo static analysis gate.
+
+    python scripts/graftlint.py                 # lint the repo, text report
+    python scripts/graftlint.py --json          # machine-readable report
+    python scripts/graftlint.py --dot locks.dot # export the lock graph
+    python scripts/graftlint.py --write-baseline  # accept current findings
+    python scripts/graftlint.py --runtime-edges dump.json  # merge a live
+        cluster's `lockdep dump` edges into the static lock graph
+
+Exit status: 0 when every finding is baselined (or none fire), 1
+otherwise — tier-1 runs this over the repo and fails on anything new.
+
+Pure AST analysis: no jax import, no device, safe under
+JAX_PLATFORMS=cpu and on machines with no accelerator at all.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ceph_tpu.analysis import baseline as baseline_mod  # noqa: E402
+from ceph_tpu.analysis import engine  # noqa: E402
+from ceph_tpu.analysis import lockgraph  # noqa: E402
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="ceph_tpu static analysis")
+    ap.add_argument("paths", nargs="*",
+                    help="files to lint (default: the whole repo)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit the full report as JSON")
+    ap.add_argument("--baseline",
+                    default=baseline_mod.default_baseline_path(),
+                    help="suppression baseline file (default: "
+                         "GRAFTLINT_BASELINE.json at the repo root)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report every finding")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept every current finding into --baseline "
+                         "and exit 0")
+    ap.add_argument("--runtime-edges", metavar="JSON",
+                    help="a `lockdep dump` JSON file (or raw edges "
+                         "mapping) to merge into the static lock graph")
+    ap.add_argument("--dot", metavar="FILE",
+                    help="write the merged lock-order graph as DOT")
+    args = ap.parse_args(argv)
+
+    runtime_edges = None
+    if args.runtime_edges:
+        with open(args.runtime_edges, encoding="utf-8") as f:
+            doc = json.load(f)
+        runtime_edges = doc.get("edges", doc)
+
+    baseline = set()
+    if not args.no_baseline and not args.write_baseline:
+        baseline = baseline_mod.load_baseline(args.baseline)
+
+    report = engine.run_lint(paths=args.paths or None,
+                             baseline=baseline,
+                             runtime_edges=runtime_edges)
+
+    if args.dot:
+        # the lockgraph rule already extracted the edges during run_lint
+        static_edges = report.static_edges_raw or {}
+        cycle = (report.lock_graph or {}).get("cycle")
+        with open(args.dot, "w", encoding="utf-8") as f:
+            f.write(lockgraph.to_dot(static_edges, runtime_edges or {},
+                                     cycle))
+            f.write("\n")
+        print(f"lock graph written to {args.dot}", file=sys.stderr)
+
+    if args.write_baseline:
+        n = baseline_mod.write_baseline(args.baseline, report.findings)
+        print(f"baseline written: {n} suppression(s) -> {args.baseline}")
+        return 0
+
+    if args.as_json:
+        print(engine.dump_report_json(report))
+    else:
+        print(report.render_text())
+    return 0 if report.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -31,3 +31,20 @@ jax.config.update(
 jax.config.update(
     "jax_persistent_cache_min_entry_size_bytes",
     int(os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"]))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_reset():
+    """Reset the global lockdep state between tests: ordering edges are
+    process-wide, so without this a (legitimate) A->B order learned in
+    one test poisons a (legitimate) B->A order in the next into a false
+    cycle; stale held entries from a crashed task would do the same."""
+    from ceph_tpu.utils.lockdep import DepLock, LockDep
+
+    LockDep.instance().reset()
+    DepLock._held.clear()
+    yield
+    LockDep.instance().reset()
+    DepLock._held.clear()

@@ -1,0 +1,19 @@
+"""Event-loop stalls + a lock invisible to lockdep.  The module path
+trick: the engine lints this file AS IF it lived under cluster/ via an
+explicit path in the test (the Lock rule is cluster-scoped)."""
+
+import asyncio
+import subprocess
+import time
+
+
+class Daemon:
+    def __init__(self):
+        self.big_lock = asyncio.Lock()  # invisible to lockdep
+
+    async def tick(self):
+        time.sleep(0.1)  # stalls every op in flight
+        with open("/tmp/x", "rb") as f:  # sync IO on the loop
+            data = f.read()
+        subprocess.run(["true"])  # blocks until the child exits
+        return data

@@ -1,0 +1,21 @@
+"""Clean async daemon code: async sleep, named DepLock, sync IO kept
+in sync helpers."""
+
+import asyncio
+
+from ceph_tpu.utils.lockdep import DepLock
+
+
+class Daemon:
+    def __init__(self):
+        self.big_lock = DepLock("corpus.daemon")
+
+    def _load(self, path):
+        # sync helper: blocking IO before the loop starts is fine
+        with open(path, "rb") as f:
+            return f.read()
+
+    async def tick(self):
+        async with self.big_lock:
+            await asyncio.sleep(0.1)
+            return 1

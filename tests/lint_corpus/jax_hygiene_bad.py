@@ -1,0 +1,44 @@
+"""Every tracer/host-sync violation family: host materialization of a
+traced value, wall-clock at trace time, host sync in a scan body,
+Python branching on a tracer, and module-scope device compute."""
+
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+DEVICE_TABLE = jnp.arange(256)  # traces + compiles at import
+
+
+@jax.jit
+def bad_asarray(x):
+    return np.asarray(x).sum()  # host materialization of a tracer
+
+
+@jax.jit
+def bad_float(x):
+    return float(x) * 2.0  # scalar coercion forces a host sync
+
+
+@jax.jit
+def bad_clock(x):
+    t0 = time.perf_counter()  # runs at TRACE time, not per step
+    return x + t0
+
+
+@jax.jit
+def bad_branch(x):
+    if x > 0:  # Python branch on a tracer
+        return x
+    return -x
+
+
+def bad_scan_body(data):
+    def body(d, _):
+        d.block_until_ready()  # host sync inside the device loop
+        return d, ()
+
+    d, _ = jax.lax.scan(body, data, None, length=8)
+    return d

@@ -1,0 +1,41 @@
+"""Clean device code: static args host-computed, lax control flow,
+host tables built with np at module scope (host-side is fine)."""
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+HOST_TABLE = np.arange(256, dtype=np.uint8)  # np at module scope: host
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def scale(x, k):
+    # k is static: a host int; np on it is host work at trace time
+    table = np.asarray([k] * 4, dtype=np.uint8)
+    if k > 2:  # static branch: resolved at trace time
+        return x * jnp.asarray(table)[0]
+    return x
+
+
+@jax.jit
+def clamp(x):
+    # shape/dtype inspection is static under trace; lax.cond for the
+    # tracer-valued decision
+    if x.ndim != 1:
+        raise ValueError("1-D only")
+    return jax.lax.cond(jnp.all(x > 0), lambda v: v, lambda v: -v, x)
+
+
+def loop(step, data):
+    @jax.jit
+    def run(d0):
+        def body(d, _):
+            return step(d), ()
+
+        d, _ = jax.lax.scan(body, d0, None, length=8)
+        return d
+
+    return run(data)

@@ -1,0 +1,20 @@
+"""Inverted lock ordering across two functions: the classic latent
+deadlock runtime lockdep only catches when BOTH paths happen to run."""
+
+from ceph_tpu.utils.lockdep import DepLock
+
+
+class Daemon:
+    def __init__(self):
+        self.map_lock = DepLock("corpus.A")
+        self.io_lock = DepLock("corpus.B")
+
+    async def update(self):
+        async with self.map_lock:      # A -> B
+            async with self.io_lock:
+                return 1
+
+    async def flush(self):
+        async with self.io_lock:       # B -> A: cycle
+            async with self.map_lock:
+                return 2

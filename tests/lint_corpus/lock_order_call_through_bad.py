@@ -1,0 +1,26 @@
+"""Interprocedural inversion: neither function nests two ``async
+with`` directly — the cycle only exists through the awaited call."""
+
+from ceph_tpu.utils.lockdep import DepLock
+
+
+class Daemon:
+    def __init__(self):
+        self.map_lock = DepLock("corpus.CT_A")
+        self.io_lock = DepLock("corpus.CT_B")
+
+    async def _write(self):
+        async with self.io_lock:
+            return 1
+
+    async def _remap(self):
+        async with self.map_lock:
+            return 2
+
+    async def update(self):
+        async with self.map_lock:
+            return await self._write()     # A -> B
+
+    async def flush(self):
+        async with self.io_lock:
+            return await self._remap()     # B -> A: cycle

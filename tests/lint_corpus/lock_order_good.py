@@ -1,0 +1,19 @@
+"""Consistent lock ordering: A before B everywhere — no cycle."""
+
+from ceph_tpu.utils.lockdep import DepLock
+
+
+class Daemon:
+    def __init__(self):
+        self.map_lock = DepLock("corpus.A")
+        self.io_lock = DepLock("corpus.B")
+
+    async def update(self):
+        async with self.map_lock:
+            async with self.io_lock:
+                return 1
+
+    async def flush(self):
+        async with self.map_lock:
+            async with self.io_lock:
+                return 2

@@ -1,0 +1,60 @@
+"""Asymmetric codecs: an encoded field the decoder forgets, a
+non-monotonic version guard, a guard past struct_v, a message class
+the decoder cannot rebuild, and a default-less wire field."""
+
+import pickle
+import struct
+from dataclasses import dataclass
+
+
+class Message:  # stand-in base
+    pass
+
+
+@dataclass
+class MBad(Message):
+    epoch: int = 0
+    blob: bytes  # no default: an older peer omitting it breaks decode
+
+
+@dataclass
+class MOrphan(Message):
+    tid: int = 0
+
+
+class HitSet:
+    struct_v = 2
+
+    def __init__(self):
+        self.bits = b""
+        self.count = 0
+        self.stamp = 0.0
+
+    def encode(self) -> bytes:
+        # writes bits, count AND stamp...
+        return pickle.dumps((self.bits, self.count, self.stamp))
+
+    @classmethod
+    def decode(cls, blob, v=2):
+        h = cls()
+        # ...but only restores two of them
+        h.bits, h.count = pickle.loads(blob)[:2]
+        if v >= 3:   # exceeds struct_v=2
+            pass
+        if v >= 1:   # after v>=3: not monotonic
+            pass
+        return h
+
+
+def _encode_frame(msg) -> bytes:
+    if isinstance(msg, MBad):
+        return struct.pack("<I", msg.epoch) + msg.blob
+    if isinstance(msg, MOrphan):
+        return struct.pack("<I", msg.tid)
+    raise TypeError(msg)
+
+
+def _decode_frame(body: bytes):
+    # MBad loses its blob; MOrphan is never reconstructed at all
+    (epoch,) = struct.unpack_from("<I", body)
+    return MBad(epoch=epoch)

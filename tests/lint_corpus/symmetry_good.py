@@ -1,0 +1,48 @@
+"""Symmetric struct codecs: every encoded field decoded, version
+guards monotonic and bounded, wire dataclass fields all defaulted."""
+
+import pickle
+import struct
+from dataclasses import dataclass
+
+
+class Message:  # stand-in base
+    pass
+
+
+@dataclass
+class MGood(Message):
+    epoch: int = 0
+    blob: bytes = b""
+
+
+class HitSet:
+    struct_v = 2
+
+    def __init__(self):
+        self.bits = b""
+        self.count = 0
+
+    def encode(self) -> bytes:
+        return pickle.dumps((self.bits, self.count))
+
+    @classmethod
+    def decode(cls, blob, v=2):
+        h = cls()
+        h.bits, h.count = pickle.loads(blob)
+        if v >= 1:
+            pass
+        if v >= 2:  # monotonic, <= struct_v
+            pass
+        return h
+
+
+def _encode_frame(msg) -> bytes:
+    if isinstance(msg, MGood):
+        return struct.pack("<I", msg.epoch) + msg.blob
+    raise TypeError(msg)
+
+
+def _decode_frame(body: bytes):
+    (epoch,) = struct.unpack_from("<I", body)
+    return MGood(epoch=epoch, blob=body[4:])

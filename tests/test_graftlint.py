@@ -1,0 +1,385 @@
+"""graftlint: the static-analysis tier-1 gate + rule self-tests.
+
+Three layers: (1) the whole repo lints clean against the shipped
+baseline — THE gate every future PR runs for free; (2) each rule
+family fires on its bad-corpus fixture and stays quiet on its good
+twin; (3) the runtime wiring — merged static+runtime lock-graph
+acyclicity, DepLock held-stack bookkeeping, and the lockdep dump /
+graftlint report admin commands.
+"""
+
+import ast
+import asyncio
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ceph_tpu.analysis import baseline as baseline_mod
+from ceph_tpu.analysis import (
+    asyncio_rules, engine, jax_hygiene, lockgraph, symmetry,
+)
+from ceph_tpu.utils.lockdep import DepLock, LockCycleError, LockDep
+
+REPO = engine.repo_root()
+CORPUS = os.path.join(REPO, "tests", "lint_corpus")
+
+
+def corpus(name):
+    return os.path.join(CORPUS, name)
+
+
+def lint_files(rule_mod, *names, relpath_as=None, runtime_edges=None):
+    """Run one rule family over corpus files; relpath_as relabels the
+    single module (the asyncio Lock rule is cluster/-scoped)."""
+    modules, errors = engine.load_modules([corpus(n) for n in names])
+    assert not errors, errors
+    if relpath_as is not None:
+        for m in modules:
+            m.relpath = relpath_as
+    ctx = engine.LintContext(runtime_edges=runtime_edges)
+    return rule_mod.check(modules, ctx), ctx
+
+
+# ---------------------------------------------------------------- the gate
+
+
+def test_repo_lints_clean_with_shipped_baseline():
+    """Tier-1 gate: zero unsuppressed findings over the whole repo, and
+    the merged lock graph is acyclic."""
+    baseline = baseline_mod.load_baseline(
+        baseline_mod.default_baseline_path())
+    report = engine.run_lint(baseline=baseline)
+    assert report.parse_errors == []
+    assert report.findings == [], "\n" + report.render_text()
+    assert report.lock_graph["acyclic"], report.lock_graph
+    # the static pass actually extracted the cluster's lock nestings
+    # (daemon locks order before messenger locks)
+    edges = "\n".join(report.lock_graph["static_edges"])
+    assert "pg.lock -> messenger.session" in edges
+    assert "messenger.session -> messenger.conn_send" in edges
+
+
+def test_cli_exits_zero_on_repo():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "graftlint.py")],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout
+
+
+def test_cli_json_and_dot(tmp_path):
+    import json
+
+    dot = tmp_path / "locks.dot"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "graftlint.py"),
+         "--json", "--dot", str(dot)],
+        capture_output=True, text=True, timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["ok"] is True
+    assert doc["lock_graph"]["acyclic"] is True
+    text = dot.read_text()
+    assert "digraph lock_order" in text
+    assert '"pg.lock" -> "messenger.session"' in text
+
+
+# ------------------------------------------------------- rule: lock-order
+
+
+def test_lock_order_good_clean():
+    findings, _ = lint_files(lockgraph, "lock_order_good.py")
+    assert findings == []
+
+
+def test_lock_order_bad_cycle_detected():
+    findings, ctx = lint_files(lockgraph, "lock_order_bad.py")
+    assert len(findings) == 1
+    assert findings[0].rule == "lock-order"
+    assert "corpus.A" in findings[0].message
+    assert "corpus.B" in findings[0].message
+    assert ctx.lock_graph["acyclic"] is False
+
+
+def test_lock_order_call_through_cycle():
+    """Neither function nests directly; the inversion only exists
+    through the awaited call — the interprocedural pass finds it."""
+    findings, _ = lint_files(lockgraph, "lock_order_call_through_bad.py")
+    assert len(findings) == 1
+    assert "corpus.CT_A" in findings[0].message
+
+
+def test_static_detection_fires_before_any_runtime_acquisition():
+    """Cycle injection: the bad corpus never RUNS — no lock is ever
+    acquired (the runtime lockdep graph stays empty), yet the static
+    pass already reports the deadlock runtime lockdep would only catch
+    after both paths execute."""
+    LockDep.instance().reset()
+    assert LockDep.instance().edges == {}
+    findings, _ = lint_files(lockgraph, "lock_order_bad.py")
+    assert findings, "static analysis must fire with zero runtime edges"
+    assert LockDep.instance().edges == {}  # still nothing ever ran
+
+
+def test_merged_static_plus_runtime_cycle():
+    """A runtime-observed edge closing a static edge into a cycle fails
+    the merged graph: the corpus's GOOD file (A->B only) plus a live
+    B->A edge from the runtime lockdep dump."""
+    findings, ctx = lint_files(lockgraph, "lock_order_good.py",
+                               runtime_edges={"corpus.B": ["corpus.A"]})
+    assert len(findings) == 1
+    assert ctx.lock_graph["acyclic"] is False
+    # and the real LockDep dump shape feeds straight in
+    async def scenario():
+        a, b = DepLock("mg.A"), DepLock("mg.B")
+        async with a:
+            async with b:
+                pass
+
+    asyncio.run(scenario())
+    dump = LockDep.instance().dump()
+    assert dump["edges"] == {"mg.A": ["mg.B"]}
+    succ = lockgraph.merged_graph({}, dump["edges"])
+    assert lockgraph.find_cycle(succ) is None
+    succ = lockgraph.merged_graph({("mg.B", "mg.A"): ("t", 1)},
+                                  dump["edges"])
+    assert lockgraph.find_cycle(succ) is not None
+
+
+# ------------------------------------------------------- rule: jax-hygiene
+
+
+def test_jax_hygiene_good_clean():
+    findings, _ = lint_files(jax_hygiene, "jax_hygiene_good.py")
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_jax_hygiene_bad_all_families_fire():
+    findings, _ = lint_files(jax_hygiene, "jax_hygiene_bad.py")
+    msgs = "\n".join(f.message for f in findings)
+    syms = {f.symbol for f in findings}
+    assert "host materialization" in msgs and "bad_asarray" in syms
+    assert "float" in msgs and "bad_float" in syms
+    assert "wall-clock" in msgs and "bad_clock" in syms
+    assert "branches on traced value" in msgs and "bad_branch" in syms
+    assert "block_until_ready" in msgs  # scan-body host sync
+    assert "module-scope jnp.arange" in msgs  # import-time device work
+
+
+# ----------------------------------------------------- rule: encode-decode
+
+
+def test_symmetry_good_clean():
+    findings, _ = lint_files(symmetry, "symmetry_good.py")
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_symmetry_bad_all_families_fire():
+    findings, _ = lint_files(symmetry, "symmetry_bad.py")
+    msgs = "\n".join(f.message for f in findings)
+    assert "'stamp' is encoded but never restored" in msgs
+    assert "exceeds declared struct_v=2" in msgs
+    assert "not monotonic" in msgs
+    assert "'blob' is encoded but not decoded" in msgs
+    assert "MOrphan is encoded but _decode_frame never constructs" in msgs
+    assert "wire message field 'blob' has no default" in msgs
+
+
+# -------------------------------------------------- rule: asyncio-blocking
+
+
+def test_asyncio_good_clean():
+    findings, _ = lint_files(
+        asyncio_rules, "asyncio_good.py",
+        relpath_as="ceph_tpu/cluster/asyncio_good.py")
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_asyncio_bad_fires():
+    findings, _ = lint_files(
+        asyncio_rules, "asyncio_bad.py",
+        relpath_as="ceph_tpu/cluster/asyncio_bad.py")
+    msgs = "\n".join(f.message for f in findings)
+    assert "time.sleep" in msgs
+    assert "open()" in msgs
+    assert "subprocess.run" in msgs
+    assert "bare asyncio.Lock() escapes lockdep" in msgs
+
+
+# ------------------------------------------------------- runtime wiring
+
+
+def test_deplock_aexit_pops_most_recent():
+    """The held-list fix: same-named locks nesting must unwind LIFO.
+    list.remove dropped the FIRST occurrence, so the survivor entry was
+    the inner one — harmless per-element but corrupting once order
+    matters to anything walking the stack.  Cycle DETECTION is disabled
+    for the scenario (same-name re-acquisition through a second
+    instance is itself a lockdep edge cycle); only the held-stack
+    bookkeeping is under test here."""
+
+    async def scenario():
+        outer, mid, inner = DepLock("dl.A"), DepLock("dl.B"), DepLock("dl.A")
+        async with outer:
+            async with mid:
+                key = id(asyncio.current_task())
+                async with inner:
+                    assert DepLock._held[key] == ["dl.A", "dl.B", "dl.A"]
+                # the INNER dl.A must be the one popped
+                assert DepLock._held[key] == ["dl.A", "dl.B"]
+            assert DepLock._held[key] == ["dl.A"]
+        assert key not in DepLock._held
+
+    LockDep.instance().enabled = False
+    try:
+        asyncio.run(scenario())
+    finally:
+        LockDep.instance().enabled = True
+
+
+def test_lockdep_fixture_isolate_between_tests_a():
+    """With the autouse reset fixture, an A->B order learned here must
+    not leak into the next test (which takes B->A legitimately)."""
+
+    async def scenario():
+        async with DepLock("iso.A"):
+            async with DepLock("iso.B"):
+                pass
+
+    asyncio.run(scenario())
+    assert "iso.A" in LockDep.instance().edges
+
+
+def test_lockdep_fixture_isolate_between_tests_b():
+    assert "iso.A" not in LockDep.instance().edges  # fixture wiped it
+
+    async def scenario():
+        async with DepLock("iso.B"):
+            async with DepLock("iso.A"):  # would cycle without the reset
+                pass
+
+    asyncio.run(scenario())
+
+
+def test_admin_socket_lockdep_dump_and_graftlint_report():
+    """`ceph daemon <name> lockdep dump` / `graftlint report` (router
+    from PR 1): the observed lock graph and the last lint summary are
+    servable from every daemon's AdminSocket."""
+    from ceph_tpu.utils.admin_socket import AdminSocket
+    from ceph_tpu.utils.perf import PerfCounters
+
+    async def scenario():
+        asok = AdminSocket()
+        asok.register_common(PerfCounters("t"))
+        async with DepLock("asok.A"):
+            async with DepLock("asok.B"):
+                pass
+        rc, dump = await asok.dispatch({"prefix": "lockdep dump"})
+        assert rc == 0
+        assert dump["edges"] == {"asok.A": ["asok.B"]}
+        rc, rep = await asok.dispatch({"prefix": "graftlint report"})
+        assert rc == 0
+        assert rep["ok"] is True
+        assert rep["files_checked"] > 100
+        assert rep["lock_graph"]["acyclic"] is True
+
+    asyncio.run(scenario())
+
+
+def test_runtime_lockdep_still_catches_dynamic_cycles():
+    """The static pass complements — not replaces — runtime lockdep."""
+
+    async def scenario():
+        a, b = DepLock("rt.A"), DepLock("rt.B")
+        async with a:
+            async with b:
+                pass
+        with pytest.raises(LockCycleError):
+            async with b:
+                async with a:
+                    pass
+
+    asyncio.run(scenario())
+
+
+def test_baseline_roundtrip(tmp_path):
+    f = engine.Finding(rule="r", path="p.py", line=3, symbol="s",
+                       message="m")
+    path = tmp_path / "b.json"
+    n = baseline_mod.write_baseline(str(path), [f])
+    assert n == 1
+    keys = baseline_mod.load_baseline(str(path))
+    assert f.baseline_key in keys
+    # line drift does not invalidate the suppression
+    f2 = engine.Finding(rule="r", path="p.py", line=99, symbol="s",
+                        message="m")
+    assert f2.baseline_key in keys
+
+
+def test_pragma_suppression(tmp_path):
+    src = (
+        "import asyncio\n"
+        "import time\n"
+        "async def tick():\n"
+        "    # graftlint: ignore[asyncio-blocking]\n"
+        "    time.sleep(1)\n")
+    p = tmp_path / "prag.py"
+    p.write_text(src)
+    report = engine.run_lint(paths=[str(p)],
+                             rules=[asyncio_rules], root=str(tmp_path))
+    assert report.findings == []
+    p.write_text(src.replace("    # graftlint: ignore"
+                             "[asyncio-blocking]\n", ""))
+    report = engine.run_lint(paths=[str(p)],
+                             rules=[asyncio_rules], root=str(tmp_path))
+    assert len(report.findings) == 1
+
+
+def test_static_argnames_params_are_static(tmp_path):
+    """`static_argnames` (the string idiom) must exempt those params
+    exactly like `static_argnums` — correct JAX code must not fail the
+    gate."""
+    src = (
+        "import functools\n"
+        "import jax\n"
+        "@functools.partial(jax.jit, static_argnames=('w',))\n"
+        "def f(x, w):\n"
+        "    if w == 8:\n"
+        "        return x\n"
+        "    return x + w\n")
+    p = tmp_path / "argnames.py"
+    p.write_text(src)
+    report = engine.run_lint(paths=[str(p)], rules=[jax_hygiene],
+                             root=str(tmp_path))
+    assert report.findings == [], [f.render() for f in report.findings]
+
+
+def test_subset_lint_does_not_poison_report_cache(tmp_path):
+    """last_report (the `graftlint report` admin payload) must never
+    serve a subset lint as the repo's state."""
+    whole = engine.run_lint(baseline=baseline_mod.load_baseline(
+        baseline_mod.default_baseline_path()))
+    assert whole.ok
+    bad = tmp_path / "bad.py"
+    bad.write_text("import time\nasync def t():\n    time.sleep(1)\n")
+    subset = engine.run_lint(paths=[str(bad)], rules=[asyncio_rules],
+                             root=str(tmp_path))
+    assert not subset.ok
+    cached = engine.last_report(run_if_missing=False)
+    assert cached is not None
+    assert cached["ok"] is True  # still the whole-repo report
+    assert cached["files_checked"] == whole.files_checked
+
+
+def test_stale_baseline_reported(tmp_path):
+    p = tmp_path / "clean.py"
+    p.write_text("x = 1\n")
+    report = engine.run_lint(paths=[str(p)], rules=[asyncio_rules],
+                             baseline={"ghost::entry::s::m"},
+                             root=str(tmp_path))
+    assert report.ok
+    assert report.stale_baseline == ["ghost::entry::s::m"]

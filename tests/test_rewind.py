@@ -35,6 +35,7 @@ def _shard_crc(osd, coll, oid):
     return crcmod.crc32c(0xFFFFFFFF, bytes(data))
 
 
+@contention_retry()
 def test_ec_partial_write_rolls_back():
     """Primary applies its shard + log entry but the sub-writes never
     reach the replicas (crash mid-write).  Peering must elect the
