@@ -1,0 +1,37 @@
+"""graft-chaos: deterministic fault injection for the mini-cluster.
+
+Four injector families behind the existing config/admin-socket seams —
+net (messenger interposition), disk (store-level faults), daemons
+(kill/revive/restart), clock (per-daemon skewable time) — plus a
+declarative scenario runner that interleaves workload with seeded fault
+schedules and judges durability invariants after convergence.  Every
+random decision derives from per-injector streams of one seed
+(chaos/rng.py), so a failing run replays bit-identically from
+``--seed``; with every injector disabled the hot paths pay a single
+``is None`` test (``chaos report`` / ``chaos_total()`` prove it).
+"""
+
+from ceph_tpu.chaos.clock import ChaosClock  # noqa: F401
+from ceph_tpu.chaos.counters import (  # noqa: F401
+    CHAOS,
+    chaos_report,
+    chaos_total,
+)
+from ceph_tpu.chaos.daemons import (  # noqa: F401
+    DaemonInjector,
+    heal_partitions,
+    partition,
+    zero_rates,
+)
+from ceph_tpu.chaos.disk import DiskInjector  # noqa: F401
+from ceph_tpu.chaos.net import NetInjector, ensure_injector  # noqa: F401
+from ceph_tpu.chaos.rng import derive_seed, stream  # noqa: F401
+from ceph_tpu.chaos.scenario import (  # noqa: F401
+    Event,
+    Scenario,
+    Verdict,
+    build_schedule,
+    builtin_scenarios,
+    ev,
+    run_scenario,
+)

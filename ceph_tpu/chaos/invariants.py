@@ -1,0 +1,208 @@
+"""Durability invariants checked after a chaos scenario converges.
+
+The post-conditions that make a fault schedule a TEST instead of a
+demolition derby (reference: teuthology's thrasher final checks +
+``wait_for_clean``):
+
+- ``durability``: every ACKED write reads back bit-identical and
+  checksum-clean (crc32c of the read bytes matches the crc recorded at
+  ack time).  ``attempted`` mode (for mid-write primary kills) accepts
+  any WHOLE payload ever submitted for the object — a timed-out write
+  may legitimately land after its client gave up (at-least-once), but
+  torn or mixed-generation bytes never pass.
+- ``health``: the cluster reaches HEALTH_OK (no down/out OSDs, no
+  slow-op warnings, nothing full).
+- ``acting``: no PG is stuck — every PG has a primary and a full acting
+  set, and every primary's ``last_complete`` has caught up to
+  ``last_update`` (peering finished, nothing left degraded).
+- ``snapshots``: every snapshot reads back the contents recorded at
+  snap time.
+- ``scrub``: a full scrub pass over every primary PG finds zero
+  unrepaired inconsistencies (silent divergence / bit-rot is caught and
+  fixed, EC shards repair through decode).
+- ``lockdep``: the runtime lock-order graph stayed acyclic under the
+  fault schedule.
+
+Each check returns a list of human-readable failure strings (empty =
+invariant holds); the scenario runner aggregates them into the verdict.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional
+
+from ceph_tpu.crush.types import CRUSH_ITEM_NONE
+from ceph_tpu.ops import crc32c as crcmod
+
+
+def _crc(data: bytes) -> int:
+    return crcmod.crc32c(0xFFFFFFFF, bytes(data))
+
+
+async def check_durability(io, acked: Dict[str, bytes],
+                           attempted: Optional[Dict[str, set]] = None,
+                           mode: str = "acked",
+                           acked_crcs: Optional[Dict[str, int]] = None,
+                           timeout: float = 60.0) -> List[str]:
+    failures: List[str] = []
+    loop = asyncio.get_event_loop()
+    overall = loop.time() + timeout
+    for oid, data in sorted(acked.items()):
+        want = {data} if mode == "acked" else \
+            set((attempted or {}).get(oid, ())) | {data}
+        got, err = None, None
+        # retry to the shared deadline, but guarantee EVERY object a
+        # minimum retry window: recovery may still be rewriting the last
+        # objects checked, and a shared budget eaten by the first ones
+        # would judge them on a single mid-recovery read
+        deadline = max(overall, loop.time() + min(15.0, timeout))
+        while asyncio.get_event_loop().time() < deadline:
+            try:
+                got = await io.read(oid, timeout=30)
+                err = None
+            except (IOError, OSError, TimeoutError) as e:
+                err = e
+                await asyncio.sleep(0.5)
+                continue
+            if got in want:
+                break
+            await asyncio.sleep(0.5)
+        if err is not None:
+            failures.append(f"durability: {oid} unreadable: {err!r}")
+        elif got is None:
+            failures.append(f"durability: {oid} never read back before "
+                            "the deadline")
+        elif got not in want:
+            failures.append(
+                f"durability: {oid} holds torn/unknown bytes "
+                f"{got[:24]!r}... != acked {data[:24]!r}...")
+        elif got == data and acked_crcs and \
+                _crc(got) != acked_crcs.get(oid, _crc(data)):
+            failures.append(f"durability: {oid} crc diverged from the "
+                            "crc recorded at ack time")
+    return failures
+
+
+async def check_health(cluster, timeout: float = 30.0) -> List[str]:
+    deadline = asyncio.get_event_loop().time() + timeout
+    health = {}
+    while asyncio.get_event_loop().time() < deadline:
+        health = cluster.mon._health_data()
+        if health["status"] == "HEALTH_OK":
+            return []
+        await asyncio.sleep(0.25)
+    return [f"health: {health.get('status')} {health.get('checks')}"]
+
+
+async def check_acting(cluster, timeout: float = 30.0) -> List[str]:
+    deadline = asyncio.get_event_loop().time() + timeout
+    failures: List[str] = []
+    while asyncio.get_event_loop().time() < deadline:
+        failures = _acting_once(cluster)
+        if not failures:
+            return []
+        await asyncio.sleep(0.25)
+    return failures
+
+
+def _acting_once(cluster) -> List[str]:
+    from ceph_tpu.osdmap.osdmap import PGid
+
+    failures: List[str] = []
+    m = cluster.mon.osdmap
+    for pool_id, pool in m.pools.items():
+        want = pool.size
+        for seed in range(pool.pg_num):
+            pgid = PGid(pool_id, seed)
+            _, _, acting, primary = m.pg_to_up_acting_osds(pgid)
+            live = [o for o in acting if o != CRUSH_ITEM_NONE]
+            if primary < 0:
+                failures.append(f"acting: pg {pgid} has no primary")
+            elif len(live) < want:
+                failures.append(
+                    f"acting: pg {pgid} undersized {live} < {want}")
+            else:
+                posd = cluster.osds.get(primary)
+                st = posd.pgs.get(pgid) if posd else None
+                if st is not None and st.last_complete < st.last_update:
+                    failures.append(
+                        f"acting: pg {pgid} incomplete "
+                        f"({st.last_complete} < {st.last_update})")
+    return failures
+
+
+async def check_snapshots(io, snaps: Dict[int, Dict[str, bytes]],
+                          timeout: float = 60.0) -> List[str]:
+    failures: List[str] = []
+    loop = asyncio.get_event_loop()
+    overall = loop.time() + timeout
+    for sid, objs in sorted(snaps.items()):
+        for oid, data in sorted(objs.items()):
+            got = None
+            deadline = max(overall, loop.time() + min(10.0, timeout))
+            while asyncio.get_event_loop().time() < deadline:
+                try:
+                    got = await io.read(oid, snapid=sid, timeout=30)
+                except (IOError, OSError, TimeoutError):
+                    await asyncio.sleep(0.5)
+                    continue
+                if got == data:
+                    break
+                await asyncio.sleep(0.5)
+            if got != data:
+                failures.append(
+                    f"snapshots: {oid}@snap{sid} diverged "
+                    f"(got {None if got is None else got[:24]!r})")
+    return failures
+
+
+async def check_scrub(cluster, timeout: float = 90.0) -> List[str]:
+    deadline = asyncio.get_event_loop().time() + timeout
+    bad: List[str] = []
+    while True:
+        bad = []
+        for osd in list(cluster.osds.values()):
+            for st in list(osd.pgs.values()):
+                if st.primary != osd.osd_id:
+                    continue
+                try:
+                    rep = await osd.scrub_pg(st)
+                except Exception as e:
+                    bad.append(f"scrub: pg {st.pgid} errored: {e!r}")
+                    continue
+                bad.extend(f"scrub: {oid} inconsistent in {st.pgid}"
+                           for oid in rep["inconsistent"]
+                           if oid not in rep["repaired"])
+        if not bad or asyncio.get_event_loop().time() > deadline:
+            break
+        await asyncio.sleep(1.0)
+    return bad
+
+
+def check_lockdep() -> List[str]:
+    """The observed runtime lock graph must be acyclic (the same graph
+    `lockdep dump` serves and graftlint merges)."""
+    from ceph_tpu.utils.lockdep import LockDep
+
+    edges = LockDep.instance().dump()["edges"]
+    state: Dict[str, int] = {}
+
+    def dfs(node, path):
+        state[node] = 1
+        for nxt in edges.get(node, ()):
+            if state.get(nxt) == 1:
+                return path + [nxt]
+            if state.get(nxt) is None:
+                cyc = dfs(nxt, path + [nxt])
+                if cyc:
+                    return cyc
+        state[node] = 2
+        return None
+
+    for node in edges:
+        if state.get(node) is None:
+            cyc = dfs(node, [node])
+            if cyc:
+                return [f"lockdep: cycle {' -> '.join(cyc)}"]
+    return []

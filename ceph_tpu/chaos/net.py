@@ -1,0 +1,176 @@
+"""Net injector: messenger-level fault interposition.
+
+The analog of the reference's ``ms_inject_socket_failures`` /
+``ms_inject_delay_*`` debug options (src/msg/Messenger.h): a messenger
+whose config carries nonzero ``chaos_net_*`` rates owns a ``NetInjector``
+that decides, per outgoing session frame, whether to drop, duplicate,
+delay, reorder, or follow up with a session reset — plus an asymmetric
+partition set that makes chosen peers unreachable from THIS endpoint
+only (``A -> B`` blocked while ``B -> A`` flows, the classic one-way
+link failure).
+
+Semantics ride the messenger's own reliability machinery rather than
+bypassing it: a dropped frame stays in the session's unacked replay
+buffer, so it is re-delivered when a later failure forces a
+reconnect+replay — exactly a lost packet under retransmission.  A
+partitioned connect raises ``ConnectionError`` like a refused TCP
+connection, which drives monclient hunting, heartbeat failure reports,
+and session replay in the real code paths.
+
+Disabled proof: a messenger with all rates zero and no partitions has
+``messenger.chaos is None`` — the hot send path pays one ``is None``
+test and nothing else.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Set, Tuple
+
+Addr = Tuple[str, int]
+
+# the config options this injector is built from (messenger observers
+# rebuild on any of these)
+CONFIG_FIELDS = (
+    "chaos_net_drop", "chaos_net_dup", "chaos_net_delay",
+    "chaos_net_delay_prob", "chaos_net_reorder", "chaos_net_reset",
+    "chaos_net_partition",
+)
+
+
+@dataclass
+class FrameFate:
+    """Per-frame decision vector (computed once, before the wire)."""
+
+    drop: bool = False
+    retransmit: float = 0.0  # drop only: session replay fires after this
+    dup: bool = False
+    delay: float = 0.0
+    reorder: float = 0.0     # >0: defer the frame by this many seconds
+    reset: bool = False
+
+
+def parse_partitions(spec: str) -> Set[Addr]:
+    """``"host:port,host:port"`` -> addr set (the injectargs encoding of
+    a partition; scenarios resolve daemon names to addrs first)."""
+    out: Set[Addr] = set()
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        host, _, port = part.rpartition(":")
+        out.add((host, int(port)))
+    return out
+
+
+class NetInjector:
+    def __init__(self, rng, drop: float = 0.0, dup: float = 0.0,
+                 delay: float = 0.0, delay_prob: float = 0.0,
+                 reorder: float = 0.0, reset: float = 0.0,
+                 partitions: Optional[Set[Addr]] = None):
+        self.rng = rng
+        self.drop = drop
+        self.dup = dup
+        self.delay = delay
+        self.delay_prob = delay_prob
+        self.reorder = reorder
+        self.reset = reset
+        self.partitions: Set[Addr] = set(partitions or ())
+
+    @classmethod
+    def from_config(cls, config, name: str,
+                    keep_partitions: Optional[Set[Addr]] = None
+                    ) -> Optional["NetInjector"]:
+        """Build from a daemon's chaos_net_* options; ``None`` when every
+        rate is zero and no partition is configured (the provable-no-op
+        state).  ``keep_partitions`` preserves programmatically-added
+        partitions across an injectargs-triggered rebuild."""
+        from ceph_tpu.chaos.rng import stream
+
+        parts = parse_partitions(config.chaos_net_partition)
+        if keep_partitions:
+            parts |= keep_partitions
+        rates = (config.chaos_net_drop, config.chaos_net_dup,
+                 config.chaos_net_delay_prob, config.chaos_net_reorder,
+                 config.chaos_net_reset)
+        if not any(rates) and not parts:
+            return None
+        return cls(stream(config.chaos_seed, f"net:{name}"),
+                   drop=config.chaos_net_drop, dup=config.chaos_net_dup,
+                   delay=config.chaos_net_delay,
+                   delay_prob=config.chaos_net_delay_prob,
+                   reorder=config.chaos_net_reorder,
+                   reset=config.chaos_net_reset, partitions=parts)
+
+    # -- partition management (scenario runner API) -------------------------
+
+    def partition(self, *addrs: Addr) -> None:
+        self.partitions.update(tuple(a) for a in addrs)
+
+    def heal(self, *addrs: Addr) -> None:
+        """Heal specific peers, or everything when called bare."""
+        if addrs:
+            self.partitions.difference_update(tuple(a) for a in addrs)
+        else:
+            self.partitions.clear()
+
+    def partitioned(self, addr: Addr) -> bool:
+        return tuple(addr) in self.partitions
+
+    # -- messenger hooks ----------------------------------------------------
+
+    def check_connect(self, addr: Addr) -> None:
+        """Raises like a refused/blackholed TCP connect when the peer is
+        behind a partition (called from Messenger.connect)."""
+        if self.partitions and tuple(addr) in self.partitions:
+            from ceph_tpu.chaos.counters import CHAOS
+
+            CHAOS.inc("net_partition_blocks")
+            raise ConnectionError(f"chaos: partition blocks {addr}")
+
+    def on_frame(self, addr: Addr) -> FrameFate:
+        """Decide this frame's fate; counters tick at decision time.
+        Each enabled fault family consumes its own rng draws, so
+        disabling one family never shifts another's stream."""
+        from ceph_tpu.chaos.counters import CHAOS
+
+        fate = FrameFate()
+        rng = self.rng
+        if self.drop and rng.random() < self.drop:
+            fate.drop = True
+            # the retransmission timer: the messenger schedules a
+            # session replay after this, so loss is transient on a
+            # healthy net and real under a partition
+            fate.retransmit = rng.uniform(0.02, 0.2)
+            CHAOS.inc("net_drops")
+            return fate                  # a dropped frame has no other fate
+        if self.delay_prob and rng.random() < self.delay_prob:
+            fate.delay = rng.uniform(0.0, self.delay or 0.05)
+            CHAOS.inc("net_delays")
+        if self.reorder and rng.random() < self.reorder:
+            fate.reorder = rng.uniform(0.005, max(0.01, self.delay or 0.05))
+            CHAOS.inc("net_reorders")
+            return fate                  # deferred: dup/reset don't stack
+        if self.dup and rng.random() < self.dup:
+            fate.dup = True
+            CHAOS.inc("net_dups")
+        if self.reset and rng.random() < self.reset:
+            fate.reset = True
+            CHAOS.inc("net_resets")
+        return fate
+
+
+def ensure_injector(messenger) -> NetInjector:
+    """The scenario runner's handle on a daemon messenger: returns the
+    live injector, creating an all-zero-rate one (for partition-only
+    scenarios) when chaos is currently disabled."""
+    if messenger.chaos is None:
+        from ceph_tpu.chaos.rng import stream
+
+        seed = 0
+        cfg = getattr(messenger, "config", None)
+        if cfg is not None:
+            seed = cfg.chaos_seed
+        messenger.chaos = NetInjector(
+            stream(seed, f"net:{messenger.name}"))
+    return messenger.chaos

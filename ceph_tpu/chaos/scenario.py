@@ -1,0 +1,531 @@
+"""Declarative chaos scenarios: seeded fault schedules over a workload.
+
+The teuthology-thrasher analog, made deterministic: a ``Scenario``
+declares a cluster shape, a write workload, a list of fault ``Event``s
+pinned to workload rounds, and the invariants that must hold after
+convergence.  ``build_schedule(scenario, seed)`` resolves every random
+choice (victims, partition halves, skew magnitudes) from the seed's
+``schedule`` stream — so the same ``--seed`` produces a bit-identical
+fault schedule, and a failure run replays exactly.
+
+Run shape::
+
+    for each round:            # rounds interleave workload and faults
+        apply this round's events (mid-write events race a write burst)
+        write the round's objects, recording acked payload + crc
+        snapshot (optional)
+    heal everything            # zero rates, drop partitions, revive dead
+    wait for convergence       # all OSDs up, epoch settled
+    check invariants           # chaos/invariants.py
+    -> Verdict
+
+Event actions:
+
+====================  ======================================================
+``kill_osd``          hard-stop an OSD (store lost, like a dead host)
+``crash_osd``         power-cut stop; FileStore/BlueStore may tear or lose
+                      the journal tail (``torn_tail`` / ``lose_frames``)
+``revive_osd``        bring a downed OSD back (crash victims keep their
+                      store and replay; kill victims boot empty)
+``restart_osd``       bounce keeping the store (delta-resync via pg log)
+``net``               set chaos_net_* rates on the target daemon(s)
+``disk``              set chaos_disk_* rates on the target daemon(s)
+``clock_skew``        skew the target daemon's time source (seconds)
+``partition``         split the OSDs into two halves (or explicit sides)
+``heal_partition``    drop every partition edge
+``bitrot``            flip one stored bit of one acked object replica
+====================  ======================================================
+
+Targets: ``osd.N`` / ``mon.N`` pin a daemon; ``random_osd`` resolves
+from the schedule stream (never dropping live OSDs below the pool
+size); ``random_down_osd`` picks a dead one; ``all_osds`` / ``cluster``
+fan out.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ceph_tpu.chaos import invariants as inv
+from ceph_tpu.chaos.counters import CHAOS
+from ceph_tpu.chaos.daemons import (
+    DaemonInjector,
+    heal_partitions,
+    partition,
+    zero_rates,
+)
+from ceph_tpu.chaos.disk import DiskInjector
+from ceph_tpu.chaos.rng import stream
+from ceph_tpu.ops import crc32c as crcmod
+
+
+@dataclass(frozen=True)
+class Event:
+    round: int
+    action: str
+    target: str = "random_osd"
+    args: Tuple[Tuple[str, object], ...] = ()
+    during_writes: bool = False
+    # apply AFTER the round's writes land (corruption events: a
+    # pre-write bitrot on a reused oid would just be overwritten, and
+    # the scrub invariant would pass without ever seeing a flipped bit)
+    after_writes: bool = False
+
+    def arg(self, key: str, default=None):
+        return dict(self.args).get(key, default)
+
+
+def ev(round: int, action: str, target: str = "random_osd",
+       during_writes: bool = False, after_writes: bool = False,
+       **args) -> Event:
+    """Sugar: ``ev(1, "crash_osd", torn_tail=True)``."""
+    return Event(round=round, action=action, target=target,
+                 during_writes=during_writes, after_writes=after_writes,
+                 args=tuple(sorted(args.items())))
+
+
+@dataclass(frozen=True)
+class Scenario:
+    name: str
+    osds: int = 3
+    pool_kind: str = "replicated"            # "replicated" | "erasure"
+    pool_size: int = 3
+    pg_num: int = 8
+    ec_profile: Optional[Tuple[Tuple[str, str], ...]] = None
+    rounds: int = 3
+    objects_per_round: int = 6
+    payload_repeat: int = 60
+    snapshots: bool = False
+    events: Tuple[Event, ...] = ()
+    invariants: Tuple[str, ...] = ("durability", "acting", "health",
+                                   "lockdep")
+    durability_mode: str = "acked"           # "acked" | "attempted"
+    store: str = "mem"                       # "mem" | "file" | "blue"
+    config: Tuple[Tuple[str, object], ...] = ()
+    write_timeout: float = 60.0
+    converge_timeout: float = 60.0
+
+
+@dataclass
+class Verdict:
+    name: str
+    seed: int
+    schedule: List[Dict]
+    passed: bool
+    failures: List[str]
+    acked_objects: int = 0
+    counters: Dict[str, int] = field(default_factory=dict)
+
+    def replay_key(self) -> Tuple:
+        """The parts of a verdict that must be identical across two runs
+        of the same seed: the resolved fault schedule and the outcome.
+        (Raw counters are wire-level and vary with async timing.)"""
+        sched = tuple(tuple(sorted(e.items())) for e in self.schedule)
+        return (self.name, self.seed, sched, self.passed,
+                tuple(sorted(self.failures)))
+
+    def as_dict(self) -> Dict:
+        return {"name": self.name, "seed": self.seed,
+                "passed": self.passed, "failures": self.failures,
+                "acked_objects": self.acked_objects,
+                "schedule": self.schedule, "counters": self.counters}
+
+
+# --------------------------------------------------------------- schedule
+
+
+def build_schedule(scenario: Scenario, seed: int) -> List[Dict]:
+    """Resolve every event to a concrete, seed-deterministic plan.
+    Victim picks track which OSDs the plan has already killed so a
+    ``revive_osd`` targets an actually-dead daemon and kills never plan
+    to drop live OSDs below the pool size."""
+    rng = stream(seed, "schedule")
+    alive = set(range(scenario.osds))
+    dead: List[int] = []
+    plan: List[Dict] = []
+    for i, e in enumerate(sorted(scenario.events,
+                                 key=lambda e: (e.round,))):
+        entry: Dict = {"round": e.round, "action": e.action,
+                       "during_writes": e.during_writes,
+                       "after_writes": e.after_writes,
+                       "args": dict(e.args)}
+        target = e.target
+        if e.action in ("kill_osd", "crash_osd", "restart_osd"):
+            if target == "random_osd":
+                floor = scenario.pool_size if e.action != "restart_osd" \
+                    else 1
+                pool = sorted(alive)
+                if e.action != "restart_osd" and len(pool) <= floor:
+                    continue            # plan refuses to wedge the pool
+                target = f"osd.{rng.choice(pool)}"
+            osd_id = int(target.split(".")[1])
+            if e.action != "restart_osd":
+                alive.discard(osd_id)
+                dead.append(osd_id)
+        elif e.action == "revive_osd":
+            if target in ("random_osd", "random_down_osd"):
+                if not dead:
+                    continue
+                target = f"osd.{rng.choice(sorted(dead))}"
+            osd_id = int(target.split(".")[1])
+            if osd_id in dead:
+                dead.remove(osd_id)
+            alive.add(osd_id)
+        elif e.action == "partition":
+            if not e.arg("a"):
+                half = sorted(rng.sample(sorted(alive),
+                                         max(1, len(alive) // 2)))
+                rest = sorted(alive - set(half))
+                entry["args"]["a"] = [f"osd.{o}" for o in half]
+                entry["args"]["b"] = [f"osd.{o}" for o in rest]
+            target = "cluster"
+        elif e.action == "clock_skew":
+            if target == "random_osd":
+                target = f"osd.{rng.choice(sorted(alive))}"
+            if entry["args"].get("skew") is None:
+                entry["args"]["skew"] = round(rng.uniform(-2.0, 2.0), 3)
+        elif e.action in ("net", "disk"):
+            if target == "random_osd":
+                target = f"osd.{rng.choice(sorted(alive))}"
+        elif e.action == "bitrot":
+            # victim object/osd resolve at apply time (needs the live
+            # acked set); the pick still comes from the seeded stream
+            target = target if target != "random_osd" else "runtime"
+        entry["target"] = target
+        entry["seq"] = i
+        plan.append(entry)
+    return plan
+
+
+# --------------------------------------------------------------- running
+
+
+def _payload(rng, oid: str, gen: int, repeat: int) -> bytes:
+    tag = f"{oid}-g{gen}-{rng.randrange(1 << 30)}-"
+    return tag.encode() * repeat
+
+
+def _store_factory(scenario: Scenario, tmpdir: Optional[str]):
+    if scenario.store == "mem":
+        return None
+    import os
+
+    from ceph_tpu.cluster.bluestore import BlueStore
+    from ceph_tpu.cluster.filestore import FileStore
+
+    def factory(osd_id: int):
+        path = os.path.join(tmpdir, f"osd{osd_id}")
+        if scenario.store == "file":
+            return FileStore(path, checkpoint_every=64)
+        return BlueStore(path, size=64 << 20, checkpoint_every=64)
+
+    return factory
+
+
+async def run_scenario(scenario: Scenario, seed: int,
+                       tmpdir: Optional[str] = None) -> Verdict:
+    """Boot, thrash, heal, converge, judge.  Pure asyncio — callers
+    wrap with ``asyncio.run`` (or the CLI does)."""
+    from ceph_tpu.cluster.vstart import _fast_config, start_cluster
+
+    schedule = build_schedule(scenario, seed)
+    wl = stream(seed, "workload")
+    rot = stream(seed, "bitrot")
+    cfg = _fast_config()
+    cfg.mon_osd_down_out_interval = 120.0    # scenarios bounce, not drain
+    cfg.chaos_seed = seed
+    for k, v in scenario.config:
+        cfg.set(k, v)
+    counters0 = dict(CHAOS.dump()["chaos"])
+    cluster = await start_cluster(
+        scenario.osds, config=cfg,
+        store_factory=_store_factory(scenario, tmpdir))
+    dmn = DaemonInjector(cluster)
+    acked: Dict[str, bytes] = {}
+    acked_crcs: Dict[str, int] = {}
+    attempted: Dict[str, set] = {}
+    snaps: Dict[int, Dict[str, bytes]] = {}
+    failures: List[str] = []
+    try:
+        client = await cluster.client()
+        if scenario.pool_kind == "erasure":
+            pool = await client.pool_create(
+                f"chaos_{scenario.name}"[:24], "erasure",
+                pg_num=scenario.pg_num,
+                ec_profile=dict(scenario.ec_profile or ()))
+        else:
+            pool = await client.pool_create(
+                f"chaos_{scenario.name}"[:24], "replicated",
+                pg_num=scenario.pg_num, size=scenario.pool_size)
+        io = client.ioctx(pool)
+
+        async def put(i: int, gen: int, timeout: float) -> None:
+            oid = f"obj{i}"
+            data = _payload(wl, oid, gen, scenario.payload_repeat)
+            attempted.setdefault(oid, set()).add(data)
+            try:
+                await io.write_full(oid, data, timeout=timeout)
+                acked[oid] = data
+                acked_crcs[oid] = crcmod.crc32c(0xFFFFFFFF, data)
+            except (IOError, OSError, TimeoutError):
+                pass
+
+        for rnd in range(scenario.rounds):
+            evs = [e for e in schedule if e["round"] == rnd]
+            for e in [e for e in evs if not e["during_writes"]
+                      and not e.get("after_writes")]:
+                await _apply_event(cluster, dmn, client, io, e, rot,
+                                   acked, pool)
+            mid = [e for e in evs if e["during_writes"]]
+            if mid:
+                burst = asyncio.gather(
+                    *[put(i, rnd, timeout=20.0)
+                      for i in range(scenario.objects_per_round)],
+                    return_exceptions=True)
+                await asyncio.sleep(wl.random() * 0.05)
+                for e in mid:
+                    await _apply_event(cluster, dmn, client, io, e, rot,
+                                       acked, pool)
+                await burst
+            else:
+                for i in range(scenario.objects_per_round):
+                    await put(i, rnd, timeout=scenario.write_timeout)
+            for e in [e for e in evs if e.get("after_writes")]:
+                await _apply_event(cluster, dmn, client, io, e, rot,
+                                   acked, pool)
+            if scenario.snapshots:
+                sid = await io.snap_create(f"chaos_s{rnd}")
+                snaps[sid] = dict(acked)
+
+        # -- heal: scenarios must converge fault-free -------------------
+        zero_rates(cluster)
+        for osd_id in sorted(set(cluster.osd_configs) -
+                             set(cluster.osds)):
+            await dmn.revive_osd(osd_id,
+                                 with_store=osd_id in cluster.osd_stores)
+        await _converge(cluster, scenario.converge_timeout)
+
+        # -- invariants -------------------------------------------------
+        for name in scenario.invariants:
+            if name == "durability":
+                failures += await inv.check_durability(
+                    io, acked, attempted=attempted,
+                    mode=scenario.durability_mode,
+                    acked_crcs=acked_crcs,
+                    timeout=scenario.converge_timeout)
+            elif name == "health":
+                failures += await inv.check_health(
+                    cluster, timeout=scenario.converge_timeout)
+            elif name == "acting":
+                failures += await inv.check_acting(
+                    cluster, timeout=scenario.converge_timeout)
+            elif name == "snapshots":
+                failures += await inv.check_snapshots(
+                    io, snaps, timeout=scenario.converge_timeout)
+            elif name == "scrub":
+                failures += await inv.check_scrub(
+                    cluster, timeout=scenario.converge_timeout * 1.5)
+            elif name == "lockdep":
+                failures += inv.check_lockdep()
+            else:
+                failures.append(f"unknown invariant {name!r}")
+    finally:
+        await cluster.stop()
+    counters1 = CHAOS.dump()["chaos"]
+    delta = {k: counters1[k] - counters0.get(k, 0) for k in counters1
+             if counters1[k] - counters0.get(k, 0)}
+    return Verdict(name=scenario.name, seed=seed, schedule=schedule,
+                   passed=not failures, failures=failures,
+                   acked_objects=len(acked), counters=delta)
+
+
+async def _apply_event(cluster, dmn: DaemonInjector, client, io,
+                       e: Dict, rot, acked: Dict[str, bytes],
+                       pool: int) -> None:
+    action, target, args = e["action"], e["target"], e["args"]
+    if action == "kill_osd":
+        osd_id = int(target.split(".")[1])
+        if osd_id in cluster.osds:
+            await dmn.kill_osd(osd_id)
+    elif action == "crash_osd":
+        osd_id = int(target.split(".")[1])
+        if osd_id in cluster.osds:
+            await dmn.crash_osd(osd_id,
+                                torn_tail=bool(args.get("torn_tail")),
+                                lose_frames=int(args.get("lose_frames",
+                                                         0)))
+    elif action == "revive_osd":
+        osd_id = int(target.split(".")[1])
+        if osd_id not in cluster.osds:
+            await dmn.revive_osd(
+                osd_id, with_store=osd_id in cluster.osd_stores)
+    elif action == "restart_osd":
+        osd_id = int(target.split(".")[1])
+        if osd_id in cluster.osds:
+            await dmn.restart_osd(osd_id)
+    elif action in ("net", "disk"):
+        for cfg in _target_configs(cluster, target):
+            cfg.injectargs({k: v for k, v in args.items()
+                            if k.startswith("chaos_")})
+    elif action == "clock_skew":
+        for cfg in _target_configs(cluster, target):
+            cfg.injectargs({"chaos_clock_skew": args["skew"]})
+    elif action == "partition":
+        partition(cluster, list(args["a"]), list(args["b"]),
+                  symmetric=bool(args.get("symmetric", True)))
+    elif action == "heal_partition":
+        heal_partitions(cluster)
+    elif action == "bitrot":
+        await _apply_bitrot(cluster, client, e, rot, acked, pool)
+    else:
+        raise ValueError(f"unknown chaos action {action!r}")
+
+
+def _target_configs(cluster, target: str):
+    if target in ("all_osds", "cluster"):
+        for o in cluster.osds.values():
+            yield o.config
+        if target == "cluster":
+            for m in cluster.mons:
+                yield m.config
+    elif target.startswith("osd."):
+        osd = cluster.osds.get(int(target.split(".")[1]))
+        if osd is not None:
+            yield osd.config
+    elif target.startswith("mon"):
+        _, _, num = target.partition(".")
+        rank = int(num) if num else 0
+        if rank < len(cluster.mons):
+            yield cluster.mons[rank].config
+    elif target == "client":
+        for c in cluster.clients:
+            yield c.objecter.config
+
+
+async def _apply_bitrot(cluster, client, e: Dict, rot,
+                        acked: Dict[str, bytes], pool: int) -> None:
+    """Flip one bit of one acked object on one acting member, straight
+    into the store behind the OSD's back — silent corruption that only
+    scrub (or a csum-verifying read) can see."""
+    if not acked:
+        return
+    oid = rot.choice(sorted(acked))
+    pgid = client.objecter.object_pgid(pool, oid)
+    coll = f"pg_{pgid.pool}_{pgid.seed}"
+    _, _, acting, _ = client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+    live = [o for o in acting if o >= 0 and o in cluster.osds]
+    if not live:
+        return
+    victim = rot.choice(live)
+    inj = DiskInjector(rot)
+    try:
+        inj.flip_bit(cluster.osds[victim].store, coll, oid,
+                     bit=e["args"].get("bit"))
+    except (FileNotFoundError, ValueError):
+        pass
+
+
+async def _converge(cluster, timeout: float) -> None:
+    """All OSDs up in the mon map and every daemon caught up to the
+    epoch (best-effort: invariants do the real judging)."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    n = cluster.mon.osdmap.max_osd
+    while asyncio.get_event_loop().time() < deadline:
+        if all(cluster.mon.osdmap.osd_up[o] for o in range(n)):
+            break
+        await asyncio.sleep(0.1)
+    try:
+        await cluster.wait_for_epoch(cluster.mon.osdmap.epoch,
+                                     timeout=max(
+                                         1.0, deadline -
+                                         asyncio.get_event_loop().time()))
+    except TimeoutError:
+        pass
+
+
+# --------------------------------------------------------------- builtins
+
+
+def builtin_scenarios() -> Dict[str, Scenario]:
+    """The shipped scenario library (scripts/chaos.py `list`)."""
+    return {
+        # tier-1 smoke: one OSD killed and revived under 10% drop
+        "smoke": Scenario(
+            name="smoke", osds=4, pool_size=3, pg_num=4, rounds=2,
+            objects_per_round=4, payload_repeat=20,
+            events=(
+                ev(0, "net", target="all_osds", chaos_net_drop=0.10),
+                ev(0, "kill_osd"),
+                ev(1, "revive_osd"),
+            ),
+            invariants=("durability", "acting", "health", "lockdep"),
+            converge_timeout=45.0),
+        # the acceptance gate: partition + kill + torn-write journal
+        "partition-kill-torn": Scenario(
+            name="partition-kill-torn", osds=5, pool_size=3, pg_num=8,
+            rounds=3, objects_per_round=5, store="file",
+            events=(
+                ev(0, "partition"),
+                ev(1, "heal_partition"),
+                ev(1, "crash_osd", torn_tail=True),
+                ev(2, "revive_osd"),
+            ),
+            invariants=("durability", "acting", "health", "scrub",
+                        "lockdep"),
+            converge_timeout=90.0),
+        # per-daemon clock skew vs heartbeats/leases
+        "clock-skew": Scenario(
+            name="clock-skew", osds=3, pool_size=3, pg_num=4, rounds=2,
+            objects_per_round=4,
+            events=(
+                ev(0, "clock_skew"),
+                ev(1, "clock_skew", skew=0.0),
+            ),
+            invariants=("durability", "acting", "health", "lockdep"),
+            converge_timeout=45.0),
+        # silent bit-rot found and repaired by scrub
+        "bitrot-scrub": Scenario(
+            name="bitrot-scrub", osds=3, pool_size=3, pg_num=4,
+            rounds=2, objects_per_round=4,
+            # after_writes: the flip must land on bytes nothing will
+            # overwrite again, or scrub has nothing real to find.
+            # scrub runs FIRST: it must repair the flip (majority
+            # authoritative copy) before durability reads the object —
+            # a read routed to the corrupt replica would otherwise fail
+            # the run that scrub was about to heal
+            events=(ev(1, "bitrot", after_writes=True),),
+            invariants=("scrub", "durability", "acting", "health",
+                        "lockdep"),
+            converge_timeout=60.0),
+        # replicated thrash: restart bounces under load, snapshots on
+        "thrash-replicated": Scenario(
+            name="thrash-replicated", osds=5, pool_size=3, pg_num=8,
+            rounds=4, objects_per_round=8, snapshots=True,
+            events=(
+                ev(0, "restart_osd"),
+                ev(1, "restart_osd"),
+                ev(2, "restart_osd"),
+                ev(3, "restart_osd"),
+            ),
+            invariants=("durability", "snapshots", "acting", "health",
+                        "scrub", "lockdep"),
+            converge_timeout=60.0),
+        # EC primaries crashed mid-write (the rewind thrasher)
+        "thrash-ec-midwrite": Scenario(
+            name="thrash-ec-midwrite", osds=4, pool_kind="erasure",
+            pg_num=4,
+            ec_profile=(("plugin", "jerasure"),
+                        ("technique", "reed_sol_van"),
+                        ("k", "2"), ("m", "1")),
+            rounds=3, objects_per_round=4, durability_mode="attempted",
+            events=(
+                ev(0, "restart_osd", during_writes=True),
+                ev(1, "restart_osd", during_writes=True),
+                ev(2, "restart_osd", during_writes=True),
+            ),
+            invariants=("durability", "scrub", "acting", "health",
+                        "lockdep"),
+            converge_timeout=90.0),
+    }

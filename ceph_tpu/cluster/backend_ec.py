@@ -18,6 +18,20 @@ from ceph_tpu.ops import crc32c as crcmod
 from ceph_tpu.osdmap.osdmap import PGid, PGPool
 
 
+class ECSizeMismatch(Exception):
+    """The chosen decode group's object size disagrees with the size the
+    caller assumed from its LOCAL shard attrs — the local shard is a
+    stale generation (e.g. a primary whose recovery pull never finished).
+    Carries the group's size so the caller can recompute the stripe
+    range and retry against the authoritative generation; mixing group
+    bytes with the local length would serve torn reads (surfaced by
+    graft-chaos: g2 bytes truncated to g1's length)."""
+
+    def __init__(self, size: int):
+        super().__init__(f"decode group size {size}")
+        self.size = size
+
+
 class ECBackendMixin:
 
     def _codec(self, pool: PGPool):
@@ -90,14 +104,35 @@ class ECBackendMixin:
             mark_current("ec_encoded")
         else:
             sa = self.store.getattr(coll, oid, "size")
-            old_size = int(sa) if sa else 0
+            if sa is None:
+                # no local shard (lost, or never held): the committed
+                # size must come from the acting set — merging against
+                # an assumed-empty object would truncate committed bytes
+                _, old_size, _ = await self._gather_shards(
+                    pool, st, oid, codec.get_data_chunk_count(), 0, 0)
+            else:
+                old_size = int(sa)
             off0, len0 = sinfo.offset_len_to_stripe_bounds(offset, len(data))
             chunk_off = sinfo.aligned_logical_offset_to_chunk_offset(off0)
-            old_in_range = max(0, min(old_size - off0, len0))
             old_bytes = b""
-            if old_in_range:
-                old_bytes = await self._ec_read_stripes(
-                    pool, st, oid, chunk_off, old_in_range)
+            for _attempt in range(2):
+                old_in_range = max(0, min(old_size - off0, len0))
+                if not old_in_range:
+                    break
+                try:
+                    old_bytes = await self._ec_read_stripes(
+                        pool, st, oid, chunk_off, old_in_range,
+                        expected_size=old_size)
+                    break
+                except ECSizeMismatch as e:
+                    if _attempt:
+                        # still unstable (write racing recovery): fail
+                        # the op rather than merge against absent bytes
+                        raise IOError(
+                            f"{oid}: object size unstable under RMW")
+                    # stale local size attr: redo the RMW against the
+                    # decode group's (committed) size
+                    old_size, old_bytes = e.size, b""
             merged = stripemod.merge_range(
                 old_bytes, old_in_range, offset - off0, data)
             new_size = max(old_size, offset + len(data))
@@ -131,6 +166,7 @@ class ECBackendMixin:
         entry = self._log_mutation(st, "modify", oid, eversion)
         if peers:
             fut = self._make_waiter(reqid, len(peers))
+            send_failures = 0
             for osd, shard in peers:
                 try:
                     await self._send_osd(osd, M.MOSDECSubOpWrite(
@@ -140,6 +176,7 @@ class ECBackendMixin:
                         pre_ops=pre_ops,
                         epoch=self.osdmap.epoch))
                 except (ConnectionError, OSError, RuntimeError):
+                    send_failures += 1
                     self._waiter_dec(reqid)
             mark_current("ec_sub_write_sent")
             try:
@@ -150,6 +187,17 @@ class ECBackendMixin:
                 return -110
             finally:
                 self._pending.pop(reqid, None)
+            if send_failures:
+                # a shard sub-write never left this host: unlike the
+                # replicated path (full copies, reachable set suffices)
+                # every EC shard is unique, so the stripe is NOT k+m
+                # durable and must not ack — the reference blocks EC
+                # writes until EVERY acting shard commits.  Stay un-acked
+                # (-110): the divergent entry rewinds during peering and
+                # the client retries against the post-peering acting set.
+                # (Surfaced by graft-chaos: a just-restarted primary with
+                # dead peer sessions could ack a 1-shard stripe.)
+                return -110
         # every shard acked: this version can never roll back now
         self._advance_last_complete(st, eversion)
         mark_current("commit")
@@ -227,7 +275,8 @@ class ECBackendMixin:
             self._log_mutation(st, msg.entry.op, msg.entry.oid,
                                msg.entry.version, entry=msg.entry)
         self.perf.inc("osd_ec_sub_writes")
-        await conn.send(M.MOSDECSubOpWriteReply(reqid=msg.reqid, result=0))
+        await self._reply_osd(conn, msg, M.MOSDECSubOpWriteReply(
+            reqid=msg.reqid, result=0))
 
     async def _handle_ec_read(self, conn: Connection,
                               msg: M.MOSDECSubOpRead) -> None:
@@ -254,19 +303,19 @@ class ECBackendMixin:
                 # puller stores a faithful copy
                 hinfo["xattrs"] = dict(self.store.get_xattrs(
                     _coll(msg.pgid), msg.oid))
-            await conn.send(M.MOSDECSubOpReadReply(
+            await self._reply_osd(conn, msg, M.MOSDECSubOpReadReply(
                 reqid=msg.reqid, result=0, shard=shard, data=data,
                 hinfo=hinfo))
             self.perf.inc("osd_ec_sub_reads")
         except (FileNotFoundError, IOError):
-            await conn.send(M.MOSDECSubOpReadReply(
+            await self._reply_osd(conn, msg, M.MOSDECSubOpReadReply(
                 reqid=msg.reqid, result=-2, shard=msg.shard))
 
     async def _gather_shards(
         self, pool: PGPool, st: PGState, oid: str, need_k: int,
         off: int = 0, length: Optional[int] = None,
         exclude_shards: Optional[Set[int]] = None,
-    ) -> Tuple[Dict[int, bytes], int]:
+    ) -> Tuple[Dict[int, bytes], int, int]:
         """Collect >= k shard (ranges) from the acting set (own shard
         free).  ``exclude_shards``: shard ids known corrupt — they must
         never be decode sources (scrub repair would otherwise reconstruct
@@ -280,9 +329,17 @@ class ECBackendMixin:
         got: Dict[int, Tuple[bytes, int, int]] = {}
         my = self.store.stat(_coll(st.pgid), oid)
         if my is not None:
-            data = self.store.read(_coll(st.pgid), oid, off, length)
+            try:
+                data = self.store.read(_coll(st.pgid), oid, off, length)
+            except IOError:
+                # local-shard media error (chaos disk EIO): our own
+                # shard is simply absent from the gather — decode from
+                # peers, mirroring the peer-side missing-shard path in
+                # _handle_ec_read instead of failing the whole read
+                data = None
             shard_attr = self.store.getattr(_coll(st.pgid), oid, "shard")
-            if shard_attr is not None and                     int(shard_attr) not in exclude_shards:
+            if data is not None and shard_attr is not None and \
+                    int(shard_attr) not in exclude_shards:
                 sa = self.store.getattr(_coll(st.pgid), oid, "size")
                 got[int(shard_attr)] = (
                     data,
@@ -354,15 +411,22 @@ class ECBackendMixin:
             raise IOError(
                 f"{oid}: acked version {acked_newest} has only {have} "
                 f"of {need_k} shards; refusing stale read")
+        version = 0
         if chosen is not None:
             v, shards = chosen
+            version = v
             size = max(sz for _, ver, sz in got.values() if ver == v)
-        return shards, size
+        return shards, size, version
 
     async def _ec_read_stripes(self, pool: PGPool, st: PGState, oid: str,
-                               chunk_off: int, logical_len: int) -> bytes:
+                               chunk_off: int, logical_len: int,
+                               expected_size: Optional[int] = None) -> bytes:
         """Read a stripe-aligned logical range: gather the touched chunk
-        range from >= k shards and decode it as a mini-object."""
+        range from >= k shards and decode it as a mini-object.  When the
+        caller computed the range from a size it assumed (its local size
+        attr), pass ``expected_size``: a disagreeing decode group raises
+        ECSizeMismatch BEFORE the under/over-fetch can fail or truncate,
+        so the caller re-ranges against the group's size."""
         from ceph_tpu.ec import stripe as stripemod
         import numpy as np
 
@@ -371,8 +435,10 @@ class ECBackendMixin:
         k = codec.get_data_chunk_count()
         nstripes = sinfo.object_stripes(logical_len)
         chunk_len = nstripes * sinfo.chunk_size
-        shards, _ = await self._gather_shards(
+        shards, gsize, _ = await self._gather_shards(
             pool, st, oid, k, off=chunk_off, length=chunk_len)
+        if expected_size is not None and shards and gsize != expected_size:
+            raise ECSizeMismatch(gsize)
         avail = {s: np.frombuffer(d, dtype=np.uint8)
                  for s, d in shards.items()
                  if len(d) == chunk_len}
@@ -391,24 +457,37 @@ class ECBackendMixin:
         if sa is None:
             # primary lost its shard (or never had one): probe peers
             codec = self._codec(pool)
-            shards, size = await self._gather_shards(
+            shards, size, _ = await self._gather_shards(
                 pool, st, oid, codec.get_data_chunk_count(), 0, 0)
             if not shards and size == 0:
                 raise FileNotFoundError(oid)
         else:
             size = int(sa)
-        if length is None:
-            length = max(0, size - offset)
-        if length == 0 or offset >= size:
-            return b""
-        length = min(length, size - offset)
         codec = self._codec(pool)
         sinfo = self._sinfo(pool, codec)
-        off0, len0 = sinfo.offset_len_to_stripe_bounds(offset, length)
-        len0 = min(len0, max(0, size - off0))
-        chunk_off = sinfo.aligned_logical_offset_to_chunk_offset(off0)
-        out = await self._ec_read_stripes(pool, st, oid, chunk_off, len0)
-        return out[offset - off0: offset - off0 + length]
+        # the object length is a property of the GENERATION being read:
+        # when the decode group disagrees with our local size attr (our
+        # own shard is stale), re-range against the group's size instead
+        # of truncating/overstretching its bytes to the local length
+        for attempt in range(2):
+            want = max(0, size - offset) if length is None else length
+            if want == 0 or offset >= size:
+                return b""
+            want = min(want, size - offset)
+            off0, len0 = sinfo.offset_len_to_stripe_bounds(offset, want)
+            len0 = min(len0, max(0, size - off0))
+            chunk_off = sinfo.aligned_logical_offset_to_chunk_offset(off0)
+            try:
+                out = await self._ec_read_stripes(
+                    pool, st, oid, chunk_off, len0, expected_size=size)
+            except ECSizeMismatch as e:
+                if attempt:
+                    raise IOError(f"{oid}: object size unstable "
+                                  "(write or recovery in flight)")
+                size = e.size
+                continue
+            return out[offset - off0: offset - off0 + want]
+        raise IOError(f"{oid}: unreadable")  # unreachable
 
     async def _recover_ec_object(self, pool: PGPool, st: PGState, oid: str,
                                  targets: Optional[List[int]] = None,
@@ -426,7 +505,7 @@ class ECBackendMixin:
         codec = self._codec(pool)
         sinfo = self._sinfo(pool, codec)
         k = codec.get_data_chunk_count()
-        shards, size = await self._gather_shards(
+        shards, size, group_version = await self._gather_shards(
             pool, st, oid, k, exclude_shards=exclude_sources)
         shard_len = sinfo.shard_size(size)
         avail = {s: np.frombuffer(d, dtype=np.uint8)
@@ -441,8 +520,14 @@ class ECBackendMixin:
         # boundary (round-6 layout contract, ec/planar.py)
         chunks = await self._compute(
             stripemod.reencode_stripes, codec, sinfo, avail, size)
-        version = max((self.store.get_version(_coll(st.pgid), oid)), 1)
+        # stamp the rebuilt shards with the DECODE GROUP's version, not
+        # our local one: a primary whose own shard is newer (or staler)
+        # than the group it decoded from would otherwise relabel old
+        # bytes as new, and a later read could mix generations that
+        # claim the same version (surfaced by graft-chaos as torn reads)
+        version = max(group_version, 1)
         hinfo = {"size": size, "version": version}
+        ok = True
         for shard, osd in enumerate(st.acting):
             if osd == CRUSH_ITEM_NONE:
                 continue
@@ -461,5 +546,7 @@ class ECBackendMixin:
                         epoch=self.osdmap.epoch))
                     self.perf.inc("osd_pushes_sent")
                 except ConnectionError:
-                    pass
-        return True
+                    # target unreachable: the rebuild did NOT land there —
+                    # report incompleteness so the recovery round retries
+                    ok = False
+        return ok

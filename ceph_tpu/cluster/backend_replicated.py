@@ -217,30 +217,32 @@ class ReplicatedBackendMixin:
         return "fail"
 
     async def _push_object(self, pool: PGPool, st: PGState, osd: int,
-                           oid: str, entry: LogEntry) -> None:
-        """Replay one log entry onto a stale member (delta recovery)."""
+                           oid: str, entry: LogEntry) -> bool:
+        """Replay one log entry onto a stale member (delta recovery).
+        Returns False when the push failed (the member stays stale and
+        the recovery round must be retried)."""
         if entry.op == "delete":
             try:
                 await self._send_osd(osd, M.MOSDPGPush(
                     pgid=st.pgid, oid=oid, op="delete",
                     version=entry.version[1], entry=entry))
                 self.perf.inc("osd_pushes_sent")
+                return True
             except ConnectionError:
-                pass
-            return
+                return False
+        ok = True
         if entry.op == "trim" or self._has_snap_state(st, oid):
             # snapshot-bearing object: the logged head mutation implies
             # clone/snapset changes that must travel with it
-            await self._push_snap_state(pool, st, osd, oid)
+            ok = await self._push_snap_state(pool, st, osd, oid)
         if entry.op == "trim":
-            return
+            return ok
         if pool.is_erasure():
-            await self._recover_ec_object(pool, st, oid, targets=[osd],
-                                          entry=entry)
-            return
+            return ok & await self._recover_ec_object(
+                pool, st, oid, targets=[osd], entry=entry)
         coll = _coll(st.pgid)
         if self.store.stat(coll, oid) is None:
-            return
+            return ok  # deleted since: a later entry carries the delete
         data = self.store.read(coll, oid)
         try:
             await self._send_osd(osd, M.MOSDPGPush(
@@ -249,23 +251,50 @@ class ReplicatedBackendMixin:
                 version=entry.version[1], entry=entry))
             self.perf.inc("osd_pushes_sent")
         except ConnectionError:
-            pass
+            ok = False
+        return ok
 
     async def _repull_after_rewind(self, st: PGState, oids) -> None:
         """Re-fetch objects a record-less rewind had to remove, from the
-        acting primary (the instruction sender)."""
+        acting primary (the instruction sender).  Failed pulls retry
+        under capped seeded backoff: this runs on a NON-primary, so the
+        primary-side incomplete-round re-arm (recovery.py
+        _queue_recovery_retry) never covers it — dropping a failure here
+        would leave the shard missing until an unrelated map change."""
         pool = self.osdmap.pools.get(st.pgid.pool)
         if pool is None:
             return
-        for oid in oids:
-            try:
-                if pool.is_erasure():
-                    await self._recover_ec_object(pool, st, oid,
-                                                  targets=[self.osd_id])
-                elif st.primary >= 0 and st.primary != self.osd_id:
-                    await self._pull_rep_object(st, st.primary, oid)
-            except (ConnectionError, OSError, asyncio.TimeoutError):
-                self.perf.inc("osd_recovery_incomplete")
+        from ceph_tpu.chaos.rng import stream
+        from ceph_tpu.utils.backoff import ExpBackoff
+
+        rng = stream(self.config.chaos_seed,
+                     f"repull:osd.{self.osd_id}:{st.pgid}") \
+            if self.config.chaos_seed else None
+        bo = ExpBackoff(base=0.25, cap=3.0, rng=rng)
+        pending = list(oids)
+        for _ in range(6):
+            failed = []
+            for oid in pending:
+                try:
+                    if pool.is_erasure():
+                        ok = await self._recover_ec_object(
+                            pool, st, oid, targets=[self.osd_id])
+                    elif st.primary >= 0 and st.primary != self.osd_id:
+                        ok = await self._pull_rep_object(st, st.primary,
+                                                         oid)
+                    else:
+                        ok = True
+                except (ConnectionError, OSError, asyncio.TimeoutError):
+                    ok = False
+                if not ok:
+                    failed.append(oid)
+                    self.perf.inc("osd_recovery_incomplete")
+            if not failed:
+                return
+            pending = failed
+            if self._stopped or self.pgs.get(st.pgid) is not st:
+                return
+            await asyncio.sleep(bo.next())
 
     def _has_snap_state(self, st: PGState, oid: str) -> bool:
         from ceph_tpu.cluster import snaps as snapmod
@@ -274,30 +303,31 @@ class ReplicatedBackendMixin:
                                   snapmod.snapdir_oid(oid), "ss") is not None
 
     async def _push_snap_state(self, pool: PGPool, st: PGState, osd: int,
-                               head: str) -> None:
+                               head: str) -> bool:
         """Sync one head's snapshot state to a member: the authoritative
         SnapSet (as a snap_sync push — the receiver also deletes clones
         the set no longer lists, covering missed trims) plus every live
-        clone object."""
+        clone object.  Returns False when any push failed."""
         from ceph_tpu.cluster import snaps as snapmod
 
         coll = _coll(st.pgid)
         blob = self.store.getattr(coll, snapmod.snapdir_oid(head), "ss")
         if blob is None:
-            return
+            return True
         try:
             await self._send_osd(osd, M.MOSDPGPush(
                 pgid=st.pgid, oid=head, op="snap_sync", data=blob))
         except ConnectionError:
-            return
+            return False
         ss = snapmod.SnapSet.decode(blob)
+        ok = True
         for c in ss.clones:
             cname = snapmod.clone_oid(head, c)
             if self.store.stat(coll, cname) is None:
                 continue
             if pool.is_erasure():
-                await self._recover_ec_object(pool, st, cname,
-                                              targets=[osd])
+                ok &= await self._recover_ec_object(pool, st, cname,
+                                                    targets=[osd])
             else:
                 try:
                     await self._send_osd(osd, M.MOSDPGPush(
@@ -307,7 +337,8 @@ class ReplicatedBackendMixin:
                         version=self.store.get_version(coll, cname)))
                     self.perf.inc("osd_pushes_sent")
                 except ConnectionError:
-                    pass
+                    ok = False
+        return ok
 
 
     def _handle_push(self, msg: M.MOSDPGPush) -> None:

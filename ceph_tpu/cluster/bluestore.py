@@ -179,6 +179,46 @@ class BlueStore(ObjectStore):
             self._dev = None
             self._mounted = False
 
+    def crash(self, torn_tail: bool = False, lose_frames: int = 0) -> None:
+        """Power-cut stop (chaos disk injector): close WITHOUT the
+        clean kv checkpoint, drop RAM onode state, optionally damage the
+        kv WAL tail (torn frame / lost frames).  mount() then replays
+        checkpoint + surviving WAL over the block device like a machine
+        that lost power mid-write."""
+        from ceph_tpu.cluster.filestore import _damage_journal
+
+        if not self._mounted:
+            return
+        self._wal.close()
+        self._wal = None
+        self._dev.close()
+        self._dev = None
+        self._mounted = False
+        self._onodes = {}
+        self._since_ckpt = 0
+        _damage_journal(self._wal_path, torn_tail, lose_frames)
+
+    def debug_bitrot(self, coll: str, oid: str, bit: int) -> None:
+        """Flip one bit of the object's stored data ON THE DEVICE,
+        leaving the onode csums untouched: the next read of that block
+        raises EIO (the csum-verify path) — silent media corruption
+        exactly as BlueStore meets it."""
+        with self._lock:
+            o = self._onodes.get(coll, {}).get(oid)
+            if o is None or o.size == 0:
+                raise FileNotFoundError(f"{coll}/{oid}")
+            bit %= o.size * 8
+            idx = (bit // 8) // BLOCK
+            blkno = o.blocks[idx]
+            if blkno < 0:
+                raise ValueError(f"{coll}/{oid} block {idx} is a hole")
+            off = (SUPER_BLOCKS + blkno) * BLOCK + (bit // 8) % BLOCK
+            self._dev.seek(off)
+            cur = self._dev.read(1)
+            self._dev.seek(off)
+            self._dev.write(bytes([cur[0] ^ (1 << (bit % 8))]))
+            self._dev.flush()
+
     def checkpoint(self) -> None:
         """Atomic ONODE-kv snapshot + WAL truncate: O(metadata), never
         O(data) — the block device is untouched."""
@@ -224,6 +264,10 @@ class BlueStore(ObjectStore):
     def queue_transaction(self, txn: Transaction) -> None:
         if not self._mounted:
             raise RuntimeError("BlueStore not mounted")
+        if self.chaos is not None:
+            # injected ENOSPC: refuse the whole txn up front, exactly
+            # like the real up-front capacity check below
+            self.chaos.on_write(txn)
         with self._lock:
             # up-front capacity check: a mid-transaction ENOSPC would
             # leave half-applied onode state with no rollback, which the
@@ -245,6 +289,8 @@ class BlueStore(ObjectStore):
         self._since_ckpt += 1
         if self._since_ckpt >= self.checkpoint_every:
             self.checkpoint()
+        if self.chaos is not None:
+            self.chaos.maybe_rot(self, txn)
 
     def _txn_block_cost(self, txn: Transaction) -> int:
         """Worst-case fresh-block demand of a transaction (write ops COW
@@ -444,6 +490,8 @@ class BlueStore(ObjectStore):
 
     def read(self, coll: str, oid: str, offset: int = 0,
              length: Optional[int] = None) -> bytes:
+        if self.chaos is not None:
+            self.chaos.on_read(coll, oid)
         with self._lock:
             o = self._onodes.get(coll, {}).get(oid)
             if o is None:

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import asyncio
 import time
+from collections import deque
 from typing import List, Set
 
 from ceph_tpu.cluster import messages as M
@@ -62,7 +63,52 @@ class ClientOpsMixin:
             self.perf.inc("osd_ops_queued_mclock")
             self._opq_event.set()
             return
-        await self._dispatch_client_op(conn, msg, m, pool, st)
+        # detach execution from the messenger read loop (the reference
+        # never executes ops on the msgr thread — ShardedOpWQ): a
+        # mutation that waits on sub-op acks would otherwise block THIS
+        # connection's dispatch, and when the op's client is another OSD
+        # (tier agent internal_op) the sub-op ack can ride the very
+        # connection the inline dispatch is blocking — a head-of-line
+        # deadlock that only the op timeout unwinds (surfaced by
+        # graft-chaos work: _reply_osd routes sub-op acks over the
+        # lossless session, i.e. the peer's outgoing client connection).
+        # Detached but NOT unordered: ops from one client connection to
+        # one PG execute in arrival order (a pipelined A-then-B must
+        # apply as A then B), so each (conn, pg) gets a FIFO drained by
+        # its own task; different PGs still run in parallel.
+        key = (id(conn), msg.pgid)
+        q = self._ordered_q.get(key)
+        if q is None:
+            q = self._ordered_q[key] = deque()
+        q.append((conn, msg))
+        if key not in self._ordered_active:
+            self._spawn_drainer(key, q)
+
+    def _spawn_drainer(self, key, q) -> None:
+        """Mark the FIFO active and start its drain task, tracked in
+        _opq_running so stop() can cancel it."""
+        self._ordered_active.add(key)
+        t = asyncio.get_event_loop().create_task(
+            self._drain_ordered(key, q))
+        self._opq_running.add(t)
+        t.add_done_callback(self._opq_running.discard)
+
+    async def _drain_ordered(self, key, q) -> None:
+        """Serve one (connection, PG) FIFO to empty, in order.  The
+        empty-check/cleanup below runs with no await in between, so an
+        enqueue can never race the drainer's exit (single event loop)."""
+        try:
+            while q:
+                conn, msg = q.popleft()
+                await self._serve_queued_op(conn, msg)
+        finally:
+            self._ordered_active.discard(key)
+            if q and not self._stopped:
+                # the drainer died mid-queue (cancellation): respawn so
+                # the queued ops are not stranded
+                self._spawn_drainer(key, q)
+            elif self._ordered_q.get(key) is q:
+                del self._ordered_q[key]
 
     async def _opq_drain(self) -> None:
         """Serve the dmClock queue (the ShardedOpWQ dequeue loop): QoS
@@ -188,7 +234,36 @@ class ClientOpsMixin:
         # replicated log entries (reference pg_log_entry_t::reqid dups)
         # and must NOT re-execute — reply success (the recorded effect is
         # applied; per-op out data is not reconstructible from the log).
-        if st.log.has_reqid(reqid):
+        # Durability gate: only entries at-or-below the commit watermark
+        # may dup-ack — a logged-but-un-acked entry (sub-writes lost
+        # around a bounce) can still rewind during peering, and
+        # dup-acking it would bless a write that then vanishes (surfaced
+        # by graft-chaos mid-write restarts).  Above the watermark we
+        # WAIT for peering's verdict rather than guess: if the entry
+        # survives and the watermark catches up (roll-forward) it is
+        # durable — dup-ack; if peering rewound it the effects are
+        # undone — re-execute; if neither resolves in time, -11 sends
+        # the client back for a map refresh + retry (re-executing
+        # blindly would double-apply non-idempotent ops like append).
+        logged = st.log.reqid_version(reqid)
+        if logged is not None and logged > st.last_complete:
+            loop = asyncio.get_event_loop()
+            # wait only HALF the client's own attempt window: the -11
+            # retry hint must reach a waiter that hasn't already timed
+            # out and resent, or every unresolved resend burns a full
+            # timeout before learning anything
+            deadline = loop.time() + self.config.osd_client_op_timeout / 2
+            while (loop.time() < deadline
+                   and st.log.reqid_version(reqid) is not None
+                   and st.last_complete < logged):
+                await asyncio.sleep(0.05)
+            logged = st.log.reqid_version(reqid)
+            if logged is not None and logged > st.last_complete:
+                top.mark("dup_unresolved_retry")
+                await conn.send(M.MOSDOpReply(
+                    reqid=msg.reqid, result=-11, epoch=m.epoch))
+                return
+        if logged is not None and logged <= st.last_complete:
             self.perf.inc("osd_dup_ops_from_log")
             top.mark("dup_refused_from_log")
             await conn.send(M.MOSDOpReply(

@@ -30,6 +30,53 @@ from ceph_tpu.cluster.store import MemStore, Transaction
 _FRAME = struct.Struct("<I")
 
 
+def _damage_journal(path: str, torn_tail: bool, lose_frames: int) -> None:
+    """Crash-model journal damage: truncate away the last ``lose_frames``
+    committed frames, then (optionally) re-append HALF of the next frame
+    so the tail is torn mid-write.  Chaos counters tick per mutation."""
+    if not os.path.exists(path) or (not torn_tail and not lose_frames):
+        return
+    from ceph_tpu.chaos.counters import CHAOS
+
+    offsets = []   # frame start offsets
+    with open(path, "rb") as f:
+        off = 0
+        while True:
+            hdr = f.read(4)
+            if len(hdr) < 4:
+                break
+            (n,) = _FRAME.unpack(hdr)
+            blob = f.read(n)
+            if len(blob) < n:
+                break   # already-torn tail: leave as-is
+            offsets.append((off, 4 + n))
+            off += 4 + n
+    victims = offsets[max(0, len(offsets) - lose_frames):] \
+        if lose_frames else []
+    keep_end = victims[0][0] if victims else (
+        offsets[-1][0] if torn_tail and offsets else None)
+    if keep_end is None:
+        return
+    torn_src = None
+    if torn_tail:
+        # the frame being torn: the first lost frame (its write "was in
+        # flight" at the cut) or the last surviving one
+        torn_src = victims[0] if victims else offsets[-1]
+    with open(path, "rb+") as f:
+        torn_bytes = b""
+        if torn_src is not None:
+            f.seek(torn_src[0])
+            whole = f.read(torn_src[1])
+            torn_bytes = whole[: max(5, torn_src[1] // 2)]
+        f.truncate(keep_end)
+        if torn_bytes:
+            f.seek(keep_end)
+            f.write(torn_bytes)
+            CHAOS.inc("disk_torn_journals")
+    if victims:
+        CHAOS.inc("disk_lost_frames", len(victims))
+
+
 class FileStore(MemStore):
     def __init__(self, path: str, checkpoint_every: int = 2048,
                  fsync: bool = False):
@@ -81,6 +128,24 @@ class FileStore(MemStore):
             self._journal = None
             self._mounted = False
 
+    def crash(self, torn_tail: bool = False, lose_frames: int = 0) -> None:
+        """Power-cut stop (chaos disk injector): close WITHOUT the
+        clean-shutdown checkpoint, drop all RAM state, and optionally
+        mutate the on-disk journal tail — ``lose_frames`` discards the
+        last N committed frames (lost writes), ``torn_tail`` truncates
+        the (remaining) last frame mid-bytes so mount() meets a torn
+        write and must discard it atomically.  The next mount() resumes
+        from checkpoint + surviving journal exactly like a machine that
+        lost power."""
+        if not self._mounted:
+            return
+        self._journal.close()
+        self._journal = None
+        self._mounted = False
+        self._colls = {}
+        self._since_checkpoint = 0
+        _damage_journal(self._journal_path, torn_tail, lose_frames)
+
     def checkpoint(self) -> None:
         """Atomic snapshot + journal truncate (bounded replay)."""
         tmp = self._ckpt_path + ".tmp"
@@ -101,13 +166,21 @@ class FileStore(MemStore):
     def queue_transaction(self, txn: Transaction) -> None:
         if not self._mounted:
             raise RuntimeError("FileStore not mounted")
+        if self.chaos is not None:
+            # refuse BEFORE the journal write: an injected ENOSPC must
+            # not leave a journaled-but-unapplied frame
+            self.chaos.on_write(txn)
         blob = txn.encode()
         with self._lock:
             self._journal.write(_FRAME.pack(len(blob)) + blob)
             self._journal.flush()
             if self.fsync:
                 os.fsync(self._journal.fileno())
-        super().queue_transaction(txn)
+        self._commit(txn)
+        if self.chaos is not None:
+            # rot hits the live (RAM) state only — like media decay on
+            # the applied copy; the journal frame stays pristine
+            self.chaos.maybe_rot(self, txn)
         self._since_checkpoint += 1
         if self._since_checkpoint >= self.checkpoint_every and \
                 not self._ckpt_inflight:

@@ -107,7 +107,8 @@ class MDSDaemon(Dispatcher):
         self.messenger = Messenger(
             EntityName("mds", rank),
             secret=self.config.auth_secret(),
-            auth=self.config.cephx_context(f"mds.{rank}"))
+            auth=self.config.cephx_context(f"mds.{rank}"),
+            config=self.config)
         self.messenger.add_dispatcher(self)
         self.mon_addr = mon_addr
         self.meta_pool = meta_pool

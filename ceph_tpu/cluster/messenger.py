@@ -41,7 +41,7 @@ import time as _time
 
 from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
 from ceph_tpu.utils.lockdep import DepLock
 
@@ -125,6 +125,12 @@ class _Session:
         self.seq = 0
         self.unacked: "OrderedDict[int, bytes]" = OrderedDict()
         self.overflowed = False
+        # set by a chaos frame drop: NO later frame may go out until the
+        # tail is replayed — the peer's acks are CUMULATIVE (ack of N
+        # trims everything <= N), which is only sound while delivery is
+        # in-order, so a skipped frame must block the session until
+        # retransmission restores order
+        self.needs_replay = False
         # unique attribute name on purpose: graftlint's static lock
         # resolver binds attr -> lock name, and PGState already owns
         # the bare attr `lock`
@@ -322,7 +328,8 @@ def _decode_hs(ftype: int, body: bytes) -> Message:
 
 
 class Messenger:
-    def __init__(self, name: EntityName, secret: bytes = None, auth=None):
+    def __init__(self, name: EntityName, secret: bytes = None, auth=None,
+                 config=None):
         self.name = name
         self.secret = secret
         # cephx mode (auth = auth.CephxContext): per-connection session
@@ -330,6 +337,15 @@ class Messenger:
         self.auth = auth
         if auth is not None:
             self.secret = None
+        # chaos net injector (ceph_tpu/chaos/net.py), rebuilt whenever
+        # the owning daemon's chaos_net_* options change (injectargs
+        # seam, like the reference's ms_inject_socket_failures).  None
+        # when disabled: the send path pays one `is None` test.
+        self.config = config
+        self.chaos = None
+        if config is not None:
+            config.add_observer(self._chaos_observer)
+            self._chaos_reconfig()
         # mon-side hook: callable(_MsgAuthRequest) -> _MsgAuthReply
         self.auth_server = None
         self.sid = next(_SID)
@@ -338,13 +354,27 @@ class Messenger:
         self._out: Dict[Addr, Connection] = {}
         self._sessions: Dict[Addr, _Session] = {}
         self._accepted: List[Connection] = []
-        self._tasks: List[asyncio.Task] = []
+        # live-task registry: completed tasks self-discard, or a chaos
+        # run would grow one dead Task per dropped/reordered frame for
+        # the daemon's lifetime
+        self._tasks: Set[asyncio.Task] = set()
         self._auth_waiters: Dict[int, asyncio.Future] = {}
         self._closing = False
         self.my_addr: Optional[Addr] = None
         # per-peer-type policies (reference Messenger::set_policy, bound
         # in ceph_osd.cc:511-525); key None = default
         self._policies: Dict[Optional[str], Policy] = {}
+
+    def _chaos_observer(self, name: str, value) -> None:
+        if name.startswith("chaos_net") or name == "chaos_seed":
+            self._chaos_reconfig()
+
+    def _chaos_reconfig(self) -> None:
+        from ceph_tpu.chaos.net import NetInjector
+
+        keep = self.chaos.partitions if self.chaos is not None else None
+        self.chaos = NetInjector.from_config(
+            self.config, str(self.name), keep_partitions=keep)
 
     def set_policy(self, peer_type: Optional[str], policy: Policy) -> None:
         """Bind a Policy for connections whose peer entity has ``type``
@@ -375,7 +405,7 @@ class Messenger:
         self._accepted.append(conn)
         task = asyncio.current_task()
         if task is not None:
-            self._tasks.append(task)
+            self._track(task)
         await self._read_loop(conn)
 
     async def _read_loop(self, conn: Connection) -> None:
@@ -501,7 +531,7 @@ class Messenger:
         fut = asyncio.get_event_loop().create_future()
         self._auth_waiters[id(conn)] = fut
         task = asyncio.get_event_loop().create_task(self._read_loop(conn))
-        self._tasks.append(task)
+        self._track(task)
         try:
             await conn.send(_MsgAuthRequest(entity=self.auth.entity,
                                             nonce=nonce, proof=proof))
@@ -516,6 +546,10 @@ class Messenger:
             await conn.close()
 
     async def connect(self, addr: Addr) -> Connection:
+        if self.chaos is not None:
+            # asymmetric partition: OUR connects to that peer fail like
+            # a blackholed TCP connect; their path to us is untouched
+            self.chaos.check_connect(addr)
         conn = self._out.get(tuple(addr))
         if conn is not None and not conn.closed:
             return conn
@@ -533,7 +567,7 @@ class Messenger:
             conn.session_key = self.auth.session_key
         self._out[tuple(addr)] = conn
         task = asyncio.get_event_loop().create_task(self._read_loop(conn))
-        self._tasks.append(task)
+        self._track(task)
         return conn
 
     async def send_message(self, msg: Message, addr: Addr) -> None:
@@ -560,14 +594,111 @@ class Messenger:
             # connection must carry the fresh key's signature (signing at
             # buffer time would wedge the replay after every renewal)
             sess.buffer(sess.seq, payload)
+            fate = None
+            if self.chaos is not None:
+                fate = self.chaos.on_frame(addr)
+                if fate.delay:
+                    await asyncio.sleep(fate.delay)
+                if fate.drop:
+                    # drop + socket failure (reference
+                    # ms_inject_socket_failures): the frame stays in
+                    # unacked, the connection dies, and the session is
+                    # GATED (needs_replay) until a retransmission timer
+                    # or the next send replays the tail in order —
+                    # packet loss under retransmission, not silent
+                    # erasure (under a partition the replayed reconnect
+                    # fails too and the loss is real)
+                    sess.needs_replay = True
+                    old = self._out.pop(addr, None)
+                    if old is not None:
+                        await old.close()
+                    self._track(
+                        asyncio.get_event_loop().create_task(
+                            self._replay_later(sess, addr,
+                                               fate.retransmit)))
+                    return
+                if fate.reorder and not sess.needs_replay:
+                    # a gated session must not leak frames around the
+                    # replay: the peer's acks are cumulative, so a late
+                    # frame delivered past the gate would trim the
+                    # still-undelivered dropped frame from the replay
+                    # buffer — silent erasure, not reordering
+                    self._track(
+                        asyncio.get_event_loop().create_task(
+                            self._late_send(sess, addr, sess.seq,
+                                            payload, fate.reorder)))
+                    return
             try:
+                if sess.needs_replay:
+                    # a chaos drop gated this session: replay the whole
+                    # unacked tail (this frame is buffered, so it rides
+                    # the replay) before anything newer goes out
+                    await self._reconnect_replay(sess, addr)
+                    return
                 conn = await self.connect(addr)
-                conn.writer.write(self._frame(conn, payload))
+                frame = self._frame(conn, payload)
+                conn.writer.write(frame)
+                if fate is not None and fate.dup:
+                    conn.writer.write(frame)  # duplicate delivery:
+                    # handlers are idempotent by contract — prove it
                 await conn.writer.drain()
+                if fate is not None and fate.reset:
+                    # injected session reset AFTER the bytes left: the
+                    # peer sees a clean close; our next send reconnects
+                    # and replays the unacked tail
+                    self._out.pop(addr, None)
+                    await conn.close()
             except (ConnectionError, OSError, RuntimeError):
                 if self._closing:
                     raise
                 await self._reconnect_replay(sess, addr)
+
+    async def _replay_later(self, sess: _Session, addr: Addr,
+                            delay: float) -> None:
+        """Chaos retransmission timer: replay the session's unacked tail
+        after a dropped frame gated the session.  A failure here leaves
+        the gate set — the next send retries the replay."""
+        await asyncio.sleep(delay)
+        if self._closing or not sess.needs_replay:
+            return
+        try:
+            async with sess.order_lock:
+                if sess.needs_replay:
+                    await self._reconnect_replay(sess, addr, retries=1)
+        except (ConnectionError, OSError, RuntimeError):
+            pass
+
+    async def _late_send(self, sess: _Session, addr: Addr, seq: int,
+                         payload: bytes, delay: float) -> None:
+        """Chaos reorder: this frame goes out AFTER traffic that was
+        sent later (ordered-delivery violation, deliberately).  A
+        failure here is a DROP, and by then the cumulative ack of later
+        traffic may already have trimmed the frame from the replay
+        buffer — so it is re-buffered (in seq order) and the session
+        gated, turning the failure into packet loss under
+        retransmission rather than silent erasure."""
+        await asyncio.sleep(delay)
+        try:
+            conn = await self.connect(addr)
+            conn.writer.write(self._frame(conn, payload))
+            await conn.writer.drain()
+        except (ConnectionError, OSError, RuntimeError):
+            if self._closing:
+                return
+            async with sess.order_lock:
+                if seq not in sess.unacked:
+                    sess.unacked[seq] = payload
+                    for s in sorted(sess.unacked):
+                        sess.unacked.move_to_end(s)
+                sess.needs_replay = True
+            self._track(
+                asyncio.get_event_loop().create_task(
+                    self._replay_later(sess, addr, delay)))
+
+    def _track(self, task: asyncio.Task) -> asyncio.Task:
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return task
 
     def _frame(self, conn: Connection, payload: bytes) -> bytes:
         key = conn._sign_key()
@@ -586,6 +717,7 @@ class Messenger:
             # future traffic starts from a clean (acked-empty) state
             sess.unacked.clear()
             sess.overflowed = False
+            sess.needs_replay = False
             raise ConnectionError(
                 f"session to {addr} lost unacked frames (overflow); "
                 "cannot replay")
@@ -597,9 +729,16 @@ class Messenger:
                 # no replay across a reset — drop the unacked tail and
                 # surface the failure so the caller re-requests
                 sess.unacked.clear()
+                sess.needs_replay = False
                 raise ConnectionError(
                     f"lossy session to {addr} reset; not replaying")
         last: Optional[Exception] = None
+        # capped exponential backoff with jitter between attempts (was:
+        # immediate linear retry) — seeded via chaos_seed so scenario
+        # retry timing replays with the fault schedule
+        from ceph_tpu.utils.backoff import ExpBackoff
+
+        backoff = ExpBackoff(base=0.02, cap=0.5, rng=self._backoff_rng())
         for attempt in range(retries):
             old = self._out.pop(addr, None)
             if old is not None:
@@ -609,11 +748,26 @@ class Messenger:
                 for payload in sess.unacked.values():
                     conn.writer.write(self._frame(conn, payload))
                 await conn.writer.drain()
+                sess.needs_replay = False
                 return
             except (ConnectionError, OSError, RuntimeError) as e:
                 last = e
-                await asyncio.sleep(0.02 * (attempt + 1))
+                await asyncio.sleep(backoff.next())
+        # keep the session gated while undelivered frames remain: a later
+        # send must replay them BEFORE anything newer, or the peer's
+        # cumulative acks could trim a frame it never saw
+        sess.needs_replay = bool(sess.unacked)
         raise last or ConnectionError(f"reconnect to {addr} failed")
+
+    def _backoff_rng(self):
+        """Seeded jitter stream when the daemon carries a chaos seed
+        (deterministic scenario replay); fresh entropy otherwise."""
+        if self.config is not None and self.config.chaos_seed:
+            from ceph_tpu.chaos.rng import stream
+
+            return stream(self.config.chaos_seed,
+                          f"backoff:{self.name}:{self.sid}")
+        return None
 
     async def shutdown(self) -> None:
         self._closing = True
@@ -624,10 +778,10 @@ class Messenger:
         # cancel + drain reader/handler tasks BEFORE wait_closed: since
         # py3.12 wait_closed() awaits every connection handler, and a
         # handler blocked in its read loop only exits via EOF or cancel
-        for t in self._tasks:
-            if not t.done():
-                t.cancel()
-        if self._tasks:
-            await asyncio.gather(*self._tasks, return_exceptions=True)
+        pending = [t for t in self._tasks if not t.done()]
+        for t in pending:
+            t.cancel()
+        if pending:
+            await asyncio.gather(*pending, return_exceptions=True)
         if self._server:
             await self._server.wait_closed()

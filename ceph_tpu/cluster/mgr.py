@@ -96,7 +96,8 @@ class MgrDaemon(Dispatcher):
         self.messenger = Messenger(
             EntityName("mgr", rank),
             secret=self.config.auth_secret(),
-            auth=self.config.cephx_context(f"mgr.{rank}"))
+            auth=self.config.cephx_context(f"mgr.{rank}"),
+            config=self.config)
         self.messenger.add_dispatcher(self)
         self.monc = MonTargeter(self.messenger, mon_addr)
         self.perfcoll = PerfCountersCollection()

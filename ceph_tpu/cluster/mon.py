@@ -57,7 +57,8 @@ class Monitor(Dispatcher):
         self.messenger = Messenger(
             EntityName("mon", rank),
             secret=self.config.auth_secret(),
-            auth=self.config.cephx_context(f"mon.{rank}"))
+            auth=self.config.cephx_context(f"mon.{rank}"),
+            config=self.config)
         self.messenger.add_dispatcher(self)
         # cephx ticket service (reference CephxServiceHandler): clients
         # prove their entity key, the mon issues time-limited tickets;
@@ -80,6 +81,12 @@ class Monitor(Dispatcher):
         # health warning and clears as soon as beacons report drain
         self.osd_slow_ops: Dict[int, Tuple[int, float]] = {}
         self.perf = PerfCounters("mon")
+        # chaos-skewable per-daemon time source: lease staleness, beacon
+        # grace, and the down-out tick all judge from THIS clock, so a
+        # skewed monitor really does fire early elections / false downs
+        from ceph_tpu.chaos.clock import ChaosClock
+
+        self.clock = ChaosClock.from_config(self.config)
         self.asok = self._build_admin_socket()
         self._tick_task: Optional[asyncio.Task] = None
         self._log: List[Tuple[str, object]] = []  # committed proposal log
@@ -282,7 +289,7 @@ class Monitor(Dispatcher):
             if was_leader and self._tick_task:
                 self._tick_task.cancel()
                 self._tick_task = None
-            self._last_lease = time.monotonic()
+            self._last_lease = self.clock.monotonic()
             if self._lease_task is None or self._lease_task.done():
                 self._lease_task = asyncio.get_event_loop().create_task(
                     self._lease_watch())
@@ -308,11 +315,11 @@ class Monitor(Dispatcher):
             await asyncio.sleep(self.config.mon_lease_interval)
             if self.is_leader:
                 return
-            stale = time.monotonic() - self._last_lease
+            stale = self.clock.monotonic() - self._last_lease
             if stale > self.config.mon_lease_ack_timeout:
                 self.perf.inc("mon_lease_timeouts")
                 await self.elector.start_election()
-                self._last_lease = time.monotonic()
+                self._last_lease = self.clock.monotonic()
 
     async def _apply_committed(self, version: int, value: bytes) -> None:
         """Paxos apply callback: every quorum member applies committed
@@ -520,7 +527,7 @@ class Monitor(Dispatcher):
                 # (reference Paxos::handle_lease epoch check)
                 if self.elector is not None and msg.epoch < self.elector.epoch:
                     return True
-                self._last_lease = time.monotonic()
+                self._last_lease = self.clock.monotonic()
                 self.leader_rank = msg.rank
             elif self.paxos:
                 await self.paxos.handle(msg)
@@ -551,7 +558,7 @@ class Monitor(Dispatcher):
             elif isinstance(msg, M.MOSDFailure):
                 await self._handle_failure(msg)
             elif 0 <= msg.osd_id < self.osdmap.max_osd:
-                self.last_beacon[msg.osd_id] = time.monotonic()
+                self.last_beacon[msg.osd_id] = self.clock.monotonic()
                 if getattr(msg, "statfs", None) is not None:
                     self.osd_statfs[msg.osd_id] = tuple(msg.statfs)
                 slow = getattr(msg, "slow_ops", None)
@@ -678,7 +685,7 @@ class Monitor(Dispatcher):
             inc.new_up[msg.osd_id] = tuple(msg.addr)
             self.down_since.pop(msg.osd_id, None)
             self.failure_reports.pop(msg.osd_id, None)
-            self.last_beacon[msg.osd_id] = time.monotonic()
+            self.last_beacon[msg.osd_id] = self.clock.monotonic()
             self.perf.inc("mon_osd_boot")
             self.clog("INF", f"osd.{msg.osd_id} boot")
             await self._commit_inc(inc)
@@ -698,7 +705,7 @@ class Monitor(Dispatcher):
                     return
                 inc = self._new_inc()
                 inc.new_down.append(osd)
-                self.down_since[osd] = time.monotonic()
+                self.down_since[osd] = self.clock.monotonic()
                 nrep = len(self.failure_reports.pop(osd, ()))
                 self.perf.inc("mon_osd_marked_down")
                 self.clog("ERR", f"osd.{osd} failed "
@@ -1134,7 +1141,7 @@ class Monitor(Dispatcher):
         auto-out and mark-down of osds whose beacons went silent)."""
         while True:
             await asyncio.sleep(self.config.mon_tick_interval)
-            now = time.monotonic()
+            now = self.clock.monotonic()
             async with self._map_mutex:
                 inc = self._new_inc()
                 for osd, since in list(self.down_since.items()):

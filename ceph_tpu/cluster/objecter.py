@@ -38,11 +38,15 @@ class Objecter(Dispatcher):
         # cache from its previous life — tids restart at 1
         self.client_name = f"{name}#{_secrets.token_hex(4)}"
         self.display_name = name
-        self.config = config or Config()
+        # per-client config copy (daemons copy theirs the same way):
+        # chaos injectargs against one client must not leak into the
+        # cluster-wide template config
+        self.config = Config(**config.show()) if config else Config()
         self.messenger = Messenger(
             EntityName("client", abs(hash(name)) % 10000),
             secret=self.config.auth_secret(),
-            auth=self.config.cephx_context(f"client.{name}"))
+            auth=self.config.cephx_context(f"client.{name}"),
+            config=self.config)
         self.messenger.add_dispatcher(self)
         from ceph_tpu.cluster.monclient import MonTargeter
 

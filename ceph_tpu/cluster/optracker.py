@@ -27,7 +27,6 @@ from __future__ import annotations
 
 import contextvars
 import itertools
-import time
 from collections import deque
 from typing import Deque, Dict, List, Optional, Tuple
 
@@ -50,10 +49,11 @@ class TrackedOp:
     def __init__(self, tracker: "OpTracker", desc: str,
                  trace: Optional[Dict] = None):
         self._tracker = tracker
+        self._clock = tracker.clock
         self.seq = next(tracker._seq)
         self.desc = desc
-        self.start = time.monotonic()
-        self.wall_start = time.time()
+        self.start = self._clock.monotonic()
+        self.wall_start = self._clock.time()
         self.events: List[tuple] = [(0.0, "initiated")]
         self.duration: Optional[float] = None
         self.trace_id: Optional[str] = None
@@ -67,22 +67,22 @@ class TrackedOp:
                 self.events.append((ts - self.wall_start, name))
 
     def mark(self, event: str) -> None:
-        self.events.append((time.monotonic() - self.start, event))
+        self.events.append((self._clock.monotonic() - self.start, event))
 
     def finish(self) -> None:
         if self.duration is None:
             self.mark("done")
-            self.duration = time.monotonic() - self.start
+            self.duration = self._clock.monotonic() - self.start
             self._tracker._finished(self)
 
     def age(self) -> float:
-        return time.monotonic() - self.start
+        return self._clock.monotonic() - self.start
 
     def dump(self) -> Dict:
         out = {
             "seq": self.seq,
             "description": self.desc,
-            "age": time.monotonic() - self.start,
+            "age": self._clock.monotonic() - self.start,
             "duration": self.duration,
             "type_data": {"events": [
                 {"time": round(t, 6), "event": e}
@@ -95,9 +95,15 @@ class TrackedOp:
 
 class OpTracker:
     def __init__(self, history_size: int = 20, slow_size: int = 20,
-                 slow_threshold: float = 30.0):
+                 slow_threshold: float = 30.0, clock=None):
         """``slow_threshold`` mirrors osd_op_complaint_time (reference
-        default 30s); 0 disables slow-op tracking."""
+        default 30s); 0 disables slow-op tracking.  ``clock`` is the
+        owning daemon's (chaos-skewable) time source — op ages follow
+        the daemon's view of time, so a clock-skew scenario makes slow-op
+        warnings fire early/late exactly as NTP drift would."""
+        from ceph_tpu.chaos.clock import ChaosClock
+
+        self.clock = clock or ChaosClock()
         self._seq = itertools.count(1)
         self._in_flight: Dict[int, TrackedOp] = {}
         self._history: Deque[TrackedOp] = deque(maxlen=history_size)

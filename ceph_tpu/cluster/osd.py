@@ -68,8 +68,20 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         self.messenger = Messenger(
             EntityName("osd", osd_id),
             secret=self.config.auth_secret(),
-            auth=self.config.cephx_context(f"osd.{osd_id}"))
+            auth=self.config.cephx_context(f"osd.{osd_id}"),
+            config=self.config)
         self.messenger.add_dispatcher(self)
+        # chaos seams (ceph_tpu/chaos/): per-daemon skewable clock (our
+        # heartbeat/failure timings read THIS, so a scenario can skew one
+        # daemon's view of time) + config-driven disk injector on the
+        # store; both stay provable no-ops at default config
+        from ceph_tpu.chaos.clock import ChaosClock
+        from ceph_tpu.chaos.disk import DiskInjector
+
+        self.clock = ChaosClock.from_config(self.config)
+        self.store.chaos = DiskInjector.from_config(
+            self.config, f"osd.{osd_id}")
+        self.config.add_observer(self._chaos_disk_observer)
         # reference ceph_osd.cc:511-525 policy binding: clients are lossy
         # (replies are connection-scoped; the client re-requests) with a
         # byte throttle so a fast client backpressures instead of burying
@@ -84,9 +96,14 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         # monmap failover (shared MonClient hunting, cluster/monclient.py)
         from ceph_tpu.cluster.monclient import MonTargeter
 
+        from ceph_tpu.chaos.rng import stream as _chaos_stream
+
         self.monc = MonTargeter(
             self.messenger, mon_addr,
-            subscribe_since=lambda: self.osdmap.epoch if self.osdmap else 0)
+            subscribe_since=lambda: self.osdmap.epoch if self.osdmap else 0,
+            rng=_chaos_stream(self.config.chaos_seed,
+                              f"monc:osd.{osd_id}")
+            if self.config.chaos_seed else None)
         self.osdmap: Optional[OSDMap] = None
         self.pgs: Dict[PGid, PGState] = {}
         # per-daemon counter registry: own counters + the process-wide
@@ -102,7 +119,8 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         self.tracker = OpTracker(
             history_size=self.config.osd_op_history_size,
             slow_size=self.config.osd_op_history_slow_op_size,
-            slow_threshold=self.config.osd_op_complaint_time)
+            slow_threshold=self.config.osd_op_complaint_time,
+            clock=self.clock)
         # last slow-op count surfaced to the cluster log (warn on rise,
         # log clearance on drain — the mon health check itself keys off
         # the beacon stream)
@@ -116,6 +134,12 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         self._internal_inflight: Dict[Tuple, asyncio.Future] = {}
         self._internal_tid = 0
         self._tasks: List[asyncio.Task] = []
+        # incomplete-recovery retry state (recovery.py
+        # _queue_recovery_retry): per-PG capped backoff + the armed
+        # retry task, so failed pulls/pushes re-run without needing
+        # another map change to trigger peering
+        self._recovery_backoffs: Dict[PGid, object] = {}
+        self._recovery_retry_tasks: Dict[PGid, asyncio.Task] = {}
         self._hb_last: Dict[int, float] = {}
         self._reported: Set[int] = set()
         # dmClock op scheduling (reference mClockClientQueue plugged into
@@ -124,6 +148,11 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         self._opq = None
         self._opq_event = asyncio.Event()
         self._opq_running: Set[asyncio.Task] = set()
+        # default (non-mclock) dispatch: per-(connection, PG) FIFO
+        # queues drained off the messenger read loop — the reference
+        # orders a client session's ops per PG (ShardedOpWQ pg queues)
+        self._ordered_q: Dict[Tuple[int, PGid], object] = {}
+        self._ordered_active: Set[Tuple[int, PGid]] = set()
         if self.config.osd_op_queue == "mclock":
             from ceph_tpu.cluster.dmclock import DmClockQueue, QoSSpec
 
@@ -187,7 +216,12 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
             .setattr(METACOLL, "superblock", "osdmap",
                      pickle.dumps(self.osdmap)))
 
-    async def stop(self) -> None:
+    async def stop(self, crash: bool = False, torn_tail: bool = False,
+                   lose_frames: int = 0) -> None:
+        """Clean shutdown, or (``crash=True``) a power-cut stop: the
+        store skips its clean-shutdown checkpoint — FileStore/BlueStore
+        may tear or lose the journal tail; a MemStore's contents are
+        simply what a dead host's RAM is."""
         self._stopped = True
         for t in list(self._tasks) + list(self._opq_running):
             t.cancel()
@@ -195,9 +229,21 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
             await asyncio.gather(*self._opq_running,
                                  return_exceptions=True)
         await self.messenger.shutdown()
-        self.store.umount()
+        if crash:
+            if hasattr(self.store, "crash"):
+                self.store.crash(torn_tail=torn_tail,
+                                 lose_frames=lose_frames)
+        else:
+            self.store.umount()
         # deregister our counters (the shared KERNELS registry stays)
         self.perfcoll.remove(self.perf.name)
+
+    def _chaos_disk_observer(self, name: str, value) -> None:
+        if name.startswith("chaos_disk") or name == "chaos_seed":
+            from ceph_tpu.chaos.disk import DiskInjector
+
+            self.store.chaos = DiskInjector.from_config(
+                self.config, f"osd.{self.osd_id}")
 
     def _next_reqid(self) -> Tuple[str, int]:
         self._tid += 1
@@ -269,7 +315,12 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                         replies.append(reply)
 
                 msg.src = self.messenger.name
-                await self._handle_client_op(_LoopConn(), msg)
+                # dispatch inline (NOT via _handle_client_op, which
+                # detaches execution as a task and would return before
+                # any reply lands in `replies`): the loopback caller is
+                # an ordinary task, never the messenger read loop, so
+                # executing here cannot head-of-line block a connection
+                await self._serve_queued_op(_LoopConn(), msg)
                 reply = next((r for r in reversed(replies)
                               if isinstance(r, M.MOSDOpReply)), None)
                 if reply is None:
@@ -361,11 +412,12 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                 self._log_mutation(st, msg.entry.op, msg.entry.oid,
                                    msg.entry.version, entry=msg.entry)
             self.perf.inc("osd_rep_ops")
-            await conn.send(M.MOSDRepOpReply(reqid=msg.reqid, result=0))
+            await self._reply_osd(conn, msg, M.MOSDRepOpReply(
+                reqid=msg.reqid, result=0))
             return True
         if isinstance(msg, M.MOSDRepOpReply) or \
                 isinstance(msg, M.MOSDECSubOpWriteReply):
-            self._ack(msg.reqid, msg.result)
+            self._ack(msg.reqid, msg.result, msg)
             return True
         if isinstance(msg, M.MOSDECSubOpWrite):
             await self._handle_ec_write(conn, msg)
@@ -377,7 +429,7 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
             self._ack(msg.reqid, msg.result, msg)
             return True
         if isinstance(msg, M.MOSDScrub):
-            await conn.send(M.MOSDScrubMap(
+            await self._reply_osd(conn, msg, M.MOSDScrubMap(
                 reqid=msg.reqid, pgid=msg.pgid,
                 objects=self._build_scrub_map(msg.pgid)))
             return True
@@ -386,7 +438,7 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
             return True
         if isinstance(msg, M.MOSDPGPush):
             self._handle_push(msg)
-            await conn.send(M.MOSDPGPushReply(
+            await self._reply_osd(conn, msg, M.MOSDPGPushReply(
                 pgid=msg.pgid, oid=msg.oid, result=0))
             return True
         if isinstance(msg, M.MOSDPGPushReply):
@@ -397,7 +449,7 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                 for oid in self._list_pg_objects(msg.pgid)
             }
             st = self.pgs.get(msg.pgid)
-            await conn.send(MOSDPGQueryReply(
+            await self._reply_osd(conn, msg, MOSDPGQueryReply(
                 pgid=msg.pgid, objects=objects,
                 info=st.info() if st else None,
                 log=st.log if st else None))
@@ -411,7 +463,7 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         if isinstance(msg, M.MPing):
             if msg.reply:
                 if msg.src is not None:
-                    self._hb_last[msg.src.num] = time.monotonic()
+                    self._hb_last[msg.src.num] = self.clock.monotonic()
             else:
                 await conn.send(M.MPing(stamp=msg.stamp, reply=True))
             return True
@@ -510,6 +562,20 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         if entry is None:
             return
         fut, acc = entry
+        src = getattr(payload, "src", None)
+        if src is not None:
+            # lossless-session replay and chaos net dup can deliver the
+            # same reply twice: one responder contributes ONE ack, or a
+            # duplicated sub-write ack would satisfy the durability
+            # threshold in place of a shard that never committed
+            sk = (src.type, src.num, getattr(payload, "shard", None))
+            seen = getattr(fut, "ackers", None)
+            if seen is None:
+                seen = set()
+                fut.ackers = seen  # type: ignore[attr-defined]
+            if sk in seen:
+                return
+            seen.add(sk)
         acc.append((result, payload))
         if len(acc) >= fut.needed and not fut.done():  # type: ignore[attr-defined]
             fut.set_result(acc)
@@ -537,6 +603,26 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         if addr is None:
             raise ConnectionError(f"no address for osd.{osd}")
         await self.messenger.send_message(msg, addr)
+
+    async def _reply_osd(self, conn: Connection, msg, reply) -> None:
+        """Ack an osd peer over the LOSSLESS session instead of the raw
+        accepted connection: a sub-op ack lost to a connection reset
+        must be replayed, or the primary stalls its full op timeout on a
+        write that IS durable everywhere (the reference's osd-osd policy
+        is lossless in both directions for the same reason; surfaced by
+        chaos net injection).  Falls back to the raw conn when the peer
+        isn't in our map yet."""
+        src = msg.src
+        if src is not None and src.type == "osd" and \
+                self.osdmap is not None:
+            addr = self.osdmap.osd_addrs.get(src.num)
+            if addr is not None:
+                try:
+                    await self.messenger.send_message(reply, tuple(addr))
+                    return
+                except (ConnectionError, OSError, RuntimeError):
+                    pass
+        await conn.send(reply)
 
     # ------------------------------------------------------------ map flow
 
@@ -727,7 +813,9 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
             m = self.osdmap
             if m is None:
                 continue
-            now = time.monotonic()
+            # the chaos-skewable per-daemon clock: a skewed OSD judges
+            # peer heartbeat staleness from ITS OWN view of time
+            now = self.clock.monotonic()
             # beacon to the mon (reference MOSDBeacon): lets the mon mark
             # us down even when no peer reporters survive; never let a
             # transport hiccup kill the heartbeat task.  The beacon also

@@ -63,7 +63,12 @@ class PGLog:
         self.entries.append(entry)
         rq = getattr(entry, "client_reqid", None)
         if rq is not None and getattr(self, "_reqids", None) is not None:
-            self._reqids[rq] = self._reqids.get(rq, 0) + 1
+            ent = self._reqids.get(rq)
+            if ent is None:
+                self._reqids[rq] = [1, entry.version]
+            else:
+                ent[0] += 1
+                ent[1] = entry.version  # append is monotonic: newest
 
     def trim(self) -> List[LogEntry]:
         """Drop oldest entries beyond max_entries, advancing the tail;
@@ -77,11 +82,13 @@ class PGLog:
         del self.entries[:excess]
         idx = getattr(self, "_reqids", None)
         if idx is not None:
+            # trim drops the OLDEST entries, so a reqid's newest logged
+            # version survives in the index until its count hits zero
             for e in dropped:
                 rq = getattr(e, "client_reqid", None)
                 if rq is not None and rq in idx:
-                    idx[rq] -= 1
-                    if idx[rq] <= 0:
+                    idx[rq][0] -= 1
+                    if idx[rq][0] <= 0:
                         del idx[rq]
         return dropped
 
@@ -96,8 +103,24 @@ class PGLog:
             for e in self.entries:
                 rq = getattr(e, "client_reqid", None)
                 if rq is not None:
-                    idx[rq] = idx.get(rq, 0) + 1
-        return idx.get(reqid, 0) > 0
+                    ent = idx.get(rq)
+                    if ent is None:
+                        idx[rq] = [1, e.version]
+                    else:
+                        ent[0] += 1
+                        ent[1] = e.version
+        ent = idx.get(reqid)
+        return ent is not None and ent[0] > 0
+
+    def reqid_version(self, reqid) -> Optional[Eversion]:
+        """Newest logged version carrying this client reqid, or None —
+        O(1) off the reqid index (dup-resolution polls this in a loop).
+        Callers gate dup-acks on it: an entry ABOVE the commit watermark
+        may still rewind during peering, so replying success from it
+        would ack a write that can subsequently vanish."""
+        if not self.has_reqid(reqid):
+            return None
+        return self._reqids[reqid][1]
 
     def since(self, v: Eversion) -> Optional[List[LogEntry]]:
         """Entries strictly newer than v, or None when v is before the

@@ -64,11 +64,22 @@ class RecoveryMixin:
         Runs under the PG lock: peering mutates st.log/st.last_update, and
         a client write interleaving with log adoption could regress
         last_update and reuse an eversion (the reference blocks ops during
-        peering for the same reason)."""
-        async with st.lock:
-            await self._recover_pg_locked(st)
+        peering for the same reason).
 
-    async def _recover_pg_locked(self, st: PGState) -> None:
+        An INCOMPLETE round (unreachable member, failed pull/push) arms a
+        capped-backoff retry (_queue_recovery_retry): peering re-runs on
+        map changes, but a pull that fails AFTER the last map change of an
+        outage would otherwise never retry — the primary stays stale
+        forever, serving old-generation state (surfaced by graft-chaos as
+        persistent torn EC reads)."""
+        async with st.lock:
+            complete = await self._recover_pg_locked(st)
+        if complete:
+            self._recovery_backoffs.pop(st.pgid, None)
+        else:
+            self._queue_recovery_retry(st)
+
+    async def _recover_pg_locked(self, st: PGState) -> bool:
         m = self.osdmap
         pool = m.pools[st.pgid.pool]
         members = [o for o in st.acting
@@ -76,9 +87,11 @@ class RecoveryMixin:
         infos: Dict[int, PGInfo] = {self.osd_id: st.info()}
         logs: Dict[int, PGLog] = {self.osd_id: st.log}
         inventories: Dict[int, Dict[str, int]] = {}
+        complete = True
         for osd in members:
             reply = await self._query_pg(osd, st.pgid)
             if reply is None:
+                complete = False  # unreachable member: retry later
                 continue
             infos[osd] = reply.info or PGInfo()
             logs[osd] = reply.log or PGLog()
@@ -94,11 +107,11 @@ class RecoveryMixin:
             # ecbackend.rst rollback).  Undo from our rollback journal.
             need = self.rewind_divergent_log(st, auth_head)
             for oid in need:  # record lost: re-pull the auth copy
-                await self._recover_ec_object(pool, st, oid,
-                                              targets=[self.osd_id])
+                complete &= await self._recover_ec_object(
+                    pool, st, oid, targets=[self.osd_id])
         if auth != self.osd_id and \
                 infos[auth].last_update > st.last_update:
-            await self._sync_self_from(
+            complete &= await self._sync_self_from(
                 pool, st, auth, logs[auth], inventories.get(auth, {}))
 
         for osd in members:
@@ -118,13 +131,13 @@ class RecoveryMixin:
                         pgid=st.pgid, op="rewind",
                         data=pickle.dumps(st.last_update)))
                 except ConnectionError:
-                    pass
+                    complete = False
                 continue
             if peer_lu >= st.last_update:
                 continue
             to_sync = st.log.objects_to_sync(peer_lu)
             if to_sync is None:
-                await self._backfill_member(
+                complete &= await self._backfill_member(
                     pool, st, osd, inventories.get(osd, {}))
             else:
                 # replay in VERSION order so the member's log advances
@@ -132,13 +145,73 @@ class RecoveryMixin:
                 # duplicate guard and leave silent log holes)
                 for oid, entry in sorted(to_sync.items(),
                                          key=lambda kv: kv[1].version):
-                    await self._push_object(pool, st, osd, oid, entry)
+                    complete &= await self._push_object(
+                        pool, st, osd, oid, entry)
+
+        # roll-forward (reference PG::activate: last_complete =
+        # last_update once missing is empty): every acting member
+        # REPORTED last_update >= V, so every entry up to V exists on
+        # every shard and can never rewind — advance the watermark.
+        # Without this, a write whose sub-writes all landed but whose
+        # ack was lost (bounce mid-commit) leaves last_complete behind
+        # forever: no rewind fires (nothing is divergent) and no later
+        # ack arrives (surfaced by graft-chaos as a stuck-incomplete PG)
+        live = [o for o in st.acting if o != CRUSH_ITEM_NONE]
+        if all(o in infos for o in live):
+            floor = min(i.last_update for i in infos.values())
+            floor = min(floor, st.last_update)
+            if floor > st.last_complete:
+                self._advance_last_complete(st, floor)
         self.perf.inc("osd_pg_recoveries")
+        return complete
+
+    def _queue_recovery_retry(self, st: PGState) -> None:
+        """Arm ONE delayed re-peering attempt for this PG (capped
+        exponential backoff, seeded jitter when the chaos seed is set, so
+        scenario retry timing replays).  Collapses with in-flight
+        retries; the backoff resets when a round completes."""
+        if self._stopped or st.primary != self.osd_id:
+            return
+        if st.pgid in self._recovery_retry_tasks:
+            return
+        bo = self._recovery_backoffs.get(st.pgid)
+        if bo is None:
+            from ceph_tpu.chaos.rng import stream
+            from ceph_tpu.utils.backoff import ExpBackoff
+
+            rng = stream(self.config.chaos_seed,
+                         f"recovery:osd.{self.osd_id}:{st.pgid}") \
+                if self.config.chaos_seed else None
+            bo = ExpBackoff(base=0.25, cap=3.0, rng=rng)
+            self._recovery_backoffs[st.pgid] = bo
+        delay = bo.next()
+        self.perf.inc("osd_recovery_retries")
+
+        async def _retry() -> None:
+            try:
+                await asyncio.sleep(delay)
+                self._recovery_retry_tasks.pop(st.pgid, None)
+                if not self._stopped and st.primary == self.osd_id and \
+                        self.pgs.get(st.pgid) is st:
+                    await self._recover_pg(st)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self.perf.inc("osd_recovery_errors")
+
+        task = asyncio.get_event_loop().create_task(_retry())
+        self._recovery_retry_tasks[st.pgid] = task
+        # track in the self-discarding set (not _tasks: a long-lived OSD
+        # would keep one dead Task per retry for its lifetime)
+        self._opq_running.add(task)
+        task.add_done_callback(self._opq_running.discard)
 
     async def _sync_self_from(self, pool: PGPool, st: PGState, auth: int,
                               auth_log: PGLog,
-                              auth_inventory: Dict[str, int]) -> None:
-        """Bring the primary up to the authoritative member's state."""
+                              auth_inventory: Dict[str, int]) -> bool:
+        """Bring the primary up to the authoritative member's state.
+        Returns False when a pull failed (the auth log was NOT adopted
+        and the caller must retry)."""
         coll = _coll(st.pgid)
         to_sync = auth_log.objects_to_sync(st.last_update)
         if to_sync is None:
@@ -181,10 +254,10 @@ class RecoveryMixin:
                 ok &= await self._pull_snap_state(pool, st, auth, oid)
         if not ok:
             # a pull failed (auth unreachable mid-recovery): do NOT claim
-            # the authoritative version — stay stale so the next peering
-            # round retries instead of serving/pushing stale bytes as new
+            # the authoritative version — stay stale so the retry/next
+            # peering round re-pulls instead of serving stale bytes as new
             self.perf.inc("osd_recovery_incomplete")
-            return
+            return False
         # adopt the authoritative log
         st.log = PGLog(tail=auth_log.tail,
                        entries=list(auth_log.entries),
@@ -192,6 +265,7 @@ class RecoveryMixin:
         st.last_update = auth_log.head if auth_log.entries else \
             max(st.last_update, auth_log.tail)
         self._save_pg_meta(st)
+        return True
 
     async def _pull_snap_state(self, pool: PGPool, st: PGState, auth: int,
                                head: str) -> bool:
@@ -230,11 +304,13 @@ class RecoveryMixin:
         return ok
 
     async def _backfill_member(self, pool: PGPool, st: PGState, osd: int,
-                               inventory: Dict[str, int]) -> None:
+                               inventory: Dict[str, int]) -> bool:
         """Full-inventory resync for a member behind the log tail
-        (reference Backfilling state)."""
+        (reference Backfilling state).  Returns False when any push
+        failed (the member is still stale; the caller must retry)."""
         from ceph_tpu.cluster import snaps as snapmod
 
+        ok = True
         for oid in self._list_pg_objects(st.pgid):
             ver = self.store.get_version(_coll(st.pgid), oid)
             if inventory.get(oid, -1) >= ver:
@@ -244,7 +320,8 @@ class RecoveryMixin:
             # everything else on an EC pool (clones included) is a real
             # EC object whose member shard gets reconstructed
             if pool.is_erasure() and not oid.endswith(snapmod._SNAPDIR):
-                await self._recover_ec_object(pool, st, oid, targets=[osd])
+                ok &= await self._recover_ec_object(pool, st, oid,
+                                                    targets=[osd])
             else:
                 data = self.store.read(_coll(st.pgid), oid)
                 try:
@@ -254,7 +331,7 @@ class RecoveryMixin:
                         version=ver))
                     self.perf.inc("osd_pushes_sent")
                 except ConnectionError:
-                    pass
+                    ok = False
         # stale objects the member has but we (authoritative) don't
         mine = set(self._list_pg_objects(st.pgid))
         for oid in inventory:
@@ -265,12 +342,16 @@ class RecoveryMixin:
                         version=st.last_update[1]))
                     self.perf.inc("osd_pushes_sent")
                 except ConnectionError:
-                    pass
+                    ok = False
         # hand the member our log state so the next peering round sees it
-        # as current instead of re-backfilling
-        blob = pickle.dumps((st.last_update, st.log))
-        try:
-            await self._send_osd(osd, M.MOSDPGPush(
-                pgid=st.pgid, op="log_sync", data=blob))
-        except ConnectionError:
-            pass
+        # as current instead of re-backfilling — only when every push
+        # landed: a log_sync over missed pushes would mark a still-stale
+        # member current and silently skip the missing objects
+        if ok:
+            blob = pickle.dumps((st.last_update, st.log))
+            try:
+                await self._send_osd(osd, M.MOSDPGPush(
+                    pgid=st.pgid, op="log_sync", data=blob))
+            except ConnectionError:
+                ok = False
+        return ok

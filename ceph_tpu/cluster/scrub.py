@@ -106,6 +106,40 @@ class ScrubMixin:
                             exclude_sources=bad_shard)
                         if ok:
                             repaired.append(oid)
+            # generation divergence: a shard can be bitwise-clean against
+            # its OWN crc yet belong to an older committed generation
+            # (an interrupted recovery left it behind).  Such a shard
+            # must never feed a decode; rebuild it from the newest
+            # committed group (surfaced by graft-chaos: a stale primary
+            # shard served torn reads and crc-scrub saw nothing wrong)
+            from ceph_tpu.cluster import snaps as snapmod
+
+            handled = set(inconsistent)
+            all_oids = set()
+            for smap in maps.values():
+                all_oids.update(smap)
+            committed = st.last_complete[1]
+            for oid in sorted(all_oids):
+                if oid in handled or oid.endswith(snapmod._SNAPDIR):
+                    continue  # snapdirs replicate; handled oids repaired
+                vers = {osd: smap[oid][0] for osd, smap in maps.items()
+                        if oid in smap}
+                cvers = [v for v in vers.values() if v <= committed]
+                if not cvers:
+                    continue  # only un-acked generations: peering's call
+                auth_v = max(cvers)
+                stale = sorted(o for o, v in vers.items() if v < auth_v)
+                if not stale:
+                    continue
+                inconsistent.append(oid)
+                self.perf.inc("osd_scrub_errors")
+                stale_shards = {i for i, o in enumerate(st.acting)
+                                if o in stale}
+                ok = await self._recover_ec_object(
+                    pool, st, oid, targets=stale,
+                    exclude_sources=stale_shards)
+                if ok:
+                    repaired.append(oid)
         else:
             # replicated: majority crc wins, divergent members get the
             # authoritative copy re-pushed

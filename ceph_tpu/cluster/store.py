@@ -100,9 +100,19 @@ class Transaction:
 
 
 class ObjectStore:
+    # disk fault injector (ceph_tpu/chaos/disk.py DiskInjector), the
+    # filestore_debug_inject_read_err analog; None (the default) keeps
+    # every hot path to a single `is None` test
+    chaos = None
+
     def mount(self) -> None: ...
 
     def umount(self) -> None: ...
+
+    def debug_bitrot(self, coll: str, oid: str, bit: int) -> None:
+        """Flip one stored bit WITHOUT touching any checksum — the
+        silent-corruption seam the disk injector drives."""
+        raise NotImplementedError
 
     def statfs(self) -> Tuple[int, int]:
         """(total_bytes, used_bytes) — reference ObjectStore::statfs."""
@@ -129,6 +139,15 @@ class MemStore(ObjectStore):
     # -- transaction application (atomic under lock) -----------------------
 
     def queue_transaction(self, txn: Transaction) -> None:
+        if self.chaos is not None:
+            # injected ENOSPC refuses the WHOLE txn before any byte
+            # lands (atomicity preserved)
+            self.chaos.on_write(txn)
+        self._commit(txn)
+        if self.chaos is not None:
+            self.chaos.maybe_rot(self, txn)
+
+    def _commit(self, txn: Transaction) -> None:
         with self._lock:
             for op in txn.ops:
                 self._apply(op)
@@ -210,6 +229,8 @@ class MemStore(ObjectStore):
 
     def read(self, coll: str, oid: str, offset: int = 0,
              length: Optional[int] = None) -> bytes:
+        if self.chaos is not None:
+            self.chaos.on_read(coll, oid)
         with self._lock:
             o = self._colls.get(coll, {}).get(oid)
             if o is None:
@@ -217,6 +238,17 @@ class MemStore(ObjectStore):
             if length is None:
                 return bytes(o.data[offset:])
             return bytes(o.data[offset : offset + length])
+
+    def debug_bitrot(self, coll: str, oid: str, bit: int) -> None:
+        """Silent in-place bit flip (no version bump, no attr change):
+        only a checksum-verifying reader — deep scrub comparing against
+        the stored hinfo crc — can tell."""
+        with self._lock:
+            o = self._colls.get(coll, {}).get(oid)
+            if o is None or not o.data:
+                raise FileNotFoundError(f"{coll}/{oid}")
+            byte, shift = divmod(bit % (len(o.data) * 8), 8)
+            o.data[byte] ^= 1 << shift
 
     def stat(self, coll: str, oid: str) -> Optional[int]:
         with self._lock:

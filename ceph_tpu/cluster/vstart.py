@@ -38,6 +38,13 @@ class Cluster:
     mds: Optional[object] = None       # rank-0 MDSDaemon (cluster/mds.py)
     mds_addr: Optional[tuple] = None
     mdss: Optional[dict] = None        # rank -> MDSDaemon (multi-active)
+    # per-daemon config copies of killed OSDs: a revive must resume the
+    # daemon's OWN config (injected fault options survive kill/revive
+    # within a chaos scenario), not the cluster template
+    osd_configs: Dict[int, Config] = field(default_factory=dict)
+    # durable stores of killed/crashed OSDs: a crash-revive remounts the
+    # same store and replays its journal (MemStore kills stay lost-RAM)
+    osd_stores: Dict[int, object] = field(default_factory=dict)
 
     async def start_mds(self, meta_pool: int, data_pool: int,
                         rank: int = 0):
@@ -126,14 +133,44 @@ class Cluster:
         raise TimeoutError("no mon leader elected")
 
     async def kill_osd(self, osd_id: int) -> None:
-        """Hard-stop an OSD (thrasher kill_osd analog)."""
+        """Hard-stop an OSD (thrasher kill_osd analog).  The daemon's
+        per-daemon config is remembered for revive; a durable store
+        (FileStore/BlueStore — anything with a crash/mount cycle) is
+        remembered too, since a dead host's disks survive it."""
         osd = self.osds.pop(osd_id)
+        self.osd_configs[osd_id] = osd.config
+        if hasattr(osd.store, "crash"):
+            self.osd_stores[osd_id] = osd.store
         await osd.stop()
 
-    async def revive_osd(self, osd_id: int) -> OSDDaemon:
-        """Start a fresh daemon for the id (revive_osd analog; empty store —
-        recovery must repopulate it)."""
-        osd = OSDDaemon(osd_id, self.mon_addr, config=self.config)
+    async def crash_osd(self, osd_id: int, torn_tail: bool = False,
+                        lose_frames: int = 0) -> None:
+        """Power-cut an OSD (chaos disk injector): no clean store
+        shutdown; a durable store may tear/lose its journal tail and is
+        kept for a revive that must replay it."""
+        osd = self.osds.pop(osd_id)
+        self.osd_configs[osd_id] = osd.config
+        if hasattr(osd.store, "crash"):
+            self.osd_stores[osd_id] = osd.store
+        await osd.stop(crash=True, torn_tail=torn_tail,
+                       lose_frames=lose_frames)
+
+    async def revive_osd(self, osd_id: int,
+                         with_store: bool = False) -> OSDDaemon:
+        """Start a fresh daemon for the id (revive_osd analog; empty
+        store by default — recovery must repopulate it).  It resumes the
+        killed daemon's OWN config copy, so fault options injected
+        before the kill survive the bounce; ``with_store`` remounts the
+        remembered durable store (journal replay) instead of booting
+        empty."""
+        cfg = self.osd_configs.pop(osd_id, None) or self.config
+        # the remembered store is consumed either way: reviving empty
+        # must not leave a stale pre-crash store behind for a later
+        # ``osd_id in osd_stores`` check to remount over recovered data
+        store = self.osd_stores.pop(osd_id, None)
+        if not with_store:
+            store = None
+        osd = OSDDaemon(osd_id, self.mon_addr, config=cfg, store=store)
         await osd.start()
         self.osds[osd_id] = osd
         return osd
@@ -141,11 +178,12 @@ class Cluster:
     async def restart_osd(self, osd_id: int) -> OSDDaemon:
         """Stop + start an OSD KEEPING its object store (daemon restart:
         the persisted pg log lets peering delta-resync instead of
-        backfilling, reference OSD.cc:2556 superblock resume)."""
+        backfilling, reference OSD.cc:2556 superblock resume) AND its
+        per-daemon config (injected fault options survive the bounce)."""
         old = self.osds.pop(osd_id)
         store = old.store
         await old.stop()
-        osd = OSDDaemon(osd_id, self.mon_addr, config=self.config,
+        osd = OSDDaemon(osd_id, self.mon_addr, config=old.config,
                         store=store)
         await osd.start()
         self.osds[osd_id] = osd

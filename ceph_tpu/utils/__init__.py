@@ -1,6 +1,7 @@
 """Common runtime: typed config schema, perf counters, admin socket."""
 
 from ceph_tpu.utils.admin_socket import AdminSocket  # noqa: F401
+from ceph_tpu.utils.backoff import ExpBackoff  # noqa: F401
 from ceph_tpu.utils.config import Config, Option  # noqa: F401
 from ceph_tpu.utils.lockdep import DepLock, LockCycleError, LockDep  # noqa: F401
 from ceph_tpu.utils.perf import (  # noqa: F401

@@ -83,6 +83,18 @@ class AdminSocket:
         self.register("graftlint report", _graftlint_report,
                       "last static-analysis summary (lint runs on "
                       "first request)")
+        self.register("chaos report",
+                      lambda cmd: _chaos_report(config),
+                      "injected-fault counters + this daemon's active "
+                      "chaos options")
+
+
+def _chaos_report(config):
+    """Process-wide chaos counters + the daemon's chaos_* option view
+    (config-driven injectors are fully described by those values)."""
+    from ceph_tpu.chaos.counters import chaos_report
+
+    return chaos_report(config)
 
 
 def _lockdep_dump(cmd):

@@ -1,0 +1,49 @@
+"""Capped exponential backoff with seeded full jitter.
+
+The retry-delay policy for monclient hunting and messenger session
+reconnect (reference: the osdc/Objecter and MonClient backoff knobs;
+jitter shape per the classic full-jitter scheme — delay drawn uniformly
+from [0, min(cap, base * factor^n)]).  Deterministic when handed a
+seeded ``random.Random``: chaos scenarios derive one per consumer from
+the scenario seed, so retry timing replays with the fault schedule.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import List, Optional
+
+
+class ExpBackoff:
+    def __init__(self, base: float = 0.05, cap: float = 1.0,
+                 factor: float = 2.0,
+                 rng: Optional[random.Random] = None):
+        self.base = base
+        self.cap = cap
+        self.factor = factor
+        self.rng = rng or random.Random()
+        self._n = 0
+
+    def next(self) -> float:
+        """The next delay: full jitter over the capped exponential
+        envelope.  Each call advances the attempt counter."""
+        ceiling = min(self.cap, self.base * (self.factor ** self._n))
+        self._n += 1
+        return self.rng.uniform(0.0, ceiling)
+
+    def reset(self) -> None:
+        """Back to attempt 0 (call on success)."""
+        self._n = 0
+
+    def schedule(self, n: int) -> List[float]:
+        """Preview the next ``n`` delays without consuming real retries
+        on a live consumer: runs on a COPY of the rng state."""
+        rng = random.Random()
+        rng.setstate(self.rng.getstate())
+        out = []
+        saved = self._n
+        for _ in range(n):
+            ceiling = min(self.cap, self.base * (self.factor ** saved))
+            saved += 1
+            out.append(rng.uniform(0.0, ceiling))
+        return out

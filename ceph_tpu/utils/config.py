@@ -106,6 +106,36 @@ OPTIONS: List[Option] = [
     Option("debug_ms", int, 0, min=0, max=20),
     Option("debug_osd", int, 0, min=0, max=20),
     Option("debug_mon", int, 0, min=0, max=20),
+    # chaos (deterministic fault injection, ceph_tpu/chaos/): the
+    # injectargs-able analog of the reference's ms_inject_socket_failures
+    # / filestore_debug_inject_read_err debug seams.  All-zero defaults
+    # keep every injector a provable no-op (messenger.chaos is None,
+    # store.chaos is None, clock skew a plain passthrough).
+    Option("chaos_seed", int, 0, "root seed for per-injector rng streams"),
+    Option("chaos_net_drop", float, 0.0, "frame drop probability",
+           min=0, max=1),
+    Option("chaos_net_dup", float, 0.0, "frame duplication probability",
+           min=0, max=1),
+    Option("chaos_net_delay", float, 0.0,
+           "max injected frame delay (s)", min=0),
+    Option("chaos_net_delay_prob", float, 0.0,
+           "frame delay probability", min=0, max=1),
+    Option("chaos_net_reorder", float, 0.0,
+           "frame reorder (deferral) probability", min=0, max=1),
+    Option("chaos_net_reset", float, 0.0,
+           "post-send session reset probability", min=0, max=1),
+    Option("chaos_net_partition", str, "",
+           "comma-separated host:port peers unreachable FROM this "
+           "daemon (asymmetric partition side)"),
+    Option("chaos_disk_read_err", float, 0.0,
+           "injected EIO probability per store read", min=0, max=1),
+    Option("chaos_disk_enospc", float, 0.0,
+           "injected ENOSPC probability per transaction", min=0, max=1),
+    Option("chaos_disk_bitrot", float, 0.0,
+           "silent bit-flip probability per committed write txn",
+           min=0, max=1),
+    Option("chaos_clock_skew", float, 0.0,
+           "seconds added to this daemon's time source"),
 ]
 
 _BY_NAME = {o.name: o for o in OPTIONS}

@@ -1,0 +1,80 @@
+"""Capped exponential backoff with seeded jitter (utils/backoff.py).
+
+The monclient-hunting / messenger-reconnect satellite: the schedule
+from a fixed seed is asserted exactly, so retry timing is replayable in
+chaos scenarios and regression-pinned here.
+"""
+
+import asyncio
+import random
+
+from ceph_tpu.utils.backoff import ExpBackoff
+
+
+def test_backoff_schedule_deterministic_from_seed():
+    a = ExpBackoff(base=0.05, cap=1.0, rng=random.Random(7))
+    b = ExpBackoff(base=0.05, cap=1.0, rng=random.Random(7))
+    sched_a = [a.next() for _ in range(8)]
+    sched_b = [b.next() for _ in range(8)]
+    assert sched_a == sched_b
+    # full jitter stays inside the capped exponential envelope
+    for n, d in enumerate(sched_a):
+        assert 0.0 <= d <= min(1.0, 0.05 * 2 ** n)
+    # the envelope actually grows: later draws can exceed the first cap
+    assert max(sched_a[4:]) > 0.05
+
+
+def test_backoff_reset_restarts_envelope():
+    b = ExpBackoff(base=0.1, cap=10.0, factor=2.0,
+                   rng=random.Random(3))
+    for _ in range(6):
+        b.next()
+    b.reset()
+    assert b.next() <= 0.1  # attempt-0 ceiling again
+
+
+def test_backoff_schedule_preview_does_not_consume():
+    b = ExpBackoff(base=0.05, cap=1.0, rng=random.Random(11))
+    preview = b.schedule(5)
+    live = [b.next() for _ in range(5)]
+    assert preview == live
+
+
+def test_montargeter_hunts_with_backoff():
+    """A dead monmap is hunted with growing jittered delays (not
+    hammered), and the schedule replays from the same seed."""
+    from ceph_tpu.cluster.monclient import MonTargeter
+
+    class DeadMessenger:
+        my_addr = ("127.0.0.1", 1)
+
+        async def send_message(self, msg, addr):
+            raise ConnectionError("down")
+
+    async def hunt_delays(seed):
+        mt = MonTargeter(DeadMessenger(),
+                         [("127.0.0.1", 2), ("127.0.0.1", 3)],
+                         rng=random.Random(seed))
+        slept = []
+        orig_sleep = asyncio.sleep
+
+        async def spy_sleep(d):
+            slept.append(d)
+            await orig_sleep(0)
+
+        asyncio.sleep = spy_sleep
+        try:
+            ok = await mt.send(object())
+        finally:
+            asyncio.sleep = orig_sleep
+        assert not ok
+        return slept
+
+    s1 = asyncio.run(hunt_delays(5))
+    s2 = asyncio.run(hunt_delays(5))
+    assert s1 == s2
+    # one backoff BETWEEN targets; the last failure returns immediately
+    # (sleeping after the final target would delay the failure verdict
+    # with no further attempt to protect)
+    assert len(s1) == 1
+    assert all(d >= 0 for d in s1)

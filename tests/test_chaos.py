@@ -1,0 +1,378 @@
+"""graft-chaos unit + integration tests: seeded streams, injector
+no-op proofs, disk faults, torn-journal crash-restart, clock skew,
+admin-socket visibility, and the vstart config-preservation fix.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.chaos.clock import ChaosClock
+from ceph_tpu.chaos.counters import CHAOS, chaos_total
+from ceph_tpu.chaos.disk import DiskInjector
+from ceph_tpu.chaos.net import NetInjector, parse_partitions
+from ceph_tpu.chaos.rng import derive_seed, stream
+from ceph_tpu.cluster.vstart import _fast_config, start_cluster
+from ceph_tpu.utils import Config
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _counters():
+    return dict(CHAOS.dump()["chaos"])
+
+
+# ------------------------------------------------------------ rng streams
+
+
+def test_streams_deterministic_and_independent():
+    assert derive_seed(42, "net:osd.0") == derive_seed(42, "net:osd.0")
+    assert derive_seed(42, "net:osd.0") != derive_seed(42, "net:osd.1")
+    assert derive_seed(42, "net:osd.0") != derive_seed(43, "net:osd.0")
+    a = [stream(42, "x").random() for _ in range(3)]
+    b = [stream(42, "x").random() for _ in range(3)]
+    assert a == b
+    # one stream's draws never shift another's
+    s_net, s_disk = stream(42, "net"), stream(42, "disk")
+    first_disk = stream(42, "disk").random()
+    for _ in range(100):
+        s_net.random()
+    assert s_disk.random() == first_disk
+
+
+# ---------------------------------------------------------- no-op proofs
+
+
+def test_injectors_none_at_default_config():
+    cfg = Config()
+    assert NetInjector.from_config(cfg, "osd.0") is None
+    assert DiskInjector.from_config(cfg, "osd.0") is None
+    cfg.chaos_net_drop = 0.5
+    assert NetInjector.from_config(cfg, "osd.0") is not None
+    cfg2 = Config(chaos_disk_read_err=0.5)
+    assert DiskInjector.from_config(cfg2, "osd.0") is not None
+
+
+def test_cluster_without_chaos_emits_zero_counters():
+    """The acceptance no-op proof: a chaos-free cluster run — boot,
+    pool, writes, reads, scrub — leaves messenger.chaos/store.chaos None
+    and increments NO chaos counter."""
+    async def scenario():
+        before = chaos_total()
+        cluster = await start_cluster(3)
+        try:
+            for osd in cluster.osds.values():
+                assert osd.messenger.chaos is None
+                assert osd.store.chaos is None
+            for mon in cluster.mons:
+                assert mon.messenger.chaos is None
+            client = await cluster.client()
+            pool = await client.pool_create("noop", "replicated",
+                                            pg_num=4, size=3)
+            io = client.ioctx(pool)
+            for i in range(4):
+                await io.write_full(f"o{i}", b"quiet" * 50)
+            for i in range(4):
+                assert await io.read(f"o{i}") == b"quiet" * 50
+        finally:
+            await cluster.stop()
+        assert chaos_total() == before
+    run(scenario())
+
+
+# ------------------------------------------------------------------ net
+
+
+def test_net_injector_rates_and_partitions():
+    inj = NetInjector(stream(1, "t"), drop=1.0)
+    fate = inj.on_frame(("h", 1))
+    assert fate.drop and fate.retransmit > 0
+    inj2 = NetInjector(stream(1, "t"), dup=1.0, reset=1.0)
+    fate2 = inj2.on_frame(("h", 1))
+    assert fate2.dup and fate2.reset and not fate2.drop
+    assert parse_partitions("127.0.0.1:5,127.0.0.1:6") == {
+        ("127.0.0.1", 5), ("127.0.0.1", 6)}
+    inj2.partition(("127.0.0.1", 5))
+    assert inj2.partitioned(("127.0.0.1", 5))
+    with pytest.raises(ConnectionError):
+        inj2.check_connect(("127.0.0.1", 5))
+    inj2.heal()
+    inj2.check_connect(("127.0.0.1", 5))  # healed: no raise
+
+
+def test_messenger_injector_follows_injectargs():
+    """The injectargs seam: chaos_net_* on a daemon's config rebuilds
+    its messenger injector live; zeroing returns it to None."""
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            osd = cluster.osds[0]
+            assert osd.messenger.chaos is None
+            osd.config.injectargs({"chaos_net_drop": 0.25})
+            assert osd.messenger.chaos is not None
+            assert osd.messenger.chaos.drop == 0.25
+            osd.config.injectargs({"chaos_net_drop": 0.0})
+            assert osd.messenger.chaos is None
+        finally:
+            await cluster.stop()
+    run(scenario())
+
+
+# ----------------------------------------------------------------- disk
+
+
+def test_disk_injector_eio_and_enospc():
+    from ceph_tpu.cluster.store import MemStore, Transaction
+
+    store = MemStore()
+    store.queue_transaction(
+        Transaction().create_collection("c").write("c", "o", 0, b"data"))
+    store.chaos = DiskInjector(stream(1, "d"), read_err=1.0)
+    with pytest.raises(IOError):
+        store.read("c", "o")
+    store.chaos = DiskInjector(stream(1, "d"), enospc=1.0)
+    with pytest.raises(OSError) as ei:
+        store.queue_transaction(Transaction().write("c", "o", 0, b"x"))
+    assert ei.value.errno == 28
+    # the refused txn left no bytes behind (atomicity)
+    store.chaos = None
+    assert store.read("c", "o") == b"data"
+
+
+def test_flip_bit_memstore_silent():
+    from ceph_tpu.cluster.store import MemStore, Transaction
+
+    store = MemStore()
+    store.queue_transaction(
+        Transaction().create_collection("c").write("c", "o", 0,
+                                                   b"A" * 64))
+    inj = DiskInjector(stream(7, "rot"))
+    before = _counters().get("disk_bitrot_flips", 0)
+    bit = inj.flip_bit(store, "c", "o")
+    assert _counters()["disk_bitrot_flips"] == before + 1
+    data = store.read("c", "o")
+    assert data != b"A" * 64
+    # exactly one bit differs, version untouched (SILENT corruption)
+    diff = [a ^ b for a, b in zip(data, b"A" * 64)]
+    assert sum(bin(d).count("1") for d in diff) == 1
+    assert store.get_version("c", "o") == 1
+    # same seed -> same bit
+    store2 = MemStore()
+    store2.queue_transaction(
+        Transaction().create_collection("c").write("c", "o", 0,
+                                                   b"A" * 64))
+    assert DiskInjector(stream(7, "rot")).flip_bit(store2, "c", "o") == bit
+
+
+def test_flip_bit_bluestore_surfaces_as_eio(tmp_path):
+    from ceph_tpu.cluster.bluestore import BlueStore
+    from ceph_tpu.cluster.store import Transaction
+
+    store = BlueStore(str(tmp_path / "bs"), size=8 << 20)
+    store.mount()
+    store.queue_transaction(
+        Transaction().create_collection("c").write("c", "o", 0,
+                                                   b"B" * 1000))
+    DiskInjector(stream(3, "rot")).flip_bit(store, "c", "o", bit=40)
+    # the onode csum was NOT updated: the read path catches the rot
+    with pytest.raises(IOError):
+        store.read("c", "o")
+    store.umount()
+
+
+# -------------------------------------------------- crash/torn journals
+
+
+def test_filestore_crash_torn_tail_discards_last_txn(tmp_path):
+    from ceph_tpu.cluster.filestore import FileStore
+    from ceph_tpu.cluster.store import Transaction
+
+    store = FileStore(str(tmp_path / "fs"))
+    store.mount()
+    store.queue_transaction(
+        Transaction().create_collection("c").write("c", "a", 0,
+                                                   b"first"))
+    store.queue_transaction(Transaction().write("c", "b", 0, b"second"))
+    store.crash(torn_tail=True)
+    store.mount()
+    # the torn tail frame was discarded atomically; earlier data intact
+    assert store.read("c", "a") == b"first"
+    assert store.stat("c", "b") is None
+    store.umount()
+
+
+def test_filestore_crash_lose_frames(tmp_path):
+    from ceph_tpu.cluster.filestore import FileStore
+    from ceph_tpu.cluster.store import Transaction
+
+    store = FileStore(str(tmp_path / "fs2"))
+    store.mount()
+    store.queue_transaction(
+        Transaction().create_collection("c").write("c", "a", 0, b"one"))
+    store.queue_transaction(Transaction().write("c", "b", 0, b"two"))
+    store.queue_transaction(Transaction().write("c", "z", 0, b"three"))
+    store.crash(lose_frames=2)
+    store.mount()
+    assert store.read("c", "a") == b"one"
+    assert store.stat("c", "b") is None
+    assert store.stat("c", "z") is None
+    store.umount()
+
+
+def test_bluestore_crash_replays_wal(tmp_path):
+    from ceph_tpu.cluster.bluestore import BlueStore
+    from ceph_tpu.cluster.store import Transaction
+
+    store = BlueStore(str(tmp_path / "bs2"), size=8 << 20)
+    store.mount()
+    store.queue_transaction(
+        Transaction().create_collection("c").write("c", "a", 0,
+                                                   b"W" * 100))
+    store.queue_transaction(Transaction().write("c", "b", 0, b"X" * 100))
+    store.crash(torn_tail=True)
+    store.mount()
+    assert store.read("c", "a") == b"W" * 100   # replayed from WAL
+    assert store.stat("c", "b") is None         # torn frame discarded
+    store.umount()
+
+
+# ---------------------------------------------------------------- clock
+
+
+def test_chaos_clock_skew_and_observer():
+    import time as _time
+
+    cfg = Config()
+    clock = ChaosClock.from_config(cfg)
+    assert abs(clock.monotonic() - _time.monotonic()) < 0.1
+    before = _counters().get("clock_skews", 0)
+    cfg.injectargs({"chaos_clock_skew": 5.0})
+    assert clock.skew == 5.0
+    assert clock.monotonic() - _time.monotonic() > 4.0
+    assert _counters()["clock_skews"] == before + 1
+
+
+def test_optracker_ages_follow_skewed_clock():
+    from ceph_tpu.cluster.optracker import OpTracker
+
+    clock = ChaosClock()
+    tracker = OpTracker(slow_threshold=10.0, clock=clock)
+    op = tracker.create("op")
+    assert tracker.slow_in_flight() == (0, 0.0)
+    clock.skew = 60.0            # the daemon's clock jumps forward
+    n, oldest = tracker.slow_in_flight()
+    assert n == 1 and oldest >= 10.0
+    op.finish()
+
+
+# -------------------------------------------------------- admin socket
+
+
+def test_chaos_report_admin_command():
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            data = await cluster.daemon_command("osd.1",
+                                                "chaos report")
+            assert data["active"] is False
+            assert "net_drops" in data["counters"]
+            cluster.osds[1].config.injectargs({"chaos_net_drop": 0.1})
+            data = await cluster.daemon_command("osd.1",
+                                                "chaos report")
+            assert data["active"] is True
+            assert data["options"]["chaos_net_drop"] == 0.1
+            # the other daemon's view stays inactive (per-daemon config)
+            data = await cluster.daemon_command("osd.0",
+                                                "chaos report")
+            assert data["active"] is False
+        finally:
+            await cluster.stop()
+    run(scenario())
+
+
+# ------------------------------------- vstart config preservation (fix)
+
+
+def test_restart_osd_keeps_injected_config():
+    """The satellite fix: kill/revive and restart must resume the
+    daemon's per-daemon config copy, so injected fault options survive a
+    bounce within a scenario."""
+    async def scenario():
+        cfg = _fast_config()
+        cfg.mon_osd_down_out_interval = 60.0
+        cluster = await start_cluster(3, config=cfg)
+        try:
+            cluster.osds[0].config.injectargs(
+                {"chaos_net_drop": 0.05, "chaos_seed": 99})
+            await cluster.restart_osd(0)
+            assert cluster.osds[0].config.chaos_net_drop == 0.05
+            assert cluster.osds[0].config.chaos_seed == 99
+            assert cluster.osds[0].messenger.chaos is not None
+
+            cluster.osds[1].config.injectargs({"chaos_clock_skew": 1.5})
+            await cluster.kill_osd(1)
+            await cluster.revive_osd(1)
+            assert cluster.osds[1].config.chaos_clock_skew == 1.5
+            assert cluster.osds[1].clock.skew == 1.5
+            # an untouched daemon still boots from the cluster template
+            await cluster.restart_osd(2)
+            assert cluster.osds[2].config.chaos_net_drop == 0.0
+        finally:
+            await cluster.stop()
+    run(scenario())
+
+
+# ------------------------------ recovery retry without a map change (fix)
+
+
+def test_incomplete_recovery_retries_without_map_change():
+    """An incomplete recovery round (unreachable member, failed
+    pull/push) must re-arm itself with capped backoff: peering is
+    otherwise only triggered by map changes, and a pull that fails
+    AFTER the last map change of an outage would leave the primary
+    stale forever (graft-chaos: persistent torn EC reads)."""
+    async def scenario():
+        cluster = await start_cluster(3, config=_fast_config())
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("retry", "replicated",
+                                            pg_num=2, size=3)
+            io = client.ioctx(pool)
+            await io.write_full("o", b"x" * 64)
+            pgid = client.objecter.object_pgid(pool, "o")
+            _, _, _, primary = \
+                client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+            osd = cluster.osds[primary]
+            st = osd.pgs[pgid]
+
+            import random as _random
+
+            from ceph_tpu.utils.backoff import ExpBackoff
+
+            # fast, seeded backoff so the test runs in milliseconds
+            osd._recovery_backoffs[st.pgid] = ExpBackoff(
+                base=0.02, cap=0.05, rng=_random.Random(7))
+            calls = []
+            orig = osd._recover_pg_locked
+
+            async def flaky(st_arg):
+                calls.append(len(calls))
+                if len(calls) < 3:
+                    return False          # incomplete: must re-arm
+                return await orig(st_arg)
+
+            osd._recover_pg_locked = flaky
+            await osd._recover_pg(st)
+            for _ in range(100):
+                if len(calls) >= 3 and \
+                        st.pgid not in osd._recovery_retry_tasks:
+                    break
+                await asyncio.sleep(0.05)
+            assert len(calls) >= 3, "incomplete recovery never retried"
+            # a COMPLETE round resets the backoff and leaves no retry
+            assert st.pgid not in osd._recovery_backoffs
+        finally:
+            await cluster.stop()
+    run(scenario())

@@ -152,92 +152,80 @@ def test_ec_divergent_replica_rewinds_on_instruction():
     run(scenario())
 
 
-@contention_retry()
-def test_thrash_primaries_mid_ec_write():
-    """Thrasher variant targeting primaries mid-write on an EC pool
-    (round-4 item 5 gate): writes race primary kills; afterwards every
-    ACKED write must read back and un-acked partials must have been
-    rolled back or completed — never silent shard divergence (verified
-    via scrub over every object)."""
+def test_stale_primary_shard_serves_committed_group():
+    """A primary whose OWN shard is a stale older generation — the state
+    an interrupted recovery pull leaves behind when no further map
+    change retriggers peering — must serve reads from the newest
+    COMMITTED shard group at the GROUP's size, never the group's bytes
+    truncated to the local size attr (graft-chaos: obj read back as g2
+    bytes at g1's length).  Scrub must then flag + rebuild the stale
+    shard even though its crc is self-consistent."""
+    from ceph_tpu.cluster.store import Transaction
+
     async def scenario():
-        rng = random.Random(11)
-        cfg = _fast_config()
-        cfg.mon_osd_down_out_interval = 60.0
-        cluster = await start_cluster(4, config=cfg)
+        cluster = await start_cluster(4, config=_fast_config())
         try:
             client = await cluster.client()
-            pool = await client.pool_create("pthrash", "erasure", pg_num=4,
+            pool = await client.pool_create("stale", "erasure", pg_num=4,
                                             ec_profile=dict(EC_PROFILE))
             io = client.ioctx(pool)
-            acked = {}
-            attempted = {}   # oid -> every payload ever submitted
+            g1 = b"g1-" * 340                 # 1020 bytes
+            g2 = b"g2-xyz" * 180              # 1080 bytes
+            await io.write_full("obj", g1)
+            pgid = client.objecter.object_pgid(pool, "obj")
+            coll = f"pg_{pgid.pool}_{pgid.seed}"
+            _, _, acting, primary = \
+                client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+            posd = cluster.osds[primary]
+            # capture the primary's complete g1 shard state
+            old_bytes = bytes(posd.store.read(coll, "obj"))
+            old_attrs = {k: posd.store.getattr(coll, "obj", k)
+                         for k in ("shard", "size", "hinfo_crc")}
+            old_ver = posd.store.get_version(coll, "obj")
+            await io.write_full("obj", g2)    # acked: every shard at g2
 
-            async def put(i, gen, timeout=60):
-                oid = f"obj{i}"
-                data = f"g{gen}-{i}-".encode() * 100
-                attempted.setdefault(oid, set()).add(data)
-                try:
-                    await io.write_full(oid, data, timeout=timeout)
-                    acked[oid] = data
-                except (IOError, OSError, TimeoutError):
-                    pass
+            # surgically regress ONLY the primary's shard back to g1
+            # (bytes + attrs + version all self-consistent, crc clean)
+            txn = (Transaction()
+                   .write(coll, "obj", 0, old_bytes)
+                   .truncate(coll, "obj", len(old_bytes)))
+            for k, v in old_attrs.items():
+                txn.setattr(coll, "obj", k, v)
+            txn.set_version(coll, "obj", old_ver)
+            posd.store.queue_transaction(txn)
 
-            for round_no in range(3):
-                for i in range(4):
-                    await put(i, round_no)
-                # find the primary of a random object and bounce it while
-                # writes are in flight
-                oid = f"obj{rng.randrange(4)}"
-                pgid = client.objecter.object_pgid(pool, oid)
-                _, _, _, primary = \
-                    client.objecter.osdmap.pg_to_up_acting_osds(pgid)
-                if primary < 0 or primary not in cluster.osds:
-                    continue
-                writes = asyncio.gather(
-                    *[put(i, round_no + 10, timeout=20) for i in range(4)],
-                    return_exceptions=True)
-                await asyncio.sleep(rng.random() * 0.05)
-                stopped = cluster.osds.pop(primary)
-                store = stopped.store
-                await stopped.stop()
-                await writes
-                osd = OSDDaemon(primary, cluster.mon_addr, config=cfg,
-                                store=store)
-                await osd.start()
-                cluster.osds[primary] = osd
-                deadline = asyncio.get_event_loop().time() + 20
-                while asyncio.get_event_loop().time() < deadline:
-                    if cluster.mon.osdmap.osd_up[primary]:
-                        break
-                    await asyncio.sleep(0.05)
-                await asyncio.sleep(1.0)
+            # read must be the committed generation, whole — not g2
+            # bytes cut to g1's 1020
+            assert await io.read("obj", timeout=60) == g2
 
-            # convergence: every object must hold SOME whole submitted
-            # payload (a timed-out write may legitimately land after its
-            # client gave up — at-least-once semantics — but torn or
-            # mixed-generation content is never acceptable)
-            for oid, data in sorted(acked.items()):
-                got = await io.read(oid, timeout=60)
-                assert got in attempted[oid], \
-                    (oid, got[:24], data[:24])
-            # no silent shard divergence: scrub every PG, expect zero
-            # inconsistent objects after recovery settles (generous
-            # deadline: under xdist CPU contention recovery rounds and
-            # scrubs can each take seconds)
-            deadline = asyncio.get_event_loop().time() + 90
-            while True:
-                bad = []
-                for o in cluster.osds.values():
-                    for st in list(o.pgs.values()):
-                        if st.primary != o.osd_id:
-                            continue
-                        rep = await o.scrub_pg(st)
-                        bad.extend(rep["inconsistent"])
-                if not bad or asyncio.get_event_loop().time() > deadline:
-                    break
-                await asyncio.sleep(1.0)
-            assert not bad, f"divergent shards after thrash: {bad}"
+            # scrub sees the generation divergence and rebuilds the
+            # stale shard from the committed group
+            st = posd.pgs[pgid]
+            rep = await posd.scrub_pg(st)
+            assert "obj" in rep["inconsistent"], \
+                "scrub missed the stale (old-generation) shard"
+            assert "obj" in rep["repaired"]
+            assert posd.store.getattr(coll, "obj", "size") == \
+                str(len(g2)).encode()
+            assert await io.read("obj", timeout=60) == g2
         finally:
             await cluster.stop()
 
     run(scenario())
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_thrash_primaries_mid_ec_write():
+    """Thrasher variant bouncing OSDs mid-write on an EC pool (round-4
+    item 5 gate), now a seeded chaos scenario: restart events race the
+    write bursts on a deterministic schedule; afterwards every acked
+    object must hold SOME whole submitted payload (at-least-once — a
+    timed-out write may land after its client gave up, but torn or
+    mixed-generation bytes never pass) and a full scrub pass finds zero
+    silent shard divergence."""
+    from ceph_tpu.chaos.scenario import builtin_scenarios, run_scenario
+
+    v = run(run_scenario(builtin_scenarios()["thrash-ec-midwrite"], 11))
+    assert v.passed, v.failures
+    assert v.counters.get("daemon_restarts") == 3

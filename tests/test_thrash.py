@@ -1,165 +1,63 @@
-"""OSD thrashing: randomized kill/restart under continuous writes.
+"""OSD thrashing as seeded chaos scenarios.
 
-The tier-4 analog of qa/tasks/thrashosds.py + ceph_manager.py
-(kill_osd :202 / revive_osd :380): a seeded sequence of daemon bounces
-interleaved with client writes; afterwards the cluster must converge —
-every object readable with its last-acknowledged contents.
+The tier-4 analog of qa/tasks/thrashosds.py, rebuilt on graft-chaos
+(round-8 satellite): the old inline thrashers improvised faults with
+ad-hoc sleeps and leaned on ``contention_retry`` to absorb their own
+timing races — exactly why they were load-flaky.  Now the fault
+schedule is resolved up-front from the scenario seed, the runner owns
+convergence waits, and the durability invariants (every acked write
+readable and checksum-clean, snapshots consistent, no stuck PG,
+HEALTH_OK, lockdep-acyclic) do the judging.  A failure replays
+bit-identically with ``scripts/chaos.py run --scenario ... --seed ...``.
 """
 
 import asyncio
-import random
 
-from tests._flaky import contention_retry
 import pytest
 
-from ceph_tpu.cluster.osd import OSDDaemon
-from ceph_tpu.cluster.vstart import _fast_config, start_cluster
+from ceph_tpu.chaos.scenario import (
+    Scenario,
+    builtin_scenarios,
+    ev,
+    run_scenario,
+)
 
 
 def run(coro):
     return asyncio.run(coro)
 
 
-@contention_retry()
+@pytest.mark.chaos
+@pytest.mark.slow
 def test_thrash_osds_replicated():
-    async def scenario():
-        rng = random.Random(42)
-        cfg = _fast_config()
-        cfg.mon_osd_down_out_interval = 60.0   # bounce, don't rebalance
-        cluster = await start_cluster(5, config=cfg)
-        try:
-            client = await cluster.client()
-            pool = await client.pool_create("thrash", "replicated",
-                                            pg_num=8, size=3)
-            io = client.ioctx(pool)
-            acked = {}
-
-            async def put(i, gen):
-                oid = f"obj{i}"
-                data = f"gen{gen}-{i}-".encode() * 60
-                try:
-                    await io.write_full(oid, data, timeout=60)
-                    acked[oid] = data   # only acknowledged writes count
-                except (IOError, OSError, TimeoutError):
-                    pass
-
-            down = None
-            for round_no in range(4):
-                for i in range(6):
-                    await put(i, round_no)
-                victim = rng.choice([o for o in list(cluster.osds)
-                                     if len(cluster.osds) > 3])
-                # bounce: stop keeping the store, write more, restart
-                stopped = cluster.osds.pop(victim)
-                store = stopped.store
-                await stopped.stop()
-                down = victim
-                for i in range(6, 10):
-                    await put(i, round_no)
-                osd = OSDDaemon(victim, cluster.mon_addr, config=cfg,
-                                store=store)
-                await osd.start()
-                cluster.osds[victim] = osd
-                deadline = asyncio.get_event_loop().time() + 20
-                while asyncio.get_event_loop().time() < deadline:
-                    if cluster.mon.osdmap.osd_up[victim]:
-                        break
-                    await asyncio.sleep(0.05)
-
-            # convergence: every acknowledged write reads back intact
-            for oid, data in sorted(acked.items()):
-                got = await io.read(oid, timeout=60)
-                assert got == data, oid
-
-            def divergent():
-                out = []
-                for oid, data in sorted(acked.items()):
-                    pgid = client.objecter.object_pgid(pool, oid)
-                    coll = f"pg_{pgid.pool}_{pgid.seed}"
-                    _, _, acting, _ = \
-                        client.objecter.osdmap.pg_to_up_acting_osds(pgid)
-                    blobs = set()
-                    for o in acting:
-                        if o >= 0 and o in cluster.osds:
-                            try:
-                                blobs.add(bytes(
-                                    cluster.osds[o].store.read(coll, oid)))
-                            except FileNotFoundError:
-                                blobs.add(b"<missing>")
-                    if blobs != {data}:
-                        out.append((oid, [b[:16] for b in blobs]))
-                return out
-
-            # replicas must converge byte-for-byte within a bounded
-            # window (recovery passes run per map change; queries against
-            # recently-bounced peers can take seconds each)
-            deadline = asyncio.get_event_loop().time() + 30
-            bad = divergent()
-            while bad and asyncio.get_event_loop().time() < deadline:
-                await asyncio.sleep(1.0)
-                bad = divergent()
-            assert not bad, bad
-        finally:
-            await cluster.stop()
-
-    run(scenario())
+    """Seeded restart-bounces under continuous writes with snapshots in
+    the mix (the old test_thrash_osds_replicated +
+    test_thrash_osds_with_snapshots, one deterministic schedule)."""
+    v = run(run_scenario(builtin_scenarios()["thrash-replicated"], 42))
+    assert v.passed, v.failures
+    assert v.counters.get("daemon_restarts") == 4
+    assert v.acked_objects == 8
 
 
-@contention_retry()
-def test_thrash_osds_with_snapshots():
-    """Thrash with pool snapshots in the mix (round-4 item 1 gate): after
-    bounces + recovery, every snap reads back the contents recorded at
-    snap time and heads read their last-acknowledged data."""
-    async def scenario():
-        rng = random.Random(7)
-        cfg = _fast_config()
-        cfg.mon_osd_down_out_interval = 60.0
-        cluster = await start_cluster(5, config=cfg)
-        try:
-            client = await cluster.client()
-            pool = await client.pool_create("sthrash", "replicated",
-                                            pg_num=8, size=3)
-            io = client.ioctx(pool)
-            acked = {}
-            snap_expect = {}   # (snapid) -> {oid: bytes at snap time}
-
-            async def put(i, gen):
-                oid = f"obj{i}"
-                data = f"snapgen{gen}-{i}-".encode() * 50
-                try:
-                    await io.write_full(oid, data, timeout=60)
-                    acked[oid] = data
-                except (IOError, OSError, TimeoutError):
-                    pass
-
-            for round_no in range(3):
-                for i in range(5):
-                    await put(i, round_no)
-                sid = await io.snap_create(f"s{round_no}")
-                snap_expect[sid] = dict(acked)
-                victim = rng.choice(list(cluster.osds))
-                stopped = cluster.osds.pop(victim)
-                store = stopped.store
-                await stopped.stop()
-                for i in range(5):
-                    await put(i, round_no + 100)  # overwrite under snapc
-                osd = OSDDaemon(victim, cluster.mon_addr, config=cfg,
-                                store=store)
-                await osd.start()
-                cluster.osds[victim] = osd
-                deadline = asyncio.get_event_loop().time() + 20
-                while asyncio.get_event_loop().time() < deadline:
-                    if cluster.mon.osdmap.osd_up[victim]:
-                        break
-                    await asyncio.sleep(0.05)
-
-            for oid, data in sorted(acked.items()):
-                assert await io.read(oid, timeout=60) == data, oid
-            for sid, objs in snap_expect.items():
-                for oid, data in sorted(objs.items()):
-                    got = await io.read(oid, snapid=sid, timeout=60)
-                    assert got == data, (oid, sid)
-        finally:
-            await cluster.stop()
-
-    run(scenario())
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_thrash_osds_kill_revive():
+    """Kill/revive variant: dead OSDs lose their (RAM) stores entirely,
+    so recovery must re-protect every object from the survivors before
+    the revived daemons rejoin."""
+    sc = Scenario(
+        name="thrash-kill", osds=5, pool_size=3, pg_num=8,
+        rounds=3, objects_per_round=6,
+        events=(
+            ev(0, "kill_osd"),
+            ev(1, "revive_osd"),
+            ev(1, "kill_osd"),
+            ev(2, "revive_osd"),
+        ),
+        invariants=("durability", "acting", "health", "scrub",
+                    "lockdep"),
+        converge_timeout=90.0)
+    v = run(run_scenario(sc, 1337))
+    assert v.passed, v.failures
+    assert v.counters.get("daemon_kills") == 2
+    assert v.counters.get("daemon_revives") == 2
