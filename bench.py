@@ -350,18 +350,33 @@ EC_CONFIGS = [
 ]
 
 
-def bench_cluster_io(secs_write=4.0, secs_read=3.0, perf_dump=False):
+def bench_cluster_io(secs_write=4.0, secs_read=3.0, perf_dump=False,
+                     attribute=False):
     """End-to-end cluster I/O (the reference `rados bench` run,
     src/tools/rados/rados.cc:103): a live 3-OSD vstart cluster with an
     EC k2m1 pool, measured through the full client->primary->EC
-    encode(TPU)->replicate pipeline.  Returns a list of metric rows."""
+    encode(TPU)->replicate pipeline.  Returns a list of metric rows.
+
+    ``attribute``: roll completed write traces into a per-stage
+    wall-time breakdown (graft-trace, `dump_op_attribution`) — the
+    instrument for the cluster/device 1000x gap (ROADMAP items 1-2).
+    The mode widens the op-history window so the whole timing window is
+    attributable; the DEFAULT bench config leaves tracing off and is
+    bit-identical to previous rounds (BENCH_NOTES zero-overhead
+    contract)."""
     import asyncio
 
     from ceph_tpu.cluster.vstart import _fast_config, start_cluster
     from ceph_tpu.tools.rados import bench as rados_bench
 
     async def scenario():
-        cluster = await start_cluster(3, config=_fast_config())
+        config = _fast_config()
+        if attribute:
+            # every write of the timing window must stay in the history
+            # ring to be attributable (4s at cluster_io rates is well
+            # under 4096 ops)
+            config.osd_op_history_size = 4096
+        cluster = await start_cluster(3, config=config)
         try:
             client = await cluster.client()
             pool = await client.pool_create(
@@ -375,9 +390,31 @@ def bench_cluster_io(secs_write=4.0, secs_read=3.0, perf_dump=False):
             for i in range(3):
                 await io.write_full(f"warm_{i}", b"\xa5" * (1 << 20))
                 await io.read(f"warm_{i}")
+            if attribute:
+                from ceph_tpu.trace.attribution import flush_op_history
+
+                await flush_op_history(cluster, 4096)
             w = await rados_bench(io, secs_write, "write",
                                   concurrency=16, block_size=1 << 20,
                                   cleanup=False)
+            attribution = None
+            if attribute:
+                # collect BEFORE the read bench so the breakdown is the
+                # write workload's; match= isolates write_full ops.
+                # Every OSD's report is merged: primaries spread across
+                # the acting sets, so each tracker holds a disjoint
+                # slice of the bench ops
+                from ceph_tpu.trace.attribution import merge_reports
+
+                wall_s = w["lat_avg_ms"] / 1e3
+                reports = []
+                for oid in cluster.osds:
+                    reports.append(await cluster.daemon_command(
+                        f"osd.{oid}",
+                        {"prefix": "dump_op_attribution",
+                         "args": {"match": "write_full"}}))
+                attribution = merge_reports(reports,
+                                            measured_wall_s=wall_s)
             r = await rados_bench(io, secs_read, "rand",
                                   concurrency=16, block_size=1 << 20)
             dumps = {}
@@ -388,11 +425,11 @@ def bench_cluster_io(secs_write=4.0, secs_read=3.0, perf_dump=False):
                 for oid, osd in cluster.osds.items():
                     dumps[f"osd.{oid}"] = osd.perfcoll.dump()
                 dumps["mon"] = cluster.mon.perf.dump()
-            return w, r, dumps
+            return w, r, dumps, attribution
         finally:
             await cluster.stop()
 
-    w, r, dumps = asyncio.run(scenario())
+    w, r, dumps, attribution = asyncio.run(scenario())
     rows = []
     for tag, rep in (("write", w), ("rand_read", r)):
         rows.append({
@@ -403,6 +440,13 @@ def bench_cluster_io(secs_write=4.0, secs_read=3.0, perf_dump=False):
             "lat_p50_ms": round(rep["lat_p50_ms"], 2),
             "lat_p95_ms": round(rep["lat_p95_ms"], 2),
             "iops": round(rep["iops"], 1)})
+    if attribution is not None:
+        rows.append({
+            "metric": "cluster_io_write_ec_k2m1_1MiB_t16_attribution",
+            "unit": "json", "mode": "cluster_vstart",
+            "vs_baseline": None, "baseline": None,
+            "baseline_src": "unmeasured",
+            "attribution": attribution})
     if perf_dump:
         rows.append({"metric": "cluster_perf_dump", "unit": "json",
                      "dumps": dumps})
@@ -420,6 +464,9 @@ def main():
     ap.add_argument("--perf-dump", action="store_true",
                     help="append daemon perf dumps + device-kernel "
                          "counters to the artifact")
+    ap.add_argument("--attribute", action="store_true",
+                    help="per-stage wall-time attribution of the "
+                         "cluster_io write bench (graft-trace)")
     args = ap.parse_args()
 
     results = []
@@ -456,7 +503,8 @@ def main():
             print(json.dumps({"metric": "crush_map_10kosd_1Mpg",
                               "error": repr(e)}), file=sys.stderr)
         try:
-            results.extend(bench_cluster_io(perf_dump=args.perf_dump))
+            results.extend(bench_cluster_io(perf_dump=args.perf_dump,
+                                            attribute=args.attribute))
         except Exception as e:
             print(json.dumps({"metric": "cluster_io", "error": repr(e)}),
                   file=sys.stderr)

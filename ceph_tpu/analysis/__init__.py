@@ -16,6 +16,9 @@ structurally, before anything runs:
                     codec plans (the denc analog).
 - ``asyncio_rules`` blocking calls inside ``async def`` and bare
                     ``asyncio.Lock`` in cluster/ escaping lockdep.
+- ``taskspawn``     unbounded per-op task spawns in cluster/ (discarded
+                    handles, grow-only registries) — every spawn needs
+                    a self-discarding tracker or a bounded slot.
 
 `engine.run_lint` drives the rules over a file set; `baseline` carries
 per-finding suppressions so accepted pre-existing findings don't block

@@ -193,9 +193,9 @@ class LintContext:
 def all_rules():
     """The registered rule families, import-cycle-free."""
     from ceph_tpu.analysis import asyncio_rules, jax_hygiene, lockgraph, \
-        symmetry
+        symmetry, taskspawn
 
-    return [lockgraph, jax_hygiene, symmetry, asyncio_rules]
+    return [lockgraph, jax_hygiene, symmetry, asyncio_rules, taskspawn]
 
 
 # cached last report (admin socket `graftlint report` serves this)

@@ -167,14 +167,20 @@ class ECBackendMixin:
         if peers:
             fut = self._make_waiter(reqid, len(peers))
             send_failures = 0
+            # span propagation: each shard sub-write carries the current
+            # span id so the replica's apply span joins this op's tree
+            subctx = self.tracer.context()
             for osd, shard in peers:
                 try:
-                    await self._send_osd(osd, M.MOSDECSubOpWrite(
+                    sub = M.MOSDECSubOpWrite(
                         reqid=reqid, pgid=st.pgid, oid=oid, shard=shard,
                         data=shards[shard].tobytes(), chunk_off=chunk_off,
                         shard_size=shard_size, hinfo=hinfo, entry=entry,
                         pre_ops=pre_ops,
-                        epoch=self.osdmap.epoch))
+                        epoch=self.osdmap.epoch)
+                    if subctx is not None:
+                        sub.trace = dict(subctx)
+                    await self._send_osd(osd, sub)
                 except (ConnectionError, OSError, RuntimeError):
                     send_failures += 1
                     self._waiter_dec(reqid)
@@ -183,6 +189,7 @@ class ECBackendMixin:
                 if not fut.done():
                     await asyncio.wait_for(
                         fut, timeout=self.config.osd_client_op_timeout)
+                mark_current("sub_write_acked")
             except asyncio.TimeoutError:
                 return -110
             finally:
@@ -265,18 +272,29 @@ class ECBackendMixin:
 
     async def _handle_ec_write(self, conn: Connection,
                                msg: M.MOSDECSubOpWrite) -> None:
-        shard_size = msg.shard_size if msg.shard_size is not None \
-            else msg.chunk_off + len(msg.data)
-        self._apply_shard(msg.pgid, msg.oid, msg.shard, msg.data,
-                          msg.chunk_off, shard_size, msg.hinfo,
-                          pre_ops=msg.pre_ops)
-        st = self.pgs.get(msg.pgid)
-        if st is not None and msg.entry is not None:
-            self._log_mutation(st, msg.entry.op, msg.entry.oid,
-                               msg.entry.version, entry=msg.entry)
-        self.perf.inc("osd_ec_sub_writes")
-        await self._reply_osd(conn, msg, M.MOSDECSubOpWriteReply(
-            reqid=msg.reqid, result=0))
+        # replica-side span: joins the primary's op tree via the sub-op
+        # trace header (NULL_SPAN when untraced/disabled)
+        tr = getattr(msg, "trace", None)
+        span = self.tracer.start(
+            "ec_sub_write", trace_id=tr.get("id"),
+            parent_id=tr.get("span")) if tr else None
+        try:
+            shard_size = msg.shard_size if msg.shard_size is not None \
+                else msg.chunk_off + len(msg.data)
+            self._apply_shard(msg.pgid, msg.oid, msg.shard, msg.data,
+                              msg.chunk_off, shard_size, msg.hinfo,
+                              pre_ops=msg.pre_ops)
+            st = self.pgs.get(msg.pgid)
+            if st is not None and msg.entry is not None:
+                self._log_mutation(st, msg.entry.op, msg.entry.oid,
+                                   msg.entry.version, entry=msg.entry)
+            self.perf.inc("osd_ec_sub_writes")
+            await self._reply_osd(conn, msg, M.MOSDECSubOpWriteReply(
+                reqid=msg.reqid, result=0))
+        finally:
+            if span is not None:
+                span.annotate(shard=msg.shard, oid=msg.oid)
+                span.finish()
 
     async def _handle_ec_read(self, conn: Connection,
                               msg: M.MOSDECSubOpRead) -> None:
@@ -349,6 +367,8 @@ class ECBackendMixin:
                  if osd not in (self.osd_id, CRUSH_ITEM_NONE)
                  and shard not in got and shard not in exclude_shards]
         if peers and len(got) < need_k:
+            from ceph_tpu.cluster.optracker import mark_current
+
             reqid = self._next_reqid()
             fut = self._make_waiter(reqid, len(peers))
             for shard, osd in peers:
@@ -358,12 +378,14 @@ class ECBackendMixin:
                         off=off, length=length))
                 except (ConnectionError, OSError, RuntimeError):
                     self._waiter_dec(reqid)
+            mark_current("ec_sub_read_sent")
             try:
                 if fut.done():
                     acc = fut.result()
                 else:
                     acc = await asyncio.wait_for(
                         fut, timeout=self.config.osd_client_op_timeout)
+                mark_current("sub_read_acked")
             except asyncio.TimeoutError:
                 acc = self._pending[reqid][1]
             finally:

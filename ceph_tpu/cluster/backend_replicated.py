@@ -124,11 +124,19 @@ class ReplicatedBackendMixin:
         if peers:
             reqid = self._next_reqid()
             fut = self._make_waiter(reqid, len(peers))
-            rep = M.MOSDRepOp(reqid=reqid, pgid=st.pgid,
-                              txn_blob=txn.encode(),
-                              entry=entry,
-                              epoch=self.osdmap.epoch)
+            # span propagation: replicas' apply spans join this op's
+            # tree.  Message built PER PEER: send_message stamps hop
+            # events into msg.trace, so a shared dict would leak one
+            # replica's send stamp into the next replica's header
+            subctx = self.tracer.context()
+            txn_blob = txn.encode()
             for o in peers:
+                rep = M.MOSDRepOp(reqid=reqid, pgid=st.pgid,
+                                  txn_blob=txn_blob,
+                                  entry=entry,
+                                  epoch=self.osdmap.epoch)
+                if subctx is not None:
+                    rep.trace = dict(subctx)
                 try:
                     await self._send_osd(o, rep)
                 except (ConnectionError, OSError, RuntimeError):
@@ -142,6 +150,7 @@ class ReplicatedBackendMixin:
                 if not fut.done():
                     await asyncio.wait_for(
                         fut, timeout=self.config.osd_client_op_timeout)
+                mark_current("sub_op_acked")
             except asyncio.TimeoutError:
                 return -110
             finally:
@@ -362,11 +371,14 @@ class ReplicatedBackendMixin:
                     need = self.rewind_divergent_log(st, target)
                     if need:
                         # fallback removals (lost records): re-pull the
-                        # authoritative copies off the dispatch path
+                        # authoritative copies off the dispatch path,
+                        # tracked so the task self-discards (task-spawn
+                        # lint: a bare spawn here leaked one dead Task
+                        # per rewind for the daemon's life)
                         import asyncio as _aio
 
-                        _aio.get_event_loop().create_task(
-                            self._repull_after_rewind(st, list(need)))
+                        self._track(_aio.get_event_loop().create_task(
+                            self._repull_after_rewind(st, list(need))))
             self.perf.inc("osd_pushes_applied")
             return
         if msg.op == "snap_sync":

@@ -286,6 +286,11 @@ class BlueStore(ObjectStore):
             if self.fsync:
                 os.fsync(self._wal.fileno())
             self._dev.flush()
+        # store-commit boundary on the current op's timeline: the txn is
+        # WAL-durable here (no-op outside a tracked dispatch)
+        from ceph_tpu.cluster.optracker import mark_current
+
+        mark_current("store:commit")
         self._since_ckpt += 1
         if self._since_ckpt >= self.checkpoint_every:
             self.checkpoint()

@@ -61,6 +61,8 @@ class ClientOpsMixin:
             self._opq.enqueue(qos_client,
                               (conn, msg, time.monotonic()))
             self.perf.inc("osd_ops_queued_mclock")
+            self._queued_depth += 1
+            self.perf.set("osd_dispatch_queue_depth", self._queued_depth)
             self._opq_event.set()
             return
         # detach execution from the messenger read loop (the reference
@@ -81,15 +83,19 @@ class ClientOpsMixin:
         if q is None:
             q = self._ordered_q[key] = deque()
         q.append((conn, msg))
+        self._queued_depth += 1
+        self.perf.set("osd_dispatch_queue_depth", self._queued_depth)
         if key not in self._ordered_active:
             self._spawn_drainer(key, q)
 
     def _spawn_drainer(self, key, q) -> None:
         """Mark the FIFO active and start its drain task, tracked in
-        _opq_running so stop() can cancel it."""
+        _opq_running so stop() can cancel it.  The loop profiler (when
+        on) wraps it: spawn count + create->first-run queued delay +
+        wall time land in the osd_loop_task_* counters."""
         self._ordered_active.add(key)
         t = asyncio.get_event_loop().create_task(
-            self._drain_ordered(key, q))
+            self.loopmon.wrap(self._drain_ordered(key, q)))
         self._opq_running.add(t)
         t.add_done_callback(self._opq_running.discard)
 
@@ -100,6 +106,9 @@ class ClientOpsMixin:
         try:
             while q:
                 conn, msg = q.popleft()
+                self._queued_depth = max(0, self._queued_depth - 1)
+                self.perf.set("osd_dispatch_queue_depth",
+                              self._queued_depth)
                 await self._serve_queued_op(conn, msg)
         finally:
             self._ordered_active.discard(key)
@@ -129,13 +138,15 @@ class ClientOpsMixin:
                         pass
                 continue
             conn, msg, stamp = item
+            self._queued_depth = max(0, self._queued_depth - 1)
+            self.perf.set("osd_dispatch_queue_depth", self._queued_depth)
             if time.monotonic() - stamp > self.config.osd_client_op_timeout:
                 # the client abandoned this attempt and resent: executing
                 # the stale copy would double-apply the op
                 self.perf.inc("osd_ops_dropped_stale")
                 continue
             t = asyncio.get_event_loop().create_task(
-                self._serve_queued_op(conn, msg))
+                self.loopmon.wrap(self._serve_queued_op(conn, msg)))
             self._opq_running.add(t)
             t.add_done_callback(self._opq_running.discard)
 
@@ -201,13 +212,22 @@ class ClientOpsMixin:
             self.perf.hinc("osd_op_in_bytes_hist", in_bytes)
         from ceph_tpu.cluster.optracker import CURRENT_OP
 
+        # graft-trace: this daemon's dispatch span parents under the
+        # client's root via the header's span id; entering it installs
+        # CURRENT_SPAN so sub-op fan-out parents under it in turn
+        # (NULL_SPAN when tracing is off — no allocation, no retention)
+        tr = getattr(msg, "trace", None) or {}
         token = CURRENT_OP.set(top)
         try:
-            if any(o[0] in self._MUTATING_OPS for o in msg.ops):
-                await self._execute_mutation_dedup(conn, msg, m, pool, st,
-                                                  top)
-            else:
-                await self._execute_client_ops(conn, msg, m, pool, st, top)
+            with self.tracer.start("osd_op", trace_id=tr.get("id"),
+                                   parent_id=tr.get("span")) as ospan:
+                ospan.annotate(oid=msg.oid, pg=str(msg.pgid))
+                if any(o[0] in self._MUTATING_OPS for o in msg.ops):
+                    await self._execute_mutation_dedup(conn, msg, m, pool,
+                                                      st, top)
+                else:
+                    await self._execute_client_ops(conn, msg, m, pool, st,
+                                                   top)
         finally:
             CURRENT_OP.reset(token)
             top.finish()
@@ -359,7 +379,7 @@ class ClientOpsMixin:
                 except (ConnectionError, OSError):
                     pass
 
-            self._tasks.append(
+            self._track(
                 asyncio.get_event_loop().create_task(_notify_bg()))
             return
         # cache-pool admission (promote / proxy / forward /

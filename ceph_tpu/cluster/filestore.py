@@ -181,6 +181,11 @@ class FileStore(MemStore):
             # rot hits the live (RAM) state only — like media decay on
             # the applied copy; the journal frame stays pristine
             self.chaos.maybe_rot(self, txn)
+        # store-commit boundary on the current op's timeline: the txn is
+        # journal-durable and applied (no-op outside a tracked dispatch)
+        from ceph_tpu.cluster.optracker import mark_current
+
+        mark_current("store:commit")
         self._since_checkpoint += 1
         if self._since_checkpoint >= self.checkpoint_every and \
                 not self._ckpt_inflight:

@@ -126,7 +126,9 @@ class MDSDaemon(Dispatcher):
         self._client = None               # our own RADOS client
         self.fs: Optional[FileSystem] = None
         self._lock = DepLock("mds.big_lock")  # the single-MDS big lock
-        self._tasks: List[asyncio.Task] = []
+        # self-discarding background-task registry (the messenger/osd
+        # _track pattern; task-spawn lint invariant)
+        self._tasks: set = set()
         self._stopped = False
         self.lease_ttl = self.config.mds_lease_ttl
         # completed-request cache (the OSD reqid dup cache's MDS twin,
@@ -161,7 +163,7 @@ class MDSDaemon(Dispatcher):
         await self._replay_journal()
         await self._beacon()
         loop = asyncio.get_event_loop()
-        self._tasks.append(loop.create_task(self._beacon_loop()))
+        self._track(loop.create_task(self._beacon_loop()))
         return addr
 
     # -- subtree authority (Migrator analog) --------------------------------
@@ -293,9 +295,14 @@ class MDSDaemon(Dispatcher):
         live = "/" + "/".join(parts[:i] + parts[i + 2:])
         return self._norm(live), rec
 
+    def _track(self, task: asyncio.Task) -> asyncio.Task:
+        from ceph_tpu.utils.tasks import track_task
+
+        return track_task(self._tasks, task)
+
     async def stop(self) -> None:
         self._stopped = True
-        for t in self._tasks:
+        for t in list(self._tasks):
             t.cancel()
         if self._client is not None:
             await self._client.shutdown()

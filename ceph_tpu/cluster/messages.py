@@ -39,6 +39,10 @@ class MOSDAlive(Message):
     osd_id: int = -1
     statfs: Optional[Tuple[int, int]] = None   # (total_bytes, used_bytes)
     slow_ops: Optional[Tuple[int, float]] = None  # (count, oldest_age_s)
+    # event-loop profiler feed (ceph_tpu/trace/loopmon.py): (last_lag_s,
+    # window_max_s) since the previous beacon; None when the sampler is
+    # off.  Drives the mon's LOOP_LAG health check beside SLOW_OPS.
+    loop_lag: Optional[Tuple[float, float]] = None
 
 
 # op verbs that mutate object state — shared by the OSD's dedup/caps

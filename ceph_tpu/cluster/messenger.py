@@ -43,6 +43,7 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
+from ceph_tpu.cluster.optracker import mark_current
 from ceph_tpu.utils.lockdep import DepLock
 
 Addr = Tuple[str, int]
@@ -443,6 +444,13 @@ class Messenger:
                 msg = pickle.loads(payload)
                 if conn.peer is None:
                     conn.peer = msg.src
+                if msg.trace is not None:
+                    # receive-side hop stamp: the trace header records
+                    # when this endpoint took the message off the wire
+                    # (arrival, before any dispatch queueing) — the
+                    # "wire" stage boundary in op attribution
+                    msg.trace.setdefault("events", []).append(
+                        (f"msgr:{self.name}:recv", _time.time()))
                 if isinstance(msg, _MsgAck):
                     sess = self._sessions.get(conn.peer_addr)
                     if sess is not None:
@@ -642,6 +650,9 @@ class Messenger:
                     conn.writer.write(frame)  # duplicate delivery:
                     # handlers are idempotent by contract — prove it
                 await conn.writer.drain()
+                # flush boundary on the CURRENT op's timeline (sub-op
+                # fan-out runs under the op context; no-op otherwise)
+                mark_current("msgr:flushed")
                 if fate is not None and fate.reset:
                     # injected session reset AFTER the bytes left: the
                     # peer sees a clean close; our next send reconnects
@@ -696,9 +707,9 @@ class Messenger:
                     self._replay_later(sess, addr, delay)))
 
     def _track(self, task: asyncio.Task) -> asyncio.Task:
-        self._tasks.add(task)
-        task.add_done_callback(self._tasks.discard)
-        return task
+        from ceph_tpu.utils.tasks import track_task
+
+        return track_task(self._tasks, task)
 
     def _frame(self, conn: Connection, payload: bytes) -> bytes:
         key = conn._sign_key()

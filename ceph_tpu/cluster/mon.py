@@ -80,6 +80,9 @@ class Monitor(Dispatcher):
         # per-osd blocked-op telemetry from beacons: feeds the SLOW_OPS
         # health warning and clears as soon as beacons report drain
         self.osd_slow_ops: Dict[int, Tuple[int, float]] = {}
+        # per-osd event-loop lag from beacons (graft-trace loop
+        # profiler): feeds the LOOP_LAG health warning the same way
+        self.osd_loop_lag: Dict[int, Tuple[float, float]] = {}
         self.perf = PerfCounters("mon")
         # chaos-skewable per-daemon time source: lease staleness, beacon
         # grace, and the down-out tick all judge from THIS clock, so a
@@ -199,6 +202,14 @@ class Monitor(Dispatcher):
             checks["SLOW_OPS"] = (
                 f"{total} slow ops, oldest age {oldest:.2f}s "
                 f"(osds: {sorted(slow)})")
+        lagged = {o: ll for o, ll in self.osd_loop_lag.items()
+                  if o < m.max_osd and m.osd_up[o]}
+        if lagged:
+            worst = max(mx for _, mx in lagged.values())
+            checks["LOOP_LAG"] = (
+                f"event-loop lag up to {worst * 1e3:.0f}ms "
+                f"(osds: {sorted(lagged)}); something is blocking "
+                f"the daemon's asyncio loop")
         status = "HEALTH_OK" if not checks else (
             "HEALTH_ERR" if full or len(down) >= m.max_osd
             else "HEALTH_WARN")
@@ -569,6 +580,17 @@ class Monitor(Dispatcher):
                         # drained: the health warning clears with the
                         # next 'health' evaluation
                         self.osd_slow_ops.pop(msg.osd_id, None)
+                lag = getattr(msg, "loop_lag", None)
+                warn_at = self.config.loop_lag_warn
+                if lag is not None and warn_at > 0 and lag[1] >= warn_at:
+                    self.osd_loop_lag[msg.osd_id] = tuple(lag)
+                else:
+                    # drained below the threshold — or the daemon's
+                    # profiler is off (lag None, e.g. restarted with
+                    # the default config): LOOP_LAG clears like
+                    # SLOW_OPS; a non-reporting OSD must never hold a
+                    # stale warning
+                    self.osd_loop_lag.pop(msg.osd_id, None)
             return True
         if isinstance(msg, M.MOSDMapMsg):
             newmap = pickle.loads(msg.osdmap_blob)

@@ -48,6 +48,13 @@ class Objecter(Dispatcher):
             auth=self.config.cephx_context(f"client.{name}"),
             config=self.config)
         self.messenger.add_dispatcher(self)
+        # graft-trace: the client mints the root span of every op's
+        # cross-daemon tree (NULL_SPAN factory when trace_enabled=0)
+        from ceph_tpu.trace import Tracer
+
+        self.tracer = Tracer(f"client.{name}",
+                             enabled=bool(self.config.trace_enabled),
+                             keep=self.config.trace_keep)
         from ceph_tpu.cluster.monclient import MonTargeter
 
         self.monc = MonTargeter(
@@ -208,6 +215,20 @@ class Objecter(Dispatcher):
         self._trace_seq += 1
         trace_id = f"{self.client_name}:op{self._trace_seq}"
         trace_events = [("objecter:submit", _time.time())]
+        # root span of the op's cross-daemon tree: lives for the whole
+        # submit incl. resends, so its duration IS the client-observed
+        # wall time the stage attribution is judged against
+        with self.tracer.start("op_submit", trace_id=trace_id) as root:
+            root.annotate(oid=oid, ops=[o[0] for o in ops])
+            return await self._op_submit_attempts(
+                pool_id, oid, ops, deadline, backoff, explicit_pgid,
+                trace_id, trace_events, root, snapc, snapid)
+
+    async def _op_submit_attempts(self, pool_id, oid, ops, deadline,
+                                  backoff, explicit_pgid, trace_id,
+                                  trace_events, root, snapc, snapid):
+        import time as _time
+
         while True:
             # re-resolve the overlay every attempt: a tier/overlay change
             # mid-retry must re-target (the redirect is map state)
@@ -227,6 +248,10 @@ class Objecter(Dispatcher):
                 msg.trace = {"id": trace_id,
                              "events": trace_events +
                              [("objecter:send", _time.time())]}
+                if root.span_id is not None:
+                    # span propagation: the OSD's dispatch span parents
+                    # under this client root
+                    msg.trace["span"] = root.span_id
                 try:
                     await self.messenger.send_message(msg, tuple(addr))
                     # outwait the OSD's own replica-ack timeout: abandoning

@@ -45,6 +45,22 @@ def mark_current(event: str) -> None:
         op.mark(event)
 
 
+def _lock_trace(name: str, phase: str) -> None:
+    """DepLock trace hook: lock wait/acquire pairs land on the current
+    op's timeline (pg.lock / messenger.session wait become first-class
+    attribution stages) with one ContextVar read per acquisition."""
+    op = CURRENT_OP.get()
+    if op is not None:
+        op.mark(f"lock_{phase}:{name}")
+
+
+# install at import: every daemon that tracks ops pulls this module in,
+# and the hook itself is a no-op outside a tracked dispatch
+from ceph_tpu.utils import lockdep as _lockdep  # noqa: E402
+
+_lockdep.TRACE_HOOK = _lock_trace
+
+
 class TrackedOp:
     def __init__(self, tracker: "OpTracker", desc: str,
                  trace: Optional[Dict] = None):
@@ -54,7 +70,7 @@ class TrackedOp:
         self.desc = desc
         self.start = self._clock.monotonic()
         self.wall_start = self._clock.time()
-        self.events: List[tuple] = [(0.0, "initiated")]
+        self.events: List[tuple] = []
         self.duration: Optional[float] = None
         self.trace_id: Optional[str] = None
         if trace:
@@ -62,9 +78,16 @@ class TrackedOp:
             # inherited events carry wall-clock stamps from upstream
             # layers (objecter, messenger hops); rebase them onto this
             # op's clock — loopback daemons share the wall clock, so
-            # negative offsets faithfully mean "before OSD arrival"
+            # negative offsets faithfully mean "before OSD arrival".
+            # Clamp at 0.0: the wall and monotonic clocks are sampled at
+            # different instants, so an inherited stamp can land
+            # epsilon-PAST our start and would sort after "initiated" —
+            # drifting the timeline (a pre-arrival hop rendered as if it
+            # happened mid-dispatch).  Everything upstream happened
+            # before this op existed, by causality.
             for name, ts in trace.get("events", ()):
-                self.events.append((ts - self.wall_start, name))
+                self.events.append((min(ts - self.wall_start, 0.0), name))
+        self.events.append((0.0, "initiated"))
 
     def mark(self, event: str) -> None:
         self.events.append((self._clock.monotonic() - self.start, event))
@@ -79,17 +102,26 @@ class TrackedOp:
         return self._clock.monotonic() - self.start
 
     def dump(self) -> Dict:
+        # sorted() is stable: same-stamp events keep insertion (causal)
+        # order, so the inherited client-side hops can never interleave
+        # into the OSD-side marks (the round-9 event-ordering fix)
+        ordered = sorted(self.events, key=lambda ev: ev[0])
         out = {
             "seq": self.seq,
             "description": self.desc,
             "age": self._clock.monotonic() - self.start,
             "duration": self.duration,
             "type_data": {"events": [
-                {"time": round(t, 6), "event": e}
-                for t, e in sorted(self.events, key=lambda ev: ev[0])]},
+                {"time": round(t, 6), "event": e} for t, e in ordered]},
         }
         if self.trace_id is not None:
             out["trace_id"] = self.trace_id
+        if self.duration is not None:
+            # stage-labeled spans derived from the same timeline, so
+            # dump_historic_ops and graft-trace agree on one op story
+            from ceph_tpu.trace.attribution import spans_from_events
+
+            out["spans"] = spans_from_events(ordered)
         return out
 
 
@@ -146,6 +178,11 @@ class OpTracker:
         ages = [op.age() for op in self._in_flight.values()]
         slow = [a for a in ages if a >= self.slow_threshold]
         return len(slow), max(slow) if slow else 0.0
+
+    def history(self) -> List[TrackedOp]:
+        """Completed ops, oldest first (the attribution aggregator's
+        input — ceph_tpu.trace.attribution.aggregate_tracker)."""
+        return list(self._history)
 
     # -- admin-command surfaces (reference dump_historic_ops et al.) --------
 

@@ -121,6 +121,19 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
             slow_size=self.config.osd_op_history_slow_op_size,
             slow_threshold=self.config.osd_op_complaint_time,
             clock=self.clock)
+        # graft-trace seams (ceph_tpu/trace/): per-daemon span tracer +
+        # event-loop profiler, both provable no-ops at default config
+        from ceph_tpu.trace import LoopProfiler, Tracer
+
+        self.tracer = Tracer(f"osd.{osd_id}",
+                             enabled=bool(self.config.trace_enabled),
+                             keep=self.config.trace_keep)
+        self.loopmon = LoopProfiler(
+            self.perf, self.config.loop_profile_interval,
+            prefix="osd_loop")
+        # live depth of the ordered dispatch queues (ShardedOpWQ-depth
+        # analog) — maintained by client_ops, exported as a perf gauge
+        self._queued_depth = 0
         # last slow-op count surfaced to the cluster log (warn on rise,
         # log clearance on drain — the mon health check itself keys off
         # the beacon stream)
@@ -133,7 +146,11 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         # promote/flush): reqid -> future resolved by MOSDOpReply
         self._internal_inflight: Dict[Tuple, asyncio.Future] = {}
         self._internal_tid = 0
-        self._tasks: List[asyncio.Task] = []
+        # background tasks: a SELF-DISCARDING set (the messenger._track
+        # pattern) — per-op and per-map-change spawns must not
+        # accumulate one dead Task each for the daemon's life (the bug
+        # class the task-spawn graftlint rule polices)
+        self._tasks: Set[asyncio.Task] = set()
         # incomplete-recovery retry state (recovery.py
         # _queue_recovery_retry): per-PG capped backoff + the armed
         # retry task, so failed pulls/pushes re-run without needing
@@ -190,12 +207,21 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         await self._mon_send(
             M.MMonSubscribe(what="osdmap", addr=addr, since=since))
         loop = asyncio.get_event_loop()
-        self._tasks.append(loop.create_task(self._heartbeat_loop()))
-        self._tasks.append(loop.create_task(self._scrub_loop()))
-        self._tasks.append(loop.create_task(self._tier_agent_loop()))
+        self._track(loop.create_task(self._heartbeat_loop()))
+        self._track(loop.create_task(self._scrub_loop()))
+        self._track(loop.create_task(self._tier_agent_loop()))
         if self._opq is not None:
-            self._tasks.append(loop.create_task(self._opq_drain()))
+            self._track(loop.create_task(self._opq_drain()))
+        if self.loopmon.enabled:
+            self._track(loop.create_task(self.loopmon.sample()))
         return addr
+
+    def _track(self, task: asyncio.Task) -> asyncio.Task:
+        """Register a background task; it discards itself on completion
+        and stop() cancels whatever is still live."""
+        from ceph_tpu.utils.tasks import track_task
+
+        return track_task(self._tasks, task)
 
     def _load_superblock(self) -> int:
         """Resume from the persisted osdmap + PG logs (reference
@@ -368,8 +394,7 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                 pass
 
         try:
-            self._tasks.append(
-                asyncio.get_event_loop().create_task(_send()))
+            self._track(asyncio.get_event_loop().create_task(_send()))
         except RuntimeError:
             pass  # no running loop (teardown)
 
@@ -405,15 +430,27 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
             await self._handle_client_op(conn, msg)
             return True
         if isinstance(msg, M.MOSDRepOp):
-            txn = Transaction.decode(msg.txn_blob)
-            self.store.queue_transaction(txn)
-            st = self.pgs.get(msg.pgid)
-            if st is not None and msg.entry is not None:
-                self._log_mutation(st, msg.entry.op, msg.entry.oid,
-                                   msg.entry.version, entry=msg.entry)
-            self.perf.inc("osd_rep_ops")
-            await self._reply_osd(conn, msg, M.MOSDRepOpReply(
-                reqid=msg.reqid, result=0))
+            # replica-side span: joins the primary's op tree via the
+            # sub-op trace header (absent/None when untraced)
+            tr = getattr(msg, "trace", None)
+            span = self.tracer.start(
+                "rep_op", trace_id=tr.get("id"),
+                parent_id=tr.get("span")) if tr else None
+            try:
+                txn = Transaction.decode(msg.txn_blob)
+                self.store.queue_transaction(txn)
+                st = self.pgs.get(msg.pgid)
+                if st is not None and msg.entry is not None:
+                    self._log_mutation(st, msg.entry.op, msg.entry.oid,
+                                       msg.entry.version, entry=msg.entry)
+                self.perf.inc("osd_rep_ops")
+                await self._reply_osd(conn, msg, M.MOSDRepOpReply(
+                    reqid=msg.reqid, result=0))
+            finally:
+                # the failed/retried replica legs are exactly the spans
+                # the assembled tree must not lose
+                if span is not None:
+                    span.finish()
             return True
         if isinstance(msg, M.MOSDRepOpReply) or \
                 isinstance(msg, M.MOSDECSubOpWriteReply):
@@ -493,6 +530,9 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
             "osd_op_in_bytes_hist", unit=perfmod.UNIT_BYTES,
             prio=perfmod.PRIO_INTERESTING,
             desc="mutation payload size, log2 byte buckets")
+        self.perf.add_u64(
+            "osd_dispatch_queue_depth", prio=perfmod.PRIO_INTERESTING,
+            desc="client ops waiting in the ordered dispatch queues")
 
     def _build_admin_socket(self):
         """Register this daemon's command table (reference OSD::asok_
@@ -522,6 +562,28 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         asok.register("dump_historic_slow_ops",
                       lambda cmd: self.tracker.dump_historic_slow_ops(),
                       "slowest completed ops past the complaint time")
+
+        def _attribution(cmd):
+            from ceph_tpu.trace.attribution import aggregate_tracker
+
+            a = {**cmd, **cmd.get("args", {})}
+            return aggregate_tracker(
+                self.tracker, match=a.get("match"),
+                measured_wall_s=a.get("measured_wall_s"))
+
+        asok.register("dump_op_attribution", _attribution,
+                      "per-stage wall-time breakdown over completed ops "
+                      "(args: match=<desc substring>, measured_wall_s)")
+
+        def _trace_dump(cmd):
+            a = {**cmd, **cmd.get("args", {})}
+            tid = a.get("trace_id")
+            if tid is not None:
+                return self.tracer.dump_trace(tid)
+            return self.tracer.dump_recent(int(a.get("n", 20)))
+
+        asok.register("trace dump", _trace_dump,
+                      "completed graft-trace spans (args: trace_id | n)")
 
         async def _scrub(cmd):
             reports = {}
@@ -664,7 +726,7 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                                             instance=self.boot_instance))
         changed = self._advance_pgs()
         if changed and not self._stopped:
-            self._tasks.append(asyncio.get_event_loop().create_task(
+            self._track(asyncio.get_event_loop().create_task(
                 self._recover_all()))
         if not self._stopped and any(
                 set(newmap.pools[st.pgid.pool].removed_snaps)
@@ -672,7 +734,7 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                 for st in self.pgs.values()
                 if st.pgid.pool in newmap.pools
                 and newmap.pools[st.pgid.pool].removed_snaps):
-            self._tasks.append(asyncio.get_event_loop().create_task(
+            self._track(asyncio.get_event_loop().create_task(
                 self._snap_trim_all()))
 
     async def _snap_trim_all(self) -> None:
@@ -834,7 +896,12 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
             try:
                 await self._mon_send(M.MOSDAlive(
                     osd_id=self.osd_id, statfs=self.store.statfs(),
-                    slow_ops=(slow_n, slow_oldest)))
+                    slow_ops=(slow_n, slow_oldest),
+                    loop_lag=self.loopmon.lag_report()))
+                # the beacon delivered this window's max: start the next
+                # window, so a drained stall clears LOOP_LAG like a
+                # drained op queue clears SLOW_OPS
+                self.loopmon.reset_window()
             except Exception:
                 pass
             # perf-counter stream to the active mgr (MgrClient::send_report)

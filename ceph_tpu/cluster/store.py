@@ -14,6 +14,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from ceph_tpu.cluster.optracker import mark_current
+
 
 @dataclass
 class Obj:
@@ -146,6 +148,9 @@ class MemStore(ObjectStore):
         self._commit(txn)
         if self.chaos is not None:
             self.chaos.maybe_rot(self, txn)
+        # store-commit boundary on the current op's timeline (no-op
+        # outside a tracked dispatch — recovery, replicas, scrub)
+        mark_current("store:commit")
 
     def _commit(self, txn: Transaction) -> None:
         with self._lock:

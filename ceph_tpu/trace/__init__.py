@@ -1,0 +1,31 @@
+"""graft-trace: cross-daemon span tracing + event-loop profiling.
+
+The observability instrument for ROADMAP items 1-2 (the ~1000x
+cluster/device gap): one client op becomes one cross-daemon tree of
+timed spans, its event timeline rolls up into a per-stage wall-time
+breakdown, and an asyncio profiler watches the loop the whole daemon
+runs on.  Everything is a provable no-op at default config — the same
+contract the chaos injectors honor — so the load-sensitive bench trust
+model (BENCH_NOTES) is untouched.
+
+- ``span``        Tracer/Span/NULL_SPAN, header propagation, tree assembly.
+- ``attribution`` event timeline -> per-stage latency attribution.
+- ``loopmon``     sampled event-loop lag + task queue/wall profiling.
+- ``perfetto``    chrome://tracing / Perfetto JSON export.
+"""
+
+from ceph_tpu.trace.span import (  # noqa: F401
+    CURRENT_SPAN,
+    NULL_SPAN,
+    Span,
+    Tracer,
+    assemble_tree,
+)
+from ceph_tpu.trace.attribution import (  # noqa: F401
+    aggregate,
+    aggregate_tracker,
+    attribute_events,
+    spans_from_events,
+    stage_for,
+)
+from ceph_tpu.trace.loopmon import LoopProfiler  # noqa: F401

@@ -61,6 +61,20 @@ OPTIONS: List[Option] = [
     Option("osd_mclock_default_reservation", float, 0.0),
     Option("osd_mclock_default_weight", float, 1.0),
     Option("osd_mclock_default_limit", float, 0.0),
+    # graft-trace (ceph_tpu/trace/): span tracing + event-loop profiling.
+    # All-off defaults keep both provable no-ops (the chaos-injector
+    # contract): Tracer.start returns the NULL_SPAN singleton and the
+    # LoopProfiler declares/samples nothing.
+    Option("trace_enabled", int, 0,
+           "graft-trace span tracing (0 = off: provable no-op)",
+           min=0, max=1),
+    Option("trace_keep", int, 256,
+           "completed traces retained per daemon tracer", min=1),
+    Option("loop_profile_interval", float, 0.0,
+           "event-loop lag sampler period (s); 0 disables", min=0),
+    Option("loop_lag_warn", float, 0.5,
+           "sampled loop lag at/above this raises the LOOP_LAG health "
+           "warning (needs the sampler on)", min=0),
     # mon
     Option("mon_osd_down_out_interval", float, 30.0,
            "auto-out after down this long"),

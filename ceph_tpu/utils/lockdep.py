@@ -18,6 +18,14 @@ class LockCycleError(RuntimeError):
     pass
 
 
+# op-trace seam (graft-trace): called as hook(lock_name, phase) with
+# phase "wait" just before acquisition and "acquired" just after, so
+# lock-wait time lands on the current op's event timeline without any
+# per-call-site instrumentation.  Installed by ceph_tpu.cluster
+# .optracker at import; the default None keeps DepLock standalone.
+TRACE_HOOK = None
+
+
 class LockDep:
     _instance: Optional["LockDep"] = None
 
@@ -93,7 +101,12 @@ class DepLock:
         key = self._task_key()
         held = DepLock._held.setdefault(key, [])
         LockDep.instance().will_lock(self.name, held)
+        hook = TRACE_HOOK
+        if hook is not None:
+            hook(self.name, "wait")
         await self._lock.acquire()
+        if hook is not None:
+            hook(self.name, "acquired")
         held.append(self.name)
         return self
 

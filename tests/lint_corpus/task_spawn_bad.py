@@ -1,0 +1,29 @@
+"""task-spawn bad corpus: every per-op spawn here leaks.
+
+Linted with relpath ceph_tpu/cluster/task_spawn_bad.py — the rule is
+cluster/-scoped.
+"""
+
+import asyncio
+
+
+class Daemon:
+    def __init__(self):
+        self._tasks = []
+        self._running = set()
+
+    async def handle_op(self):
+        # 1: handle discarded outright — nothing can ever cancel or
+        # observe this task, and a failure disappears silently
+        asyncio.get_event_loop().create_task(self._bg())
+        # 2: grow-only list — one dead Task per op for the daemon's life
+        self._tasks.append(asyncio.get_event_loop().create_task(self._bg()))
+        # 3: grow-only set (same leak, different container)
+        self._running.add(asyncio.get_event_loop().create_task(self._bg()))
+        # 4: bound to a name the function never touches again
+        orphan = asyncio.get_event_loop().create_task(self._bg())  # noqa: F841
+        # 5: ensure_future, same discard
+        asyncio.ensure_future(self._bg())
+
+    async def _bg(self):
+        await asyncio.sleep(0)

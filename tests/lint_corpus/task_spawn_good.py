@@ -1,0 +1,32 @@
+"""task-spawn good corpus: every spawn has a bounded lifetime."""
+
+import asyncio
+
+
+class Daemon:
+    def __init__(self):
+        self._bg_tasks = set()
+        self._timer = None
+        self._retries = {}
+
+    def _track(self, task):
+        self._bg_tasks.add(task)
+        task.add_done_callback(self._bg_tasks.discard)
+        return task
+
+    async def handle_op(self):
+        # handed to the self-discarding tracker — the callee owns it
+        self._track(asyncio.get_event_loop().create_task(self._bg()))
+        # replace-on-rearm attribute slot: at most one live task
+        self._timer = asyncio.get_event_loop().create_task(self._bg())
+        # keyed slot, same bounded shape
+        self._retries["pg1"] = asyncio.get_event_loop().create_task(
+            self._bg())
+        # bound, then explicitly given a discard path
+        t = asyncio.get_event_loop().create_task(self._bg())
+        t.add_done_callback(lambda _t: None)
+        # awaited: bounded by this coroutine
+        await asyncio.get_event_loop().create_task(self._bg())
+
+    async def _bg(self):
+        await asyncio.sleep(0)

@@ -18,7 +18,7 @@ import pytest
 
 from ceph_tpu.analysis import baseline as baseline_mod
 from ceph_tpu.analysis import (
-    asyncio_rules, engine, jax_hygiene, lockgraph, symmetry,
+    asyncio_rules, engine, jax_hygiene, lockgraph, symmetry, taskspawn,
 )
 from ceph_tpu.utils.lockdep import DepLock, LockCycleError, LockDep
 
@@ -208,6 +208,36 @@ def test_asyncio_bad_fires():
     assert "open()" in msgs
     assert "subprocess.run" in msgs
     assert "bare asyncio.Lock() escapes lockdep" in msgs
+
+
+# ----------------------------------------------------- rule: task-spawn
+
+
+def test_task_spawn_good_clean():
+    findings, _ = lint_files(
+        taskspawn, "task_spawn_good.py",
+        relpath_as="ceph_tpu/cluster/task_spawn_good.py")
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_task_spawn_bad_all_shapes_fire():
+    findings, _ = lint_files(
+        taskspawn, "task_spawn_bad.py",
+        relpath_as="ceph_tpu/cluster/task_spawn_bad.py")
+    msgs = "\n".join(f.message for f in findings)
+    assert len(findings) == 5, [f.render() for f in findings]
+    assert "task handle discarded" in msgs
+    assert "_tasks.append()" in msgs        # grow-only list
+    assert "_running.add()" in msgs         # grow-only set
+    assert "'orphan' but never tracked" in msgs
+    assert all(f.rule == "task-spawn" for f in findings)
+
+
+def test_task_spawn_scoped_to_cluster():
+    """The rule is cluster/-scoped like the bare-Lock rule: the same
+    source outside ceph_tpu/cluster/ stays quiet."""
+    findings, _ = lint_files(taskspawn, "task_spawn_bad.py")
+    assert findings == []
 
 
 # ------------------------------------------------------- runtime wiring
