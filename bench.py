@@ -363,7 +363,14 @@ def bench_cluster_io(secs_write=4.0, secs_read=3.0, perf_dump=False,
     The mode widens the op-history window so the whole timing window is
     attributable; the DEFAULT bench config leaves tracing off and is
     bit-identical to previous rounds (BENCH_NOTES zero-overhead
-    contract)."""
+    contract).
+
+    Round 10: the stage table knows the overload regime — client
+    congestion-window waits book as ``throttle_wait``, dequeue-shed ops
+    as ``shed``, EC straggler hedges as ``hedge`` — so the wall_coverage
+    >= 0.90 trust floor holds with admission backpressure enabled, and
+    the attribution row carries the shed/pushback counters for the run
+    (all zero at default budgets)."""
     import asyncio
 
     from ceph_tpu.cluster.vstart import _fast_config, start_cluster
@@ -415,6 +422,15 @@ def bench_cluster_io(secs_write=4.0, secs_read=3.0, perf_dump=False,
                          "args": {"match": "write_full"}}))
                 attribution = merge_reports(reports,
                                             measured_wall_s=wall_s)
+                # backpressure context for the artifact: nonzero only
+                # when admission budgets are configured for the run
+                attribution["overload"] = {
+                    name: sum(o.perf.get(name)
+                              for o in cluster.osds.values())
+                    for name in ("osd_throttle_rejects",
+                                 "osd_ops_shed_expired",
+                                 "osd_qos_preempted",
+                                 "osd_ec_hedged_reads")}
             r = await rados_bench(io, secs_read, "rand",
                                   concurrency=16, block_size=1 << 20)
             dumps = {}

@@ -19,6 +19,9 @@ structurally, before anything runs:
 - ``taskspawn``     unbounded per-op task spawns in cluster/ (discarded
                     handles, grow-only registries) — every spawn needs
                     a self-discarding tracker or a bounded slot.
+- ``rpc_timeout``   bare ``await fut`` on RPC futures in cluster/ (no
+                    timeout/deadline: a lost reply hangs the coroutine
+                    for the daemon's lifetime).
 
 `engine.run_lint` drives the rules over a file set; `baseline` carries
 per-finding suppressions so accepted pre-existing findings don't block

@@ -193,9 +193,10 @@ class LintContext:
 def all_rules():
     """The registered rule families, import-cycle-free."""
     from ceph_tpu.analysis import asyncio_rules, jax_hygiene, lockgraph, \
-        symmetry, taskspawn
+        rpc_timeout, symmetry, taskspawn
 
-    return [lockgraph, jax_hygiene, symmetry, asyncio_rules, taskspawn]
+    return [lockgraph, jax_hygiene, symmetry, asyncio_rules, taskspawn,
+            rpc_timeout]
 
 
 # cached last report (admin socket `graftlint report` serves this)

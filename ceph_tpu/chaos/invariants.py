@@ -180,6 +180,24 @@ async def check_scrub(cluster, timeout: float = 90.0) -> List[str]:
     return bad
 
 
+def check_shed(cluster) -> List[str]:
+    """An overload scenario must actually exercise the shedding
+    machinery: at least one throttle pushback, deadline shed, or QoS
+    preemption across the cluster — a run where nothing shed means the
+    offered load never exceeded the budget and the scenario proved
+    nothing.  (SLOW_OPS staying clear is the existing ``health``
+    invariant's job at convergence.)"""
+    total = 0
+    for osd in cluster.osds.values():
+        for counter in ("osd_throttle_rejects", "osd_ops_shed_expired",
+                        "osd_sub_ops_shed_expired", "osd_qos_preempted"):
+            total += osd.perf.get(counter)  # 0 for never-bumped names
+    if total:
+        return []
+    return ["shed: overload run produced zero throttle pushbacks / "
+            "deadline sheds / QoS preemptions — budget never saturated"]
+
+
 def check_lockdep() -> List[str]:
     """The observed runtime lock graph must be acyclic (the same graph
     `lockdep dump` serves and graftlint merges)."""

@@ -106,6 +106,16 @@ class Scenario:
     config: Tuple[Tuple[str, object], ...] = ()
     write_timeout: float = 60.0
     converge_timeout: float = 60.0
+    # overload workload shape (round 10): "zipf" picks oids from a
+    # zipfian hot-object distribution (same oid may be written
+    # concurrently — use durability_mode="attempted"); burst_concurrency
+    # fires every round's writes concurrently (an offered-load burst
+    # against the admission budget); op_deadline bounds each write's
+    # client budget (> 0 arms the "deadline" invariant's bookkeeping:
+    # an ack arriving after its deadline is a failure)
+    workload: str = "seq"                    # "seq" | "zipf"
+    burst_concurrency: int = 0
+    op_deadline: float = 0.0
 
 
 @dataclass
@@ -207,6 +217,26 @@ def _payload(rng, oid: str, gen: int, repeat: int) -> bytes:
     return tag.encode() * repeat
 
 
+_ZIPF_CUM: Dict[Tuple[int, float], List[float]] = {}
+
+
+def _zipf_pick(rng, n: int, alpha: float = 1.2) -> int:
+    """Rank drawn from a zipfian over [0, n): a few hot objects take
+    most writes (the million-client hot-set shape, ROADMAP item 4).
+    Cumulative weights are precomputed per (n, alpha) — one rng draw
+    and a binary search per pick, same stream consumption as the
+    linear scan it replaces (seed replay unaffected)."""
+    import bisect
+    from itertools import accumulate
+
+    cum = _ZIPF_CUM.get((n, alpha))
+    if cum is None:
+        cum = _ZIPF_CUM[(n, alpha)] = list(accumulate(
+            1.0 / ((r + 1) ** alpha) for r in range(n)))
+    x = rng.random() * cum[-1]
+    return min(bisect.bisect_left(cum, x), n - 1)
+
+
 def _store_factory(scenario: Scenario, tmpdir: Optional[str]):
     if scenario.store == "mem":
         return None
@@ -261,17 +291,36 @@ async def run_scenario(scenario: Scenario, seed: int,
                 pg_num=scenario.pg_num, size=scenario.pool_size)
         io = client.ioctx(pool)
 
+        deadline_misses: List[str] = []
+        loop = asyncio.get_event_loop()
+
         async def put(i: int, gen: int, timeout: float) -> None:
-            oid = f"obj{i}"
+            if scenario.workload == "zipf":
+                # zipfian hot objects: concurrent writers may race on
+                # one oid — attempted-mode durability judges those
+                oid = f"obj{_zipf_pick(wl, scenario.objects_per_round)}"
+            else:
+                oid = f"obj{i}"
             data = _payload(wl, oid, gen, scenario.payload_repeat)
             attempted.setdefault(oid, set()).add(data)
+            t0 = loop.time()
             try:
                 await io.write_full(oid, data, timeout=timeout)
-                acked[oid] = data
-                acked_crcs[oid] = crcmod.crc32c(0xFFFFFFFF, data)
             except (IOError, OSError, TimeoutError):
-                pass
+                return
+            if scenario.op_deadline:
+                elapsed = loop.time() - t0
+                if elapsed > timeout + 0.25:
+                    # the zero-acked-but-expired acceptance criterion:
+                    # an ack arriving after the client's deadline means
+                    # deadline shedding failed somewhere in the stack
+                    deadline_misses.append(
+                        f"deadline: {oid} acked {elapsed:.2f}s after "
+                        f"submit, past its {timeout}s deadline")
+            acked[oid] = data
+            acked_crcs[oid] = crcmod.crc32c(0xFFFFFFFF, data)
 
+        put_timeout = scenario.op_deadline or scenario.write_timeout
         for rnd in range(scenario.rounds):
             evs = [e for e in schedule if e["round"] == rnd]
             for e in [e for e in evs if not e["during_writes"]
@@ -281,7 +330,8 @@ async def run_scenario(scenario: Scenario, seed: int,
             mid = [e for e in evs if e["during_writes"]]
             if mid:
                 burst = asyncio.gather(
-                    *[put(i, rnd, timeout=20.0)
+                    *[put(i, rnd,
+                          timeout=scenario.op_deadline or 20.0)
                       for i in range(scenario.objects_per_round)],
                     return_exceptions=True)
                 await asyncio.sleep(wl.random() * 0.05)
@@ -289,9 +339,23 @@ async def run_scenario(scenario: Scenario, seed: int,
                     await _apply_event(cluster, dmn, client, io, e, rot,
                                        acked, pool)
                 await burst
+            elif scenario.burst_concurrency:
+                # offered-load burst bounded at burst_concurrency
+                # in-flight writes — the overload regime the admission
+                # budget absorbs
+                gate = asyncio.Semaphore(scenario.burst_concurrency)
+
+                async def bounded_put(i, gen):
+                    async with gate:
+                        await put(i, gen, timeout=put_timeout)
+
+                await asyncio.gather(
+                    *[bounded_put(i, rnd)
+                      for i in range(scenario.objects_per_round)],
+                    return_exceptions=True)
             else:
                 for i in range(scenario.objects_per_round):
-                    await put(i, rnd, timeout=scenario.write_timeout)
+                    await put(i, rnd, timeout=put_timeout)
             for e in [e for e in evs if e.get("after_writes")]:
                 await _apply_event(cluster, dmn, client, io, e, rot,
                                    acked, pool)
@@ -329,6 +393,12 @@ async def run_scenario(scenario: Scenario, seed: int,
                     cluster, timeout=scenario.converge_timeout * 1.5)
             elif name == "lockdep":
                 failures += inv.check_lockdep()
+            elif name == "deadline":
+                # recorded inline by put(): every ack past its client
+                # deadline is one failure line
+                failures += deadline_misses
+            elif name == "shed":
+                failures += inv.check_shed(cluster)
             else:
                 failures.append(f"unknown invariant {name!r}")
     finally:
@@ -512,6 +582,42 @@ def builtin_scenarios() -> Dict[str, Scenario]:
             invariants=("durability", "snapshots", "acting", "health",
                         "scrub", "lockdep"),
             converge_timeout=60.0),
+        # graceful degradation under overload (round 10 acceptance
+        # gate): zipfian write bursts at 4x the admission budget with a
+        # shard holder killed mid-run.  Verdict = durability + "no
+        # acked op exceeded its deadline" + "shed count > 0" + HEALTH
+        # converging clear of a SLOW_OPS storm.  Slow-marked (see
+        # overload-smoke for the tier-1 variant).
+        "overload-shed": Scenario(
+            name="overload-shed", osds=4, pool_kind="erasure",
+            pool_size=3, pg_num=4,
+            ec_profile=(("plugin", "jerasure"),
+                        ("technique", "reed_sol_van"),
+                        ("k", "2"), ("m", "1")),
+            rounds=3, objects_per_round=24, payload_repeat=40,
+            durability_mode="attempted", workload="zipf",
+            burst_concurrency=24, op_deadline=20.0,
+            config=(("osd_op_throttle_ops", 6),),   # 24 offered vs 6
+            events=(
+                ev(1, "kill_osd"),
+                ev(2, "revive_osd"),
+            ),
+            invariants=("durability", "deadline", "shed", "acting",
+                        "health", "lockdep"),
+            converge_timeout=90.0),
+        # tier-1 smoke variant: one small 4x burst, no faults, purely
+        # structural assertions (shed fired, nothing acked late, the
+        # cluster converges) — the bench host is load-sensitive, so no
+        # timing thresholds here
+        "overload-smoke": Scenario(
+            name="overload-smoke", osds=3, pool_size=3, pg_num=4,
+            rounds=1, objects_per_round=12, payload_repeat=20,
+            durability_mode="attempted", workload="zipf",
+            burst_concurrency=12, op_deadline=25.0,
+            config=(("osd_op_throttle_ops", 3),),   # 12 offered vs 3
+            invariants=("durability", "deadline", "shed", "acting",
+                        "health", "lockdep"),
+            converge_timeout=45.0),
         # EC primaries crashed mid-write (the rewind thrasher)
         "thrash-ec-midwrite": Scenario(
             name="thrash-ec-midwrite", osds=4, pool_kind="erasure",

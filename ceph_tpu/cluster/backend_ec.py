@@ -170,6 +170,11 @@ class ECBackendMixin:
             # span propagation: each shard sub-write carries the current
             # span id so the replica's apply span joins this op's tree
             subctx = self.tracer.context()
+            # sub-writes inherit the client op's deadline (None for
+            # recovery traffic): a replica sheds the dead legs
+            from ceph_tpu.cluster.pg import CURRENT_OP_DEADLINE
+
+            sub_deadline = CURRENT_OP_DEADLINE.get()
             for osd, shard in peers:
                 try:
                     sub = M.MOSDECSubOpWrite(
@@ -177,7 +182,8 @@ class ECBackendMixin:
                         data=shards[shard].tobytes(), chunk_off=chunk_off,
                         shard_size=shard_size, hinfo=hinfo, entry=entry,
                         pre_ops=pre_ops,
-                        epoch=self.osdmap.epoch)
+                        epoch=self.osdmap.epoch,
+                        deadline=sub_deadline)
                     if subctx is not None:
                         sub.trace = dict(subctx)
                     await self._send_osd(osd, sub)
@@ -188,7 +194,7 @@ class ECBackendMixin:
             try:
                 if not fut.done():
                     await asyncio.wait_for(
-                        fut, timeout=self.config.osd_client_op_timeout)
+                        fut, timeout=self._ack_wait_timeout())
                 mark_current("sub_write_acked")
             except asyncio.TimeoutError:
                 return -110
@@ -272,6 +278,11 @@ class ECBackendMixin:
 
     async def _handle_ec_write(self, conn: Connection,
                                msg: M.MOSDECSubOpWrite) -> None:
+        if self._sub_op_expired(msg):
+            # dead work: the parent op's client deadline passed — no
+            # apply, no reply (the primary times out and stays un-acked,
+            # so a shed shard can never count toward durability)
+            return
         # replica-side span: joins the primary's op tree via the sub-op
         # trace header (NULL_SPAN when untraced/disabled)
         tr = getattr(msg, "trace", None)
@@ -298,6 +309,8 @@ class ECBackendMixin:
 
     async def _handle_ec_read(self, conn: Connection,
                               msg: M.MOSDECSubOpRead) -> None:
+        if self._sub_op_expired(msg):
+            return  # nobody awaits: shed instead of burning device time
         try:
             full = self.store.read(_coll(msg.pgid), msg.oid)
             stored_crc = self.store.getattr(_coll(msg.pgid), msg.oid,
@@ -329,15 +342,111 @@ class ECBackendMixin:
             await self._reply_osd(conn, msg, M.MOSDECSubOpReadReply(
                 reqid=msg.reqid, result=-2, shard=msg.shard))
 
+    def _hedge_delay(self) -> float:
+        """Straggler-hedge delay for degraded k-of-n reads: the p90 of
+        recent sub-read gather latencies x2, floored by config and
+        capped well under the op timeout — a slow shard holder costs
+        one quantile, not a full timeout."""
+        floor = self.config.osd_ec_hedge_delay_floor
+        lats = sorted(self._subread_lats)
+        if not lats:
+            return floor * 4
+        q = lats[min(len(lats) - 1, (9 * len(lats)) // 10)]
+        return min(max(2.0 * q, floor),
+                   self.config.osd_client_op_timeout / 4.0)
+
+    async def _subread_round(self, st: PGState, oid: str, targets,
+                             off: int, length: Optional[int],
+                             spare=None, check=None) -> List:
+        """One shard sub-read fan-out: contact ``targets``, promoting a
+        ``spare`` shard holder immediately when a send fails outright
+        (dead peer), and hedging the remaining spares after the
+        quantile-derived delay (slow peer).  ``check(acc)`` resolves the
+        waiter early — typically "k same-generation shards arrived".
+        Returns the (result, reply) accumulator."""
+        from ceph_tpu.cluster.optracker import mark_current
+        from ceph_tpu.cluster.pg import CURRENT_OP_DEADLINE
+
+        spare = list(spare or [])
+        reqid = self._next_reqid()
+        fut = self._make_waiter(reqid, len(targets))
+        if check is not None:
+            fut.check = check  # type: ignore[attr-defined]
+        sub_deadline = CURRENT_OP_DEADLINE.get()
+
+        async def _send_one(shard: int, osd: int) -> bool:
+            try:
+                await self._send_osd(osd, M.MOSDECSubOpRead(
+                    reqid=reqid, pgid=st.pgid, oid=oid, shard=shard,
+                    off=off, length=length, deadline=sub_deadline))
+                return True
+            except (ConnectionError, OSError, RuntimeError):
+                return False
+
+        pending = list(targets)
+        while pending:
+            shard, osd = pending.pop(0)
+            if await _send_one(shard, osd):
+                continue
+            if spare:
+                # dead shard holder: promote a spare NOW instead of
+                # shrinking the gather below k
+                pending.append(spare.pop(0))
+                self.perf.inc("osd_ec_hedge_promotions")
+            else:
+                self._waiter_dec(reqid)
+        mark_current("ec_sub_read_sent")
+        hedge_task = None
+        if spare and not fut.done():
+            delay = self._hedge_delay()
+
+            async def _hedge():
+                await asyncio.sleep(delay)
+                if fut.done() or self._stopped:
+                    return
+                # a straggler is late past the quantile: widen the
+                # gather so a slow holder degrades latency, not
+                # availability
+                self.perf.inc("osd_ec_hedged_reads")
+                mark_current("ec_hedge_sent")
+                for shard, osd in spare:
+                    fut.needed += 1  # type: ignore[attr-defined]
+                    if not await _send_one(shard, osd):
+                        self._waiter_dec(reqid)
+
+            hedge_task = self._track(
+                asyncio.get_event_loop().create_task(_hedge()))
+        t0 = asyncio.get_event_loop().time()
+        try:
+            if fut.done():
+                acc = fut.result()
+            else:
+                acc = await asyncio.wait_for(
+                    fut, timeout=self._ack_wait_timeout())
+            mark_current("sub_read_acked")
+            self._subread_lats.append(
+                asyncio.get_event_loop().time() - t0)
+        except asyncio.TimeoutError:
+            acc = self._pending[reqid][1]
+        finally:
+            self._pending.pop(reqid, None)
+            if hedge_task is not None:
+                hedge_task.cancel()
+        return acc
+
     async def _gather_shards(
         self, pool: PGPool, st: PGState, oid: str, need_k: int,
         off: int = 0, length: Optional[int] = None,
         exclude_shards: Optional[Set[int]] = None,
+        fast_k: bool = False,
     ) -> Tuple[Dict[int, bytes], int, int]:
         """Collect >= k shard (ranges) from the acting set (own shard
         free).  ``exclude_shards``: shard ids known corrupt — they must
         never be decode sources (scrub repair would otherwise reconstruct
-        FROM the corruption and bless it)."""
+        FROM the corruption and bless it).  ``fast_k``: degraded-mode
+        client reads — contact only the first k shard holders, resolve
+        on the first k clean same-generation shards, and hedge/promote
+        stragglers instead of gathering the full group."""
         exclude_shards = exclude_shards or set()
         # (shard -> (bytes, version, size)): versions gate which shards
         # may decode together — a stale rejoined member's shard from an
@@ -363,33 +472,66 @@ class ECBackendMixin:
                     data,
                     self.store.get_version(_coll(st.pgid), oid),
                     int(sa) if sa else 0)
+        committed_seq = st.last_complete[1]
         peers = [(shard, osd) for shard, osd in enumerate(st.acting)
                  if osd not in (self.osd_id, CRUSH_ITEM_NONE)
                  and shard not in got and shard not in exclude_shards]
         if peers and len(got) < need_k:
-            from ceph_tpu.cluster.optracker import mark_current
+            want = need_k - len(got)
+            fast = (fast_k and bool(self.config.osd_ec_hedge_reads)
+                    and len(peers) > want)
+            if fast:
+                # the object's newest logged generation: when the pg
+                # log still covers the object, early-resolve ONLY on
+                # exactly that generation — k shards of an OLDER
+                # committed generation (just-revived members not yet
+                # recovered) must never outvote an unseen newer one.
+                # Objects past the log window have had no recent
+                # writes, so no newer generation can exist to miss
+                # (kill victims boot empty and reply ENOENT, they
+                # don't serve stale bytes).
+                logged_ver = next(
+                    (e.version[1] for e in reversed(st.log.entries)
+                     if e.oid == oid), None)
 
-            reqid = self._next_reqid()
-            fut = self._make_waiter(reqid, len(peers))
-            for shard, osd in peers:
-                try:
-                    await self._send_osd(osd, M.MOSDECSubOpRead(
-                        reqid=reqid, pgid=st.pgid, oid=oid, shard=shard,
-                        off=off, length=length))
-                except (ConnectionError, OSError, RuntimeError):
-                    self._waiter_dec(reqid)
-            mark_current("ec_sub_read_sent")
-            try:
-                if fut.done():
-                    acc = fut.result()
+                def _viable(acc, _local=dict(got), _c=committed_seq,
+                            _k=need_k, _lv=logged_ver):
+                    """k same-generation shards at/below the commit
+                    watermark — pinned to the logged generation when
+                    the log knows it."""
+                    byver: Dict[int, set] = {}
+                    for s, (_d, v, _sz) in _local.items():
+                        byver.setdefault(v, set()).add(s)
+                    for result, reply in acc:
+                        if result == 0 and reply is not None:
+                            byver.setdefault(
+                                reply.hinfo.get("version", 0),
+                                set()).add(reply.shard)
+                    if _lv is not None and _lv <= _c:
+                        ss = byver.get(_lv)
+                        return ss is not None and len(ss) >= _k
+                    return any(v <= _c and len(ss) >= _k
+                               for v, ss in byver.items())
+
+                acc = await self._subread_round(
+                    st, oid, peers[:want], off, length,
+                    spare=peers[want:], check=_viable)
+                if _viable(acc):
+                    self.perf.inc("osd_ec_fastk_reads")
                 else:
-                    acc = await asyncio.wait_for(
-                        fut, timeout=self.config.osd_client_op_timeout)
-                mark_current("sub_read_acked")
-            except asyncio.TimeoutError:
-                acc = self._pending[reqid][1]
-            finally:
-                self._pending.pop(reqid, None)
+                    # fast path came up short (mixed generations, dead
+                    # holders, un-acked head): widen to every shard not
+                    # yet heard from — correctness never rests on the
+                    # fast path
+                    heard = {r.shard for res, r in acc
+                             if res == 0 and r is not None}
+                    rest = [(s, o) for s, o in peers if s not in heard]
+                    if rest:
+                        acc = acc + await self._subread_round(
+                            st, oid, rest, off, length)
+            else:
+                acc = await self._subread_round(st, oid, peers, off,
+                                                length)
             for result, reply in acc:
                 if result == 0 and reply is not None:
                     got[reply.shard] = (
@@ -403,7 +545,6 @@ class ECBackendMixin:
         # later vanish would break read-your-ack semantics (the reference
         # compares object_info versions in handle_sub_read_reply and
         # serves committed state)
-        committed_seq = st.last_complete[1]
         shards: Dict[int, bytes] = {}
         size = 0
         versions = sorted({ver for _, ver, _ in got.values()}, reverse=True)
@@ -457,8 +598,11 @@ class ECBackendMixin:
         k = codec.get_data_chunk_count()
         nstripes = sinfo.object_stripes(logical_len)
         chunk_len = nstripes * sinfo.chunk_size
+        # degraded-mode client read: first k clean shards decode, a
+        # slow/dead holder is hedged/promoted instead of awaited
         shards, gsize, _ = await self._gather_shards(
-            pool, st, oid, k, off=chunk_off, length=chunk_len)
+            pool, st, oid, k, off=chunk_off, length=chunk_len,
+            fast_k=True)
         if expected_size is not None and shards and gsize != expected_size:
             raise ECSizeMismatch(gsize)
         avail = {s: np.frombuffer(d, dtype=np.uint8)

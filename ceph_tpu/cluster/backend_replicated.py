@@ -115,6 +115,7 @@ class ReplicatedBackendMixin:
         acting replicas ack (reference PrimaryLogPG::issue_repop,
         PrimaryLogPG.cc:9173)."""
         from ceph_tpu.cluster.optracker import mark_current
+        from ceph_tpu.cluster.pg import CURRENT_OP_DEADLINE
 
         self.store.queue_transaction(txn)
         mark_current("store:journal_queued")
@@ -130,11 +131,15 @@ class ReplicatedBackendMixin:
             # replica's send stamp into the next replica's header
             subctx = self.tracer.context()
             txn_blob = txn.encode()
+            # sub-writes inherit the client op's deadline (None for
+            # recovery/trim traffic): replicas shed the dead legs
+            sub_deadline = CURRENT_OP_DEADLINE.get()
             for o in peers:
                 rep = M.MOSDRepOp(reqid=reqid, pgid=st.pgid,
                                   txn_blob=txn_blob,
                                   entry=entry,
-                                  epoch=self.osdmap.epoch)
+                                  epoch=self.osdmap.epoch,
+                                  deadline=sub_deadline)
                 if subctx is not None:
                     rep.trace = dict(subctx)
                 try:
@@ -149,7 +154,7 @@ class ReplicatedBackendMixin:
             try:
                 if not fut.done():
                     await asyncio.wait_for(
-                        fut, timeout=self.config.osd_client_op_timeout)
+                        fut, timeout=self._ack_wait_timeout())
                 mark_current("sub_op_acked")
             except asyncio.TimeoutError:
                 return -110

@@ -21,6 +21,183 @@ from ceph_tpu.cluster.store import Transaction
 
 class ClientOpsMixin:
 
+    # ----------------------------------------------- admission control
+    #
+    # Layered admission ahead of dispatch (reference: the osd op/byte
+    # throttles feeding ShardedOpWQ): an op beyond the configured
+    # budgets is pushed back THROTTLED (-EBUSY) instead of queueing
+    # unboundedly — the explicit signal the objecter's AIMD congestion
+    # window runs against.  Budgets of 0 (default) admit everything.
+
+    @staticmethod
+    def _qos_entity(reqid0) -> str:
+        """QoS identity = the STABLE entity name: reqids carry a
+        per-incarnation nonce after '#' (dup-cache uniqueness), but
+        dmClock shares/limits/budgets attach to the entity."""
+        return str(reqid0).split("#", 1)[0]
+
+    @classmethod
+    def _qos_background(cls, name) -> bool:
+        """osd-internal client traffic (tier agent flush/promote,
+        copy-from pulls) is the background class: under admission
+        pressure it is shed first, yielding to real clients."""
+        return cls._qos_entity(name).startswith("osd.")
+
+    @staticmethod
+    def _op_cost_bytes(msg: M.MOSDOp) -> int:
+        return sum(len(args.get("data", b"")) for _op, args in msg.ops)
+
+    @staticmethod
+    def _is_control_op(msg: M.MOSDOp) -> bool:
+        """Pure-control vectors (notify_ack: resolves an existing
+        waiter, zero payload) are exempt from admission AND from every
+        shed point: dropping one blocks its waiter for a full timeout —
+        more dead work than serving the one-line ack.  The single
+        definition all three exemption sites share."""
+        return all(o[0] == "notify_ack" for o in msg.ops)
+
+    def _claim_throttle(self, msg) -> None:
+        """Dispatch-byte ownership: the messenger's per-frame byte
+        throttle (osd_client_message_size_cap) stays held until the op
+        is SERVED, not just enqueued — the cap bounds bytes in dispatch
+        like the reference's message throttle (held until the Message
+        is destroyed), and a blocked sender resumes exactly when the
+        queue drains.  Claimed only for ADMITTED ops: a rejected op is
+        never served, so its budget must return via the read loop."""
+        if getattr(msg, "_throttle", None) is not None:
+            msg._throttle_held = True
+
+    def _admit_op(self, msg: M.MOSDOp) -> bool:
+        cap_ops = self.config.osd_op_throttle_ops
+        cap_bytes = self.config.osd_op_throttle_bytes
+        if not cap_ops and not cap_bytes:
+            # admission disabled (default): provable no-op — no
+            # accounting, no gauges, nothing for release to undo
+            self._claim_throttle(msg)
+            return True
+        cost = self._op_cost_bytes(msg)
+        if cap_ops and self._admit_ops + 1 > cap_ops:
+            return False
+        # a single op larger than the whole byte budget must not wedge:
+        # it is admitted alone (the Throttle.acquire clamp, upstream)
+        if cap_bytes and self._admit_bytes + cost > cap_bytes and \
+                self._admit_bytes > 0:
+            return False
+        msg._admitted = cost
+        self._admit_ops += 1
+        self._admit_bytes += cost
+        self.perf.set("osd_admit_ops_in_use", self._admit_ops)
+        self.perf.set("osd_admit_bytes_in_use", self._admit_bytes)
+        self._claim_throttle(msg)
+        return True
+
+    def _admit_release_accounting(self, msg):
+        """Synchronous half of the release: return the budget NOW (no
+        suspension point, so a caller can re-admit atomically) and hand
+        back the messenger-throttle claim to release asynchronously.
+        Returns (throttle, bytes) or None.  Budget accounting exists
+        only when admission is configured (_admitted set); the throttle
+        claim is independent (made for every admitted op)."""
+        cost = getattr(msg, "_admitted", None)
+        if cost is not None:
+            msg._admitted = None
+            self._admit_ops = max(0, self._admit_ops - 1)
+            self._admit_bytes = max(0, self._admit_bytes - cost)
+            self.perf.set("osd_admit_ops_in_use", self._admit_ops)
+            self.perf.set("osd_admit_bytes_in_use", self._admit_bytes)
+        thr = getattr(msg, "_throttle", None)
+        if thr is not None and getattr(msg, "_throttle_held", False):
+            msg._throttle_held = False
+            return (thr, msg._throttle_bytes)
+        return None
+
+    async def _admit_release(self, msg) -> None:
+        claim = self._admit_release_accounting(msg)
+        if claim is not None:
+            await claim[0].release(claim[1])
+
+    def _would_admit_after_evicting(self, msg, victim) -> bool:
+        """Would shedding ``victim`` actually admit ``msg``?  Dropping
+        background work that doesn't buy admission (e.g. the byte
+        budget is the constraint and the victim is tiny) would pay the
+        eviction for nothing."""
+        cap_ops = self.config.osd_op_throttle_ops
+        cap_bytes = self.config.osd_op_throttle_bytes
+        cost = self._op_cost_bytes(msg)
+        v_cost = getattr(victim, "_admitted", None) or 0
+        if cap_ops and self._admit_ops > cap_ops:  # -1 victim +1 msg
+            return False
+        bytes_after = max(0, self._admit_bytes - v_cost)
+        if cap_bytes and bytes_after + cost > cap_bytes and \
+                bytes_after > 0:
+            return False
+        return True
+
+    async def _admit_or_pushback(self, conn, msg, m) -> bool:
+        """Admission decision for one arriving client op.  On pressure,
+        mclock's tags decide WHAT yields: a client-class arrival may
+        evict a queued background-class op (QoS-enforced shedding);
+        everything else gets the explicit THROTTLED pushback."""
+        if self._is_control_op(msg):
+            return True  # control acks bypass admission (see helper)
+        if self._admit_op(msg):
+            return True
+        if self._opq is not None and \
+                not self._qos_background(msg.reqid[0]):
+            victim = self._opq.peek_evict(self._qos_background)
+            evicted = self._opq.evict(self._qos_background) \
+                if victim is not None and \
+                self._would_admit_after_evicting(msg, victim[1]) else None
+            if evicted is not None:
+                e_conn, e_msg, _stamp = evicted
+                self._queued_depth = max(0, self._queued_depth - 1)
+                self.perf.set("osd_dispatch_queue_depth",
+                              self._queued_depth)
+                # return the victim's budget and take it for THIS op
+                # with no await in between: a suspension here would let
+                # a concurrent arrival steal the freed slot, wasting
+                # the eviction AND pushing this op back
+                claim = self._admit_release_accounting(e_msg)
+                admitted = self._admit_op(msg)
+                self.perf.inc("osd_qos_preempted")
+                if claim is not None:
+                    await claim[0].release(claim[1])
+                try:
+                    # prompt pushback: the background submitter backs
+                    # off instead of burning its full op timeout
+                    await e_conn.send(M.MOSDOpReply(
+                        reqid=e_msg.reqid, result=M.THROTTLED,
+                        throttled=True, epoch=m.epoch))
+                except (ConnectionError, OSError, RuntimeError):
+                    pass
+                if admitted:
+                    return True
+        self.perf.inc("osd_throttle_rejects")
+        await conn.send(M.MOSDOpReply(
+            reqid=msg.reqid, result=M.THROTTLED, throttled=True,
+            epoch=m.epoch))
+        return False
+
+    def _shed_if_expired(self, msg: M.MOSDOp) -> bool:
+        """Dead-work shedding at dequeue: an op past its client-stamped
+        deadline has nobody awaiting the reply — burning device time on
+        it only delays live ops.  Counted and kept in the historic ring
+        so attribution shows where the shed op's wall time went.  Reads
+        the skewable daemon clock (chaos clock-skew reaches it); pure
+        control acks are exempt, mirroring their admission bypass."""
+        dl = getattr(msg, "deadline", None)
+        if dl is None or self.clock.time() <= dl:
+            return False
+        if self._is_control_op(msg):
+            return False
+        self.perf.inc("osd_ops_shed_expired")
+        top = self.tracker.create(
+            f"osd_op({msg.reqid[0]}:{msg.reqid[1]} {msg.oid} "
+            f"SHED expired)", trace=getattr(msg, "trace", None))
+        top.mark("shed_expired")
+        top.finish()
+        return True
+
     # -------------------------------------------------------- client ops
 
     async def _resolve_client_op(self, conn: Connection, msg: M.MOSDOp):
@@ -48,12 +225,23 @@ class ClientOpsMixin:
         if resolved is None:
             return
         m, pool, st = resolved
+        # admission ahead of dispatch: budgets, QoS-aware eviction, or
+        # explicit pushback — the end of unbounded queueing
+        if not await self._admit_or_pushback(conn, msg, m):
+            return
         if self._opq is not None:
-            # QoS identity = the STABLE client name: reqids carry a
-            # per-incarnation nonce after '#' (dup-cache uniqueness),
-            # but dmClock shares/limits attach to the entity
-            qos_client = str(msg.reqid[0]).split("#", 1)[0]
-            self._opq.ensure_client(qos_client, self._opq_default)
+            from ceph_tpu.cluster.dmclock import QoSSpec
+
+            qos_client = self._qos_entity(msg.reqid[0])
+            default = self._opq_default
+            if self._qos_background(qos_client):
+                # background class: no reservation, a fraction of the
+                # spare capacity, and first in line for eviction
+                default = QoSSpec(
+                    reservation=0.0,
+                    weight=self.config.osd_mclock_background_weight,
+                    limit=self.config.osd_mclock_background_limit)
+            self._opq.ensure_client(qos_client, default)
             # queue ONLY (conn, msg, stamp): map/pool/PG/primary state is
             # re-resolved at dequeue time, and ops that outlived the
             # client's attempt window are dropped (the client has already
@@ -109,7 +297,7 @@ class ClientOpsMixin:
                 self._queued_depth = max(0, self._queued_depth - 1)
                 self.perf.set("osd_dispatch_queue_depth",
                               self._queued_depth)
-                await self._serve_queued_op(conn, msg)
+                await self._serve_admitted(conn, msg)
         finally:
             self._ordered_active.discard(key)
             if q and not self._stopped:
@@ -126,6 +314,21 @@ class ClientOpsMixin:
         while not self._stopped:
             item = self._opq.dequeue()
             if item is None:
+                # dead-work purge BEFORE pacing: an op already past its
+                # deadline must not wait for its L-tag — shed it now so
+                # its admission budget frees for live work (skewable
+                # clock, like every shed decision on this daemon)
+                now = self.clock.time()
+                expired = self._opq.purge(
+                    lambda it: getattr(it[1], "deadline", None)
+                    is not None and now > it[1].deadline
+                    and not self._is_control_op(it[1]))
+                for e_conn, e_msg, _stamp in expired:
+                    self._queued_depth = max(0, self._queued_depth - 1)
+                    self.perf.set("osd_dispatch_queue_depth",
+                                  self._queued_depth)
+                    self._shed_if_expired(e_msg)
+                    await self._admit_release(e_msg)
                 wait = self._opq.next_eligible_in()
                 if wait is not None:
                     # throttled: sleep until the earliest L-tag matures
@@ -140,15 +343,33 @@ class ClientOpsMixin:
             conn, msg, stamp = item
             self._queued_depth = max(0, self._queued_depth - 1)
             self.perf.set("osd_dispatch_queue_depth", self._queued_depth)
+            # dmclock conformance ride the perf/Prometheus path: which
+            # share of dequeues was reservation-driven vs spare capacity
+            self.perf.set("osd_qos_served_reservation",
+                          self._opq.stats["served_reservation"])
+            self.perf.set("osd_qos_served_spare",
+                          self._opq.stats["served_spare"])
             if time.monotonic() - stamp > self.config.osd_client_op_timeout:
                 # the client abandoned this attempt and resent: executing
                 # the stale copy would double-apply the op
                 self.perf.inc("osd_ops_dropped_stale")
+                await self._admit_release(msg)
                 continue
             t = asyncio.get_event_loop().create_task(
-                self.loopmon.wrap(self._serve_queued_op(conn, msg)))
+                self.loopmon.wrap(self._serve_admitted(conn, msg)))
             self._opq_running.add(t)
             t.add_done_callback(self._opq_running.discard)
+
+    async def _serve_admitted(self, conn, msg) -> None:
+        """Serve one admitted op, returning its admission budget (and
+        the messenger byte-throttle claim) however it exits — incl. the
+        deadline shed, which runs HERE, at dequeue, so expired ops never
+        reach the backend."""
+        try:
+            if not self._shed_if_expired(msg):
+                await self._serve_queued_op(conn, msg)
+        finally:
+            await self._admit_release(msg)
 
     async def _serve_queued_op(self, conn, msg) -> None:
         try:
@@ -211,6 +432,7 @@ class ClientOpsMixin:
         if in_bytes:
             self.perf.hinc("osd_op_in_bytes_hist", in_bytes)
         from ceph_tpu.cluster.optracker import CURRENT_OP
+        from ceph_tpu.cluster.pg import CURRENT_OP_DEADLINE
 
         # graft-trace: this daemon's dispatch span parents under the
         # client's root via the header's span id; entering it installs
@@ -218,6 +440,9 @@ class ClientOpsMixin:
         # (NULL_SPAN when tracing is off — no allocation, no retention)
         tr = getattr(msg, "trace", None) or {}
         token = CURRENT_OP.set(top)
+        # sub-writes/sub-reads fanned out under this op inherit its
+        # client deadline, so replicas can shed the dead legs too
+        dl_token = CURRENT_OP_DEADLINE.set(getattr(msg, "deadline", None))
         try:
             with self.tracer.start("osd_op", trace_id=tr.get("id"),
                                    parent_id=tr.get("span")) as ospan:
@@ -229,6 +454,7 @@ class ClientOpsMixin:
                     await self._execute_client_ops(conn, msg, m, pool, st,
                                                    top)
         finally:
+            CURRENT_OP_DEADLINE.reset(dl_token)
             CURRENT_OP.reset(token)
             top.finish()
             if top.duration is not None:

@@ -49,6 +49,12 @@ class DmClockQueue:
         self._clients: Dict[str, _ClientRec] = {}
         self._now = now
         self._seq = itertools.count()
+        # conformance counters (dmclock PullReq phase telemetry): how
+        # many dequeues were reservation-driven vs spare-capacity, and
+        # how many queued requests were evicted to admit higher classes
+        # under throttle pressure — exported via the OSD perf path
+        self.stats: Dict[str, int] = {
+            "served_reservation": 0, "served_spare": 0, "evicted": 0}
 
     def ensure_client(self, client: str, default: QoSSpec) -> None:
         """Install ``default`` only on first sight of the client."""
@@ -108,9 +114,78 @@ class DmClockQueue:
         pick = best_r or best_p
         if pick is None:
             return None
+        self.stats["served_reservation" if pick is best_r
+                   else "served_spare"] += 1
         rec = self._clients[pick[1]]
         _, item, _ = rec.queue.pop(0)
         return item
+
+    def _evict_pick(self, match) -> Optional[str]:
+        """The eviction victim's client: largest HEAD P-tag among
+        matching clients with queued work — the class currently least
+        entitled to service (head tag = its next scheduling position;
+        the tail tag would just bias toward the longest backlog)."""
+        best = None
+        for name, rec in self._clients.items():
+            if not rec.queue or not match(name):
+                continue
+            tag = rec.queue[0][2]
+            if best is None or tag.p > best[0]:
+                best = (tag.p, name)
+        return best[1] if best is not None else None
+
+    def peek_evict(self, match) -> Optional[object]:
+        """The item ``evict(match)`` WOULD shed, without shedding it —
+        the caller checks whether the eviction actually buys admission
+        before dropping background work for nothing."""
+        name = self._evict_pick(match)
+        if name is None:
+            return None
+        return self._clients[name].queue[-1][1]
+
+    def evict(self, match) -> Optional[object]:
+        """Shed one queued request of a client whose name satisfies
+        ``match`` — the youngest request of the client with the LARGEST
+        head P-tag (the least-entitled class, its least-urgent work).
+        The QoS-enforced shedding seam: under admission pressure the
+        caller evicts background classes to admit reserved clients.
+        Returns the evicted item, or None when nothing matches."""
+        name = self._evict_pick(match)
+        if name is None:
+            return None
+        rec = self._clients[name]
+        _, item, _ = rec.queue.pop()
+        self.stats["evicted"] += 1
+        return item
+
+    def purge(self, predicate) -> List[object]:
+        """Remove and return every queued item satisfying ``predicate``
+        (dead-work shedding: an op whose deadline passed must not wait
+        for its L-tag to mature — it is dropped, not paced).  Tag
+        history is untouched, so the class's pacing is unaffected."""
+        out: List[object] = []
+        for rec in self._clients.values():
+            keep = []
+            for entry in rec.queue:
+                if predicate(entry[1]):
+                    out.append(entry[1])
+                else:
+                    keep.append(entry)
+            rec.queue[:] = keep
+        return out
+
+    def dump(self) -> Dict:
+        """Conformance + queue-depth snapshot (the `dump_dmclock` admin
+        payload): per-client spec, depth, and the global counters."""
+        return {
+            "stats": dict(self.stats),
+            "clients": {
+                name: {"reservation": rec.spec.reservation,
+                       "weight": rec.spec.weight,
+                       "limit": rec.spec.limit,
+                       "queued": len(rec.queue)}
+                for name, rec in self._clients.items()},
+        }
 
     def next_eligible_in(self, now: Optional[float] = None) -> Optional[float]:
         """Seconds until the earliest queued head becomes limit-eligible

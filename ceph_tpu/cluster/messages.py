@@ -45,6 +45,15 @@ class MOSDAlive(Message):
     loop_lag: Optional[Tuple[float, float]] = None
 
 
+# throttle-full admission pushback result (EBUSY): distinct from the
+# -11 misdirect hint on purpose — a pushed-back client must NOT refresh
+# its map (the target is right, the daemon is full); it shrinks its
+# congestion window and retries after a jittered backoff.  The errno
+# alone is NOT the discriminator: op handlers can legitimately return
+# -16 (cls lock contention), so pushback replies additionally set
+# MOSDOpReply.throttled — the out-of-band flag clients key off.
+THROTTLED = -16
+
 # op verbs that mutate object state — shared by the OSD's dedup/caps
 # logic and the objecter's cache-overlay targeting so the two can never
 # drift (a verb classified differently on the two sides would route
@@ -149,6 +158,10 @@ class MOSDOp(Message):
     # clone-on-write for mutations, snapid selects the snap a read sees
     snapc: Optional[Tuple[int, Tuple[int, ...]]] = None
     snapid: Optional[int] = None
+    # absolute wall-clock deadline of the CLIENT's total op budget: OSDs
+    # drop the op at dequeue once it passes (nobody awaits the reply),
+    # and sub-ops inherit it so replicas shed dead work too
+    deadline: Optional[float] = None
 
 
 @dataclass
@@ -157,6 +170,10 @@ class MOSDOpReply(Message):
     result: int = 0
     data: Any = None
     epoch: int = 0
+    # True ONLY for admission-throttle pushback: result=-16 alone is
+    # ambiguous (a cls lock EBUSY is an op RESULT to surface, not a
+    # congestion signal to retry)
+    throttled: bool = False
 
 
 @dataclass
@@ -217,6 +234,9 @@ class MOSDRepOp(Message):
     txn_blob: bytes = b""
     entry: Any = None            # pglog.LogEntry
     epoch: int = 0
+    # inherited from the parent client op (None for recovery traffic):
+    # an expired sub-write is dead work — the primary's client is gone
+    deadline: Optional[float] = None
 
 
 @dataclass
@@ -248,6 +268,7 @@ class MOSDECSubOpWrite(Message):
     hinfo: Dict[str, Any] = field(default_factory=dict)
     entry: Any = None            # pglog.LogEntry
     epoch: int = 0
+    deadline: Optional[float] = None  # inherited parent-op deadline
 
 
 @dataclass
@@ -267,6 +288,7 @@ class MOSDECSubOpRead(Message):
     shard: int = -1
     off: int = 0
     length: Optional[int] = None
+    deadline: Optional[float] = None  # inherited parent-op deadline
 
 
 @dataclass

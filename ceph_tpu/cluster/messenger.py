@@ -229,16 +229,22 @@ class Throttle:
         self.waiting = 0
         self._cond = asyncio.Condition()
 
-    async def acquire(self, n: int) -> None:
+    async def acquire(self, n: int) -> bool:
+        """Returns True when the caller had to WAIT for budget — the
+        signal the read loop stamps into the op's trace header so
+        throttle wait shows up in per-stage attribution."""
         n = min(n, self.max)  # a single oversized frame must not wedge
+        waited = False
         async with self._cond:
             self.waiting += 1
             try:
                 while self.cur + n > self.max:
+                    waited = True
                     await self._cond.wait()
             finally:
                 self.waiting -= 1
             self.cur += n
+        return waited
 
     async def release(self, n: int) -> None:
         n = min(n, self.max)
@@ -467,13 +473,26 @@ class Messenger:
                 if thr is not None:
                     # byte-budget backpressure: waiting here stops this
                     # socket's drain, pushing TCP backpressure to the peer
-                    await thr.acquire(n)
+                    if await thr.acquire(n) and msg.trace is not None:
+                        # the wait was real: stamp it so attribution
+                        # books the delta as throttle_wait, not wire
+                        msg.trace.setdefault("events", []).append(
+                            (f"throttle:{self.name}:acquired",
+                             _time.time()))
+                    # dispatch handoff seam: a dispatcher that QUEUES the
+                    # message (the OSD's ShardedOpWQ analog) takes
+                    # ownership by setting _throttle_held and releases
+                    # after serving — the cap then bounds bytes in
+                    # dispatch, not merely in enqueue
+                    msg._throttle = thr
+                    msg._throttle_bytes = n
                 try:
                     for d in self.dispatchers:
                         if await d.ms_dispatch(conn, msg):
                             break
                 finally:
-                    if thr is not None:
+                    if thr is not None and \
+                            not getattr(msg, "_throttle_held", False):
                         await thr.release(n)
         except (asyncio.IncompleteReadError, ConnectionError,
                 asyncio.CancelledError):

@@ -25,6 +25,7 @@ from ceph_tpu.cluster.messenger import (
 from ceph_tpu.ops.jenkins import str_hash_rjenkins
 from ceph_tpu.osdmap.osdmap import OSDMap, PGid, ceph_stable_mod
 from ceph_tpu.utils import Config
+from ceph_tpu.utils.backoff import AIMDWindow, ExpBackoff
 
 
 class Objecter(Dispatcher):
@@ -74,6 +75,28 @@ class Objecter(Dispatcher):
         self._cookie = 0
         self._watches: Dict[Tuple[int, str, int], object] = {}
         self._relinger_task = None
+        # client-side flow control against OSD admission throttles: an
+        # AIMD congestion window on inflight ops, driven by explicit
+        # THROTTLED (-EBUSY) pushback — the primary flow-control signal,
+        # replacing blind wait_for timeouts.  Wide open until the first
+        # pushback, so with throttles off (default) it never constrains.
+        self.cwnd = AIMDWindow(self.config.objecter_inflight_max)
+        self._cwnd_inflight = 0
+        self._cwnd_event = asyncio.Event()
+        self._pushback_backoff = ExpBackoff(
+            base=0.02, cap=1.0, rng=self._backoff_rng("pushback"))
+
+    def _backoff_rng(self, tag: str):
+        """Seeded jitter stream when the client carries a chaos seed
+        (deterministic scenario replay — the messenger/monclient
+        contract); fresh entropy otherwise.  Keyed by the STABLE display
+        name: the reqid nonce must not perturb replay."""
+        if self.config.chaos_seed:
+            from ceph_tpu.chaos.rng import stream
+
+            return stream(self.config.chaos_seed,
+                          f"objecter:{self.display_name}:{tag}")
+        return None
 
     @property
     def mon_addr(self) -> Addr:
@@ -205,7 +228,6 @@ class Objecter(Dispatcher):
         if timeout is None:
             timeout = self.config.rados_osd_op_timeout
         deadline = asyncio.get_event_loop().time() + timeout
-        backoff = 0.05
         explicit_pgid = pgid
         # op-lifecycle trace header: one id for the op across resends;
         # the events ride the MOSDOp into the OSD's TrackedOp so
@@ -215,20 +237,64 @@ class Objecter(Dispatcher):
         self._trace_seq += 1
         trace_id = f"{self.client_name}:op{self._trace_seq}"
         trace_events = [("objecter:submit", _time.time())]
-        # root span of the op's cross-daemon tree: lives for the whole
-        # submit incl. resends, so its duration IS the client-observed
-        # wall time the stage attribution is judged against
-        with self.tracer.start("op_submit", trace_id=trace_id) as root:
-            root.annotate(oid=oid, ops=[o[0] for o in ops])
-            return await self._op_submit_attempts(
-                pool_id, oid, ops, deadline, backoff, explicit_pgid,
-                trace_id, trace_events, root, snapc, snapid)
+        # wall-clock deadline rides the message header: OSDs and their
+        # sub-ops shed this op at dequeue once it passes (nobody awaits)
+        wall_deadline = _time.time() + timeout
+        # congestion-window gate BEFORE targeting: inflight ops beyond
+        # the AIMD window wait here, and an op whose deadline passes
+        # while waiting is shed client-side (never sent at all)
+        waited = await self._cwnd_acquire(deadline, oid)
+        if waited:
+            trace_events.append(("objecter:throttle_wait", _time.time()))
+        try:
+            # root span of the op's cross-daemon tree: lives for the
+            # whole submit incl. resends, so its duration IS the
+            # client-observed wall time stage attribution is judged by
+            with self.tracer.start("op_submit", trace_id=trace_id) as root:
+                root.annotate(oid=oid, ops=[o[0] for o in ops])
+                return await self._op_submit_attempts(
+                    pool_id, oid, ops, deadline, wall_deadline,
+                    explicit_pgid, trace_id, trace_events, root,
+                    snapc, snapid)
+        finally:
+            self._cwnd_release()
+
+    async def _cwnd_acquire(self, deadline: float, oid: str) -> bool:
+        waited = False
+        loop = asyncio.get_event_loop()
+        while self._cwnd_inflight >= self.cwnd.limit:
+            waited = True
+            remaining = deadline - loop.time()
+            if remaining <= 0:
+                # client-side dead-work shed: the op expired before it
+                # ever left this host — don't add it to the pile
+                raise TimeoutError(
+                    f"op on {oid} expired waiting for congestion window")
+            self._cwnd_event.clear()
+            try:
+                await asyncio.wait_for(self._cwnd_event.wait(),
+                                       timeout=remaining)
+            except asyncio.TimeoutError:
+                pass
+        self._cwnd_inflight += 1
+        return waited
+
+    def _cwnd_release(self) -> None:
+        self._cwnd_inflight = max(0, self._cwnd_inflight - 1)
+        self._cwnd_event.set()
 
     async def _op_submit_attempts(self, pool_id, oid, ops, deadline,
-                                  backoff, explicit_pgid, trace_id,
+                                  wall_deadline, explicit_pgid, trace_id,
                                   trace_events, root, snapc, snapid):
         import time as _time
 
+        loop = asyncio.get_event_loop()
+        # capped full-jitter backoff between retargeting attempts (was a
+        # blind doubling sleep); a separate stream paces throttle
+        # pushback retries so congestion retries and map-refresh retries
+        # never share an attempt counter
+        retarget_backoff = ExpBackoff(base=0.05, cap=1.0,
+                                      rng=self._backoff_rng("retarget"))
         while True:
             # re-resolve the overlay every attempt: a tier/overlay change
             # mid-retry must re-target (the redirect is map state)
@@ -240,11 +306,12 @@ class Objecter(Dispatcher):
             if addr is not None:
                 self._tid += 1
                 reqid = (self.client_name, self._tid)
-                fut = asyncio.get_event_loop().create_future()
+                fut = loop.create_future()
                 self._inflight[reqid] = fut
                 msg = M.MOSDOp(reqid=reqid, pgid=pgid, oid=oid, ops=ops,
                                epoch=self.osdmap.epoch,
-                               snapc=snapc, snapid=snapid)
+                               snapc=snapc, snapid=snapid,
+                               deadline=wall_deadline)
                 msg.trace = {"id": trace_id,
                              "events": trace_events +
                              [("objecter:send", _time.time())]}
@@ -254,19 +321,33 @@ class Objecter(Dispatcher):
                     msg.trace["span"] = root.span_id
                 try:
                     await self.messenger.send_message(msg, tuple(addr))
-                    # outwait the OSD's own replica-ack timeout: abandoning
+                    # outwait the OSD's own replica-ack timeout (abandoning
                     # in parallel just queues a duplicate op behind the PG
-                    # lock and compounds load
-                    attempt = self.config.osd_client_op_timeout + 2.0
+                    # lock), but never past the op deadline — an ack past
+                    # the deadline must not reach the caller as success
+                    attempt = min(self.config.osd_client_op_timeout + 2.0,
+                                  max(0.05, deadline - loop.time()))
                     reply = await asyncio.wait_for(fut, timeout=attempt)
+                    if getattr(reply, "throttled", False):
+                        # explicit admission pushback: shrink the window
+                        # (multiplicative decrease), pause a jittered
+                        # beat, resend — WITHOUT a map refresh (the
+                        # target is right, the daemon is full)
+                        self.cwnd.on_pushback()
+                        if loop.time() > deadline:
+                            raise TimeoutError(
+                                f"op on {oid} throttled past deadline")
+                        await asyncio.sleep(self._pushback_backoff.next())
+                        continue
                     if reply.result != -11:  # not misdirected
+                        self.cwnd.on_ack()
+                        self._pushback_backoff.reset()
                         return reply
                 except (ConnectionError, OSError, asyncio.TimeoutError):
                     self._inflight.pop(reqid, None)
-            if asyncio.get_event_loop().time() > deadline:
+            if loop.time() > deadline:
                 raise TimeoutError(f"op on {oid} timed out")
-            await asyncio.sleep(backoff)
-            backoff = min(backoff * 2, 1.0)
+            await asyncio.sleep(retarget_backoff.next())
             try:
                 await self._refresh_map()
             except asyncio.TimeoutError:
@@ -357,6 +438,11 @@ class Objecter(Dispatcher):
         the mon: pool create returns the existing pool on a retry)."""
         deadline = asyncio.get_event_loop().time() + timeout * 3
         last_err = None
+        # capped jittered backoff between retries: a mon that answers -11
+        # INSTANTLY (leaderless quorum) must not be hammered at loop
+        # speed — fixed sleeps made every leaderless client resonate
+        backoff = ExpBackoff(base=0.05, cap=1.0,
+                             rng=self._backoff_rng("mon_command"))
         while asyncio.get_event_loop().time() < deadline:
             self._mon_tid += 1
             tid = self._mon_tid
@@ -369,11 +455,11 @@ class Objecter(Dispatcher):
                 self._mon_inflight.pop(tid, None)
                 last_err = e
                 self._hunt()
-                await asyncio.sleep(0.2)
+                await asyncio.sleep(backoff.next())
                 continue
             if reply.result == -11:   # no leader yet: retry
                 last_err = RuntimeError(str(reply.data))
-                await asyncio.sleep(0.3)
+                await asyncio.sleep(backoff.next())
                 continue
             if reply.result != 0:
                 raise RuntimeError(f"mon command failed: {reply.data}")

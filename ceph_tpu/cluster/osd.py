@@ -134,6 +134,22 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         # live depth of the ordered dispatch queues (ShardedOpWQ-depth
         # analog) — maintained by client_ops, exported as a perf gauge
         self._queued_depth = 0
+        # admission budgets in use (client_ops._admit_op): ops + payload
+        # bytes concurrently queued/executing against osd_op_throttle_*
+        self._admit_ops = 0
+        self._admit_bytes = 0
+        # recent EC sub-read gather latencies (seconds): the quantile
+        # the hedge delay for degraded k-of-n reads is derived from
+        from collections import deque as _deque
+
+        self._subread_lats = _deque(maxlen=64)
+        # ONE shared jitter stream for internal-op pushback backoff:
+        # concurrent internal ops interleave draws from it, so their
+        # retries desynchronize (per-call streams with one name would
+        # retry in lockstep); seeded for chaos replay, else None
+        self._internal_backoff_rng = _chaos_stream(
+            self.config.chaos_seed, f"internal:osd.{osd_id}") \
+            if self.config.chaos_seed else None
         # last slow-op count surfaced to the cluster log (warn on rise,
         # log clearance on drain — the mon health check itself keys off
         # the beacon stream)
@@ -296,6 +312,17 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         if timeout is None:
             timeout = self.config.osd_client_op_timeout + 2.0
         deadline = asyncio.get_event_loop().time() + timeout
+        # background class: when the target pushes back THROTTLED under
+        # admission pressure (or evicts us for a client op), retry under
+        # capped jittered backoff — yielding, not hammering.  The rng
+        # is the daemon-wide seeded stream (chaos replay) shared by all
+        # internal ops, so concurrent retries interleave draws instead
+        # of sleeping identical sequences in lockstep.
+        from ceph_tpu.utils.backoff import ExpBackoff
+
+        pushback = ExpBackoff(base=0.05, cap=1.0,
+                              rng=self._internal_backoff_rng)
+        wall_deadline = time.time() + timeout
         while True:
             m = self.osdmap
             pool = m.pools.get(pool_id)
@@ -321,7 +348,8 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                 reqid = (f"osd.{self.osd_id}.int#{self.boot_instance}",
                          self._internal_tid)
             msg = M.MOSDOp(reqid=reqid, pgid=pgid, oid=oid, ops=ops,
-                           epoch=m.epoch, snapc=snapc, snapid=snapid)
+                           epoch=m.epoch, snapc=snapc, snapid=snapid,
+                           deadline=wall_deadline)
             if primary == self.osd_id and self._opq is None:
                 # self-targeted: dispatch DIRECTLY instead of messaging
                 # ourselves — a nested internal op would share the outer
@@ -373,6 +401,15 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                             f"internal op to {pool_id}:{oid} kept "
                             "misdirecting past the deadline")
                     await asyncio.sleep(0.1)
+                    continue
+                if getattr(reply, "throttled", False):
+                    # admission pushback / QoS eviction: back off and
+                    # retry until our own deadline
+                    if asyncio.get_event_loop().time() > deadline:
+                        raise IOError(
+                            f"internal op to {pool_id}:{oid} throttled "
+                            "past the deadline")
+                    await asyncio.sleep(pushback.next())
                     continue
                 return reply
             except asyncio.TimeoutError:
@@ -430,6 +467,13 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
             await self._handle_client_op(conn, msg)
             return True
         if isinstance(msg, M.MOSDRepOp):
+            if self._sub_op_expired(msg):
+                # parent op's client deadline passed: the primary's
+                # waiter is (or will be) gone — applying + replying is
+                # dead work.  No reply: the primary times out -110 and
+                # the op stays un-acked, so durability is never claimed
+                # for a stripe some member shed.
+                return True
             # replica-side span: joins the primary's op tree via the
             # sub-op trace header (absent/None when untraced)
             tr = getattr(msg, "trace", None)
@@ -506,6 +550,48 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
             return True
         return False
 
+    def _sub_op_expired(self, msg) -> bool:
+        """Dead-work shedding on the replica/shard side: a sub-op whose
+        inherited client deadline passed is dropped at dispatch (counted;
+        None deadline — recovery traffic — always executes).  Reads the
+        daemon's skewable clock, so chaos clock-skew scenarios exercise
+        the cross-daemon wall-clock protocol this design rides on."""
+        dl = getattr(msg, "deadline", None)
+        if dl is None or self.clock.time() <= dl:
+            return False
+        self.perf.inc("osd_sub_ops_shed_expired")
+        return True
+
+    def _ack_wait_timeout(self) -> float:
+        """Sub-op ack wait budget: the usual op timeout, clamped to the
+        current client op's remaining deadline — replicas SHED expired
+        sub-ops without replying, so waiting past the deadline would
+        pin the primary (and its ordered FIFO) on work nobody awaits."""
+        from ceph_tpu.cluster.pg import CURRENT_OP_DEADLINE
+
+        t = self.config.osd_client_op_timeout
+        dl = CURRENT_OP_DEADLINE.get()
+        if dl is not None:
+            t = min(t, max(0.05, dl - self.clock.time()))
+        return t
+
+    async def _yield_under_pressure(self) -> None:
+        """Background work (recovery rounds, scrub passes) yields while
+        client admission pressure is high — the QoS demotion the
+        reference gets from mclock op classes.  No-op with budgets off."""
+        budget = self.config.osd_op_throttle_ops
+        if not budget:
+            return
+        yielded = False
+        for _ in range(100):
+            if self._stopped or \
+                    self._admit_ops < max(1, (3 * budget) // 4):
+                break
+            if not yielded:
+                yielded = True
+                self.perf.inc("osd_recovery_yields")
+            await asyncio.sleep(0.05)
+
     def _declare_perf_schema(self) -> None:
         """Typed schemas + histograms for the op path (reference
         OSD::create_logger, src/osd/osd_perf_counters.cc)."""
@@ -533,6 +619,46 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         self.perf.add_u64(
             "osd_dispatch_queue_depth", prio=perfmod.PRIO_INTERESTING,
             desc="client ops waiting in the ordered dispatch queues")
+        # overload/degradation telemetry (round 10): admission budgets,
+        # deadline shedding, QoS conformance, hedged EC reads — all ride
+        # the existing perf/Prometheus export
+        self.perf.add_u64("osd_throttle_rejects",
+                          prio=perfmod.PRIO_INTERESTING,
+                          desc="client ops pushed back THROTTLED at "
+                               "admission (budget full)")
+        self.perf.add_u64("osd_ops_shed_expired",
+                          prio=perfmod.PRIO_INTERESTING,
+                          desc="client ops dropped at dequeue past "
+                               "their deadline (dead work)")
+        self.perf.add_u64("osd_sub_ops_shed_expired",
+                          desc="replica/shard sub-ops dropped past the "
+                               "inherited parent deadline")
+        self.perf.add_u64("osd_qos_preempted",
+                          desc="queued background-class ops evicted to "
+                               "admit client ops under pressure")
+        self.perf.add_u64("osd_qos_served_reservation",
+                          desc="dmclock dequeues served by reservation "
+                               "tag (conformance)")
+        self.perf.add_u64("osd_qos_served_spare",
+                          desc="dmclock dequeues served from spare "
+                               "capacity by weight tag")
+        self.perf.add_u64("osd_admit_ops_in_use",
+                          desc="admission op budget currently in use")
+        self.perf.add_u64("osd_admit_bytes_in_use",
+                          unit=perfmod.UNIT_BYTES,
+                          desc="admission byte budget currently in use")
+        self.perf.add_u64("osd_ec_hedged_reads",
+                          desc="EC gathers that hedged straggler "
+                               "sub-reads after the quantile delay")
+        self.perf.add_u64("osd_ec_hedge_promotions",
+                          desc="EC gathers that promoted a spare shard "
+                               "after a failed sub-read send")
+        self.perf.add_u64("osd_ec_fastk_reads",
+                          desc="EC reads that resolved from the first "
+                               "k clean shards")
+        self.perf.add_u64("osd_recovery_yields",
+                          desc="background recovery/scrub rounds "
+                               "delayed under client admission pressure")
 
     def _build_admin_socket(self):
         """Register this daemon's command table (reference OSD::asok_
@@ -584,6 +710,15 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
 
         asok.register("trace dump", _trace_dump,
                       "completed graft-trace spans (args: trace_id | n)")
+
+        def _dmclock(cmd):
+            if self._opq is None:
+                return {"enabled": False}
+            return {"enabled": True, **self._opq.dump()}
+
+        asok.register("dump_dmclock", _dmclock,
+                      "dmclock conformance counters + per-client queue "
+                      "depths (QoS shedding telemetry)")
 
         async def _scrub(cmd):
             reports = {}
@@ -639,7 +774,17 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                 return
             seen.add(sk)
         acc.append((result, payload))
-        if len(acc) >= fut.needed and not fut.done():  # type: ignore[attr-defined]
+        if fut.done():
+            return
+        # early-resolve hook (degraded EC reads): a waiter may install
+        # ``check(acc) -> bool`` to resolve as soon as the accumulated
+        # replies SUFFICE (e.g. k same-generation shards), without
+        # waiting for every contacted responder
+        chk = getattr(fut, "check", None)
+        if chk is not None and chk(acc):
+            fut.set_result(acc)
+            return
+        if len(acc) >= fut.needed:  # type: ignore[attr-defined]
             fut.set_result(acc)
 
     def _make_waiter(self, key, needed: int) -> asyncio.Future:

@@ -28,6 +28,13 @@ from ceph_tpu.utils.lockdep import DepLock
 CURRENT_CLIENT_REQID: contextvars.ContextVar = contextvars.ContextVar(
     "ceph_tpu_current_client_reqid", default=None)
 
+# the wall-clock deadline of the client op currently executing (set
+# around _dispatch_client_op): sub-writes/sub-reads fanned out under it
+# inherit the parent deadline so replicas can shed dead work.  None for
+# recovery/scrub traffic, which has no client waiting.
+CURRENT_OP_DEADLINE: contextvars.ContextVar = contextvars.ContextVar(
+    "ceph_tpu_current_op_deadline", default=None)
+
 
 # the per-PG metadata object holding the persisted log + last_update
 # (reference: the pgmeta ghobject, PG::_init / read_info)

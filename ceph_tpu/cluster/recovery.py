@@ -26,6 +26,10 @@ class RecoveryMixin:
         for pgid, st in list(self.pgs.items()):
             if st.primary == self.osd_id:
                 try:
+                    # background class yields to client admission
+                    # pressure (mclock demotion analog): recovery pulls
+                    # wait for the op budget to drain below 3/4
+                    await self._yield_under_pressure()
                     await self._recover_pg(st)
                 except Exception:
                     # count AND surface: a silently-failing recovery loop

@@ -198,6 +198,9 @@ class ScrubMixin:
             for st in list(self.pgs.values()):
                 if st.primary == self.osd_id and not self._stopped:
                     try:
+                        # background scrub yields to client admission
+                        # pressure, like recovery (QoS class demotion)
+                        await self._yield_under_pressure()
                         await self.scrub_pg(st)
                     except Exception:
                         self.perf.inc("osd_scrub_errors")

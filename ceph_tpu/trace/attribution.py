@@ -44,6 +44,13 @@ EVENT_STAGE = {
     "done": "reply",
     "dup_reply_from_cache": "dup_cache",
     "dup_refused_from_log": "dup_cache",
+    # overload-regime stages (round 10): client congestion-window wait,
+    # dead-work shed at dequeue, straggler hedge on degraded EC reads —
+    # so wall_coverage holds with backpressure enabled (bench.py
+    # --attribute books throttle waits instead of losing them to "wire")
+    "objecter:throttle_wait": "throttle_wait",
+    "shed_expired": "shed",
+    "ec_hedge_sent": "hedge",
 }
 
 
@@ -56,6 +63,10 @@ def stage_for(event: str) -> str:
     if event.startswith("lock_wait:"):
         # the delta reaching the wait mark is execution BEFORE the lock
         return "exec"
+    if event.startswith("throttle:"):
+        # messenger byte-throttle acquire stamp (throttle:<daemon>:
+        # acquired): the delta from recv to here is budget wait
+        return "throttle_wait"
     if event.startswith("msgr:"):
         return "wire" if event.endswith(":recv") else "messenger_send"
     return f"other:{event}"

@@ -43,6 +43,33 @@ OPTIONS: List[Option] = [
            "(reference osd_client_message_size_cap throttle)"),
     Option("rados_osd_op_timeout", float, 30.0,
            "client-side total op budget incl. resends"),
+    # overload / graceful degradation (round 10): layered admission
+    # control ahead of dispatch (reference osd_op_throttle feeding
+    # ShardedOpWQ) + client congestion window + deadline shedding +
+    # degraded EC reads.  Zero budgets = unlimited (provable no-op).
+    Option("osd_op_throttle_ops", int, 0,
+           "admission budget: client ops concurrently queued+executing; "
+           "beyond it the op is pushed back -EBUSY (0 = unlimited)",
+           min=0),
+    Option("osd_op_throttle_bytes", int, 0,
+           "admission budget: mutation payload bytes concurrently "
+           "queued+executing (0 = unlimited)", min=0),
+    Option("objecter_inflight_max", int, 256,
+           "client congestion-window ceiling (AIMD shrinks from here on "
+           "throttle pushback, recovers additively on acks)", min=1),
+    Option("osd_ec_hedge_reads", int, 1,
+           "EC reads gather only the first k clean shards and hedge "
+           "stragglers after a quantile-derived delay (0 = full gather)",
+           min=0, max=1),
+    Option("osd_ec_hedge_delay_floor", float, 0.05,
+           "minimum hedge delay before contacting spare EC shards (s)",
+           min=0),
+    Option("osd_mclock_background_weight", float, 0.25,
+           "dmClock weight for background (osd-internal) op classes; "
+           "under admission pressure these are shed first"),
+    Option("osd_mclock_background_limit", float, 0.0,
+           "ops/s cap for the background class (0 = unlimited, like "
+           "every dmclock limit)"),
     Option("osd_map_cache_size", int, 50),
     Option("osd_map_batch_min_pgs", int, 256,
            "pools with at least this many PGs use batched placement"),

@@ -1,0 +1,37 @@
+"""rpc-timeout BAD corpus: bare awaits on RPC futures hang forever."""
+
+import asyncio
+
+
+class Daemon:
+    def __init__(self):
+        self._pending = {}
+
+    def _make_waiter(self, key, needed):
+        fut = asyncio.get_event_loop().create_future()
+        fut.needed = needed
+        self._pending[key] = (fut, [])
+        return fut
+
+    async def wait_unbounded_waiter(self, key):
+        fut = self._make_waiter(key, 1)
+        # BAD: if the peer dies, this hangs for the daemon's lifetime
+        return await fut
+
+    async def wait_unbounded_reply(self, tid):
+        fut = asyncio.get_event_loop().create_future()
+        self._pending[tid] = fut
+        # BAD: reply waiter with no timeout and no deadline
+        reply = await fut
+        return reply
+
+    async def wait_unbounded_annotated(self, tid):
+        fut: asyncio.Future = asyncio.get_event_loop().create_future()
+        self._pending[tid] = fut
+        # BAD: annotated binding is still a bare future await
+        return await fut
+
+    async def wait_unbounded_chained(self, tid):
+        fut = self._round = asyncio.get_event_loop().create_future()
+        # BAD: chained binding is still a bare future await
+        return await fut

@@ -1,0 +1,34 @@
+"""rpc-timeout GOOD corpus: every RPC future wait is bounded."""
+
+import asyncio
+
+
+class Daemon:
+    def __init__(self):
+        self._pending = {}
+        self.timeout = 5.0
+
+    def _make_waiter(self, key, needed):
+        fut = asyncio.get_event_loop().create_future()
+        fut.needed = needed
+        self._pending[key] = (fut, [])
+        return fut
+
+    async def wait_bounded(self, key):
+        fut = self._make_waiter(key, 1)
+        try:
+            # bounded: wait_for carries the deadline
+            return await asyncio.wait_for(fut, timeout=self.timeout)
+        finally:
+            self._pending.pop(key, None)
+
+    async def poll_done(self, key):
+        fut = asyncio.get_event_loop().create_future()
+        if fut.done():
+            return fut.result()  # poll, never a bare await
+        return await asyncio.wait_for(fut, timeout=self.timeout)
+
+    async def not_a_future(self, q):
+        # awaiting other awaitables stays out of scope for the rule
+        item = await q.get()
+        return item

@@ -78,3 +78,29 @@ def test_montargeter_hunts_with_backoff():
     # with no further attempt to protect)
     assert len(s1) == 1
     assert all(d >= 0 for d in s1)
+
+
+def test_aimd_window_shape():
+    """AIMD congestion window: starts at the ceiling (no-op until real
+    pushback), halves multiplicatively on pushback, recovers additively
+    at ~1/w per ack, and never leaves [1, ceiling]."""
+    from ceph_tpu.utils.backoff import AIMDWindow
+
+    w = AIMDWindow(64)
+    assert w.limit == 64 and w.window == 64.0
+    w.on_ack()
+    assert w.window == 64.0  # capped at the ceiling
+    w.on_pushback()
+    assert w.window == 32.0 and w.pushbacks == 1
+    for _ in range(10):
+        w.on_pushback()
+    assert w.window == 1.0  # floor
+    before = w.window
+    w.on_ack()
+    assert before < w.window <= before + 1.0  # additive recovery
+    # a full window's worth of acks gains ~one slot
+    w2 = AIMDWindow(64)
+    w2.on_pushback()  # 32
+    for _ in range(32):
+        w2.on_ack()
+    assert 32.5 < w2.window < 34.0

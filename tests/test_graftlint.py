@@ -240,6 +240,36 @@ def test_task_spawn_scoped_to_cluster():
     assert findings == []
 
 
+def test_rpc_timeout_good_clean():
+    from ceph_tpu.analysis import rpc_timeout
+
+    findings, _ = lint_files(
+        rpc_timeout, "rpc_timeout_good.py",
+        relpath_as="ceph_tpu/cluster/rpc_timeout_good.py")
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_rpc_timeout_bad_fires():
+    from ceph_tpu.analysis import rpc_timeout
+
+    findings, _ = lint_files(
+        rpc_timeout, "rpc_timeout_bad.py",
+        relpath_as="ceph_tpu/cluster/rpc_timeout_bad.py")
+    # plain, annotated, and chained bindings all fire
+    assert len(findings) == 4, [f.render() for f in findings]
+    assert all(f.rule == "rpc-timeout" for f in findings)
+    msgs = "\n".join(f.message for f in findings)
+    assert "can hang forever" in msgs
+    assert "wait_for" in msgs
+
+
+def test_rpc_timeout_scoped_to_cluster():
+    from ceph_tpu.analysis import rpc_timeout
+
+    findings, _ = lint_files(rpc_timeout, "rpc_timeout_bad.py")
+    assert findings == []
+
+
 # ------------------------------------------------------- runtime wiring
 
 

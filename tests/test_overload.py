@@ -1,0 +1,358 @@
+"""Graceful degradation under overload (round 10 acceptance gates).
+
+Tier-1 tests stay STRUCTURAL (counters, invariants, bit-exact reads) —
+the bench host is load-sensitive, so no timing thresholds here.  The
+timing-based goodput criterion ("within 20% of the admission budget")
+is slow-marked.
+
+Covers: admission pushback driving the client AIMD congestion window,
+deadline propagation + dead-work shedding at the mclock dequeue,
+degraded k-of-n EC reads with a dead shard holder (hedge/promotion),
+the OSD byte-throttle held through dispatch (release-after-drain
+regression + throttle_wait attribution), and the seeded overload-smoke
+chaos scenario.
+"""
+
+import asyncio
+import os
+
+import pytest
+
+from ceph_tpu.cluster.vstart import _fast_config, start_cluster
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _sum_counter(cluster, name: str) -> int:
+    return sum(osd.perf.get(name) for osd in cluster.osds.values())
+
+
+# ------------------------------------------------- admission + AIMD cwnd
+
+
+def test_admission_pushback_drives_client_cwnd():
+    """A 12-op burst against a 1-op admission budget: every op still
+    lands (AIMD retries absorb the pushback), the OSDs counted explicit
+    THROTTLED rejects, and the client's congestion window shrank from
+    its ceiling — backpressure, not timeouts, did the flow control."""
+
+    async def scenario():
+        config = _fast_config()
+        config.osd_op_throttle_ops = 1
+        cluster = await start_cluster(3, config=config)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("ovl", pg_num=8, size=3)
+            io = client.ioctx(pool)
+            datas = {f"o{i}": os.urandom(4096) + bytes([i])
+                     for i in range(12)}
+            await asyncio.gather(*[io.write_full(oid, d)
+                                   for oid, d in datas.items()])
+            for oid, d in datas.items():
+                assert await io.read(oid) == d
+            cwnd = client.objecter.cwnd
+            rejects = _sum_counter(cluster, "osd_throttle_rejects")
+            return cwnd.pushbacks, cwnd.window, cwnd.ceiling, rejects
+        finally:
+            await cluster.stop()
+
+    pushbacks, window, ceiling, rejects = run(scenario())
+    assert rejects > 0, "budget 1 vs 12 concurrent ops never pushed back"
+    assert pushbacks > 0
+    assert window < ceiling  # multiplicative decrease engaged
+
+
+def test_throttle_noop_when_budgets_off():
+    """Default budgets (0) are a provable no-op: no pushbacks, window
+    stays at the ceiling — the chaos-injector contract."""
+
+    async def scenario():
+        cluster = await start_cluster(3, config=_fast_config())
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("noop", pg_num=4, size=3)
+            io = client.ioctx(pool)
+            await asyncio.gather(*[io.write_full(f"n{i}", b"x" * 1024)
+                                   for i in range(8)])
+            cwnd = client.objecter.cwnd
+            return (cwnd.pushbacks, cwnd.window, cwnd.ceiling,
+                    _sum_counter(cluster, "osd_throttle_rejects"))
+        finally:
+            await cluster.stop()
+
+    pushbacks, window, ceiling, rejects = run(scenario())
+    assert pushbacks == 0 and rejects == 0
+    assert window == float(ceiling)
+
+
+# ------------------------------------------- deadline shedding (mclock)
+
+
+def test_mclock_limit_sheds_expired_ops_at_dequeue():
+    """Six concurrent writes to one hot object through a 2 op/s mclock
+    limit, each with a 1.2s deadline: the L-tag pacing pushes the tail
+    of the queue past its deadline, the OSD sheds those at dequeue
+    (counted), and NO op is acked after its deadline — the overload
+    acceptance invariant at micro scale."""
+
+    async def scenario():
+        config = _fast_config()
+        config.osd_op_queue = "mclock"
+        cluster = await start_cluster(3, config=config)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("dl", pg_num=4, size=3)
+            io = client.ioctx(pool)
+            # warm: the qos entity registers + the object exists
+            await io.write_full("hot", b"warm")
+            entity = client.objecter.client_name.split("#", 1)[0]
+            for osd in cluster.osds.values():
+                osd.set_qos(entity, reservation=0.0, weight=1.0,
+                            limit=2.0)
+            loop = asyncio.get_event_loop()
+            deadline_s = 1.2
+            late_acks = []
+
+            async def put(i):
+                t0 = loop.time()
+                try:
+                    await io.write_full("hot", bytes([i]) * 512,
+                                        timeout=deadline_s)
+                except (IOError, OSError, TimeoutError):
+                    return 0
+                if loop.time() - t0 > deadline_s + 0.25:
+                    late_acks.append(i)
+                return 1
+
+            acked = sum(await asyncio.gather(*[put(i) for i in range(6)]))
+            # let the drain loop's dead-work purge sweep the expired
+            # tail (it wakes at most 0.25s after the deadlines pass)
+            await asyncio.sleep(0.8)
+            shed = _sum_counter(cluster, "osd_ops_shed_expired")
+            return acked, shed, late_acks
+        finally:
+            await cluster.stop()
+
+    acked, shed, late_acks = run(scenario())
+    assert late_acks == [], f"ops acked past their deadline: {late_acks}"
+    assert shed > 0, "expired queued ops were executed instead of shed"
+    assert acked >= 1  # the head of the queue still made it
+
+
+# -------------------------------------------- degraded-mode EC reads
+
+
+def test_ec_read_completes_k_of_n_with_dead_shard_holder():
+    """Kill the first shard holder the primary would contact, then read
+    WITHOUT waiting for a map change: the gather promotes/hedges to the
+    surviving shard and the read returns bit-exact — a dead holder
+    degrades latency, not availability."""
+
+    async def scenario():
+        from ceph_tpu.chaos.daemons import DaemonInjector
+
+        cluster = await start_cluster(4, config=_fast_config())
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create(
+                "deg", "erasure", pg_num=2,
+                ec_profile={"plugin": "jerasure",
+                            "technique": "reed_sol_van",
+                            "k": "2", "m": "1"})
+            io = client.ioctx(pool)
+            data = os.urandom(64 * 1024)
+            await io.write_full("obj", data)
+            pgid = client.objecter.object_pgid(pool, "obj")
+            _, _, acting, primary = \
+                client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+            # the first peer the fast-path gather contacts: lowest
+            # shard index whose holder is not the primary
+            victim = next(o for o in acting if o != primary)
+            await DaemonInjector(cluster).kill_osd(victim)
+            # read IMMEDIATELY — the map still lists the dead holder
+            got = await io.read("obj")
+            posd = cluster.osds[primary]
+            degraded = (posd.perf.get("osd_ec_hedged_reads") +
+                        posd.perf.get("osd_ec_hedge_promotions"))
+            return got == data, degraded
+        finally:
+            await cluster.stop()
+
+    bit_exact, degraded = run(scenario())
+    assert bit_exact
+    assert degraded >= 1, \
+        "read served without hedging/promoting around the dead holder"
+
+
+def test_ec_fastk_read_counts_and_stays_bit_exact():
+    """Healthy-cluster fast path: reads resolve from the first k clean
+    shards (counter fires) and every byte matches."""
+
+    async def scenario():
+        cluster = await start_cluster(4, config=_fast_config())
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create(
+                "fk", "erasure", pg_num=2,
+                ec_profile={"plugin": "jerasure",
+                            "technique": "reed_sol_van",
+                            "k": "2", "m": "1"})
+            io = client.ioctx(pool)
+            blobs = {f"f{i}": os.urandom(32 * 1024) for i in range(4)}
+            for oid, d in blobs.items():
+                await io.write_full(oid, d)
+            ok = all([(await io.read(oid)) == d
+                      for oid, d in blobs.items()])
+            return ok, _sum_counter(cluster, "osd_ec_fastk_reads")
+        finally:
+            await cluster.stop()
+
+    ok, fastk = run(scenario())
+    assert ok
+    assert fastk >= 1
+
+
+# ------------------------- byte throttle held through dispatch (regression)
+
+
+def test_byte_throttle_release_after_dispatch_and_attribution():
+    """Regression for osd_client_message_size_cap releases: with a cap
+    admitting ~1.5 writes, three concurrent 100 KiB writes to one PG
+    serialize through the byte budget, ALL complete (the blocked sender
+    resumes when the queue drains), and the wait lands in op
+    attribution as the throttle_wait stage."""
+
+    async def scenario():
+        from ceph_tpu.trace.attribution import aggregate_tracker
+
+        config = _fast_config()
+        config.osd_client_message_size_cap = 150_000
+        cluster = await start_cluster(3, config=config)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("thr", pg_num=2, size=3)
+            io = client.ioctx(pool)
+            payloads = [bytes([i]) * 100_000 for i in range(3)]
+            await asyncio.gather(*[io.write_full("hot", p)
+                                   for p in payloads])
+            got = await io.read("hot")
+            pgid = client.objecter.object_pgid(pool, "hot")
+            primary = client.objecter._target_osd(pgid)
+            rep = aggregate_tracker(cluster.osds[primary].tracker,
+                                    match="write_full")
+            return got in payloads, rep["stages"]
+        finally:
+            await cluster.stop()
+
+    consistent, stages = run(scenario())
+    assert consistent  # releases worked: every blocked write drained
+    assert "throttle_wait" in stages, stages
+    assert stages["throttle_wait"]["s"] > 0
+
+
+# --------------------------------------------- attribution stage contract
+
+
+def test_attribution_books_overload_stages_with_full_coverage():
+    """The round-6 trust model with backpressure enabled: timelines
+    carrying throttle/shed/hedge marks attribute every nanosecond to
+    exactly one stage (sums == traced total), with the new stage names."""
+    from ceph_tpu.trace.attribution import attribute_events
+
+    events = [
+        (0.00, "objecter:submit"),
+        (0.05, "objecter:throttle_wait"),      # cwnd gate wait
+        (0.06, "objecter:send"),
+        (0.07, "msgr:osd.0:recv"),
+        (0.09, "throttle:osd.0:acquired"),     # byte-budget wait
+        (0.10, "dispatched"),
+        (0.12, "ec_sub_read_sent"),
+        (0.15, "ec_hedge_sent"),               # straggler hedge
+        (0.18, "sub_read_acked"),
+        (0.19, "done"),
+    ]
+    stages, total = attribute_events(events)
+    assert stages["throttle_wait"] == pytest.approx(0.05 + 0.02)
+    assert stages["hedge"] == pytest.approx(0.03)
+    assert sum(stages.values()) == pytest.approx(total)
+
+    shed_stages, shed_total = attribute_events(
+        [(0.0, "initiated"), (0.4, "shed_expired")])
+    assert shed_stages == {"shed": pytest.approx(0.4)}
+    assert shed_total == pytest.approx(0.4)
+
+
+# --------------------------------------------------- chaos scenario gates
+
+
+@pytest.mark.chaos
+def test_overload_smoke_scenario():
+    """Tier-1 overload smoke: a 4x-budget zipfian burst on a healthy
+    cluster — shed count > 0, zero acked-past-deadline ops, durability
+    + health converge.  Structural verdicts only (load-sensitive host)."""
+    from ceph_tpu.chaos.scenario import builtin_scenarios, run_scenario
+
+    v = run(run_scenario(builtin_scenarios()["overload-smoke"], 23))
+    assert v.passed, v.failures
+    assert v.acked_objects > 0
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_overload_shed_scenario():
+    """The full acceptance gate: zipfian bursts at 4x admission budget
+    + a killed shard holder mid-run.  Durability invariants + zero
+    acked-but-expired ops + shed > 0 + HEALTH clear at convergence."""
+    from ceph_tpu.chaos.scenario import builtin_scenarios, run_scenario
+
+    v = run(run_scenario(builtin_scenarios()["overload-shed"], 29))
+    assert v.passed, v.failures
+    assert v.acked_objects > 0
+
+
+@pytest.mark.slow
+def test_goodput_within_20pct_of_admission_budget():
+    """No congestion collapse: goodput at 4x offered load stays within
+    20% of goodput at exactly-budget load (the AIMD window converges on
+    the admission budget instead of thrashing).  Timing-based — slow."""
+
+    async def phase(io, workers: int, secs: float, tag: str) -> int:
+        loop = asyncio.get_event_loop()
+        stop_at = loop.time() + secs
+        counts = [0] * workers
+
+        async def worker(w: int):
+            i = 0
+            while loop.time() < stop_at:
+                try:
+                    await io.write_full(f"{tag}_{w}_{i % 8}",
+                                        b"g" * 16384, timeout=10.0)
+                    counts[w] += 1
+                except (IOError, OSError, TimeoutError):
+                    pass
+                i += 1
+
+        await asyncio.gather(*[worker(w) for w in range(workers)])
+        return sum(counts)
+
+    async def scenario():
+        config = _fast_config()
+        config.osd_op_throttle_ops = 4
+        cluster = await start_cluster(3, config=config)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("gp", pg_num=8, size=3)
+            io = client.ioctx(pool)
+            await io.write_full("warm", b"w" * 16384)
+            at_budget = await phase(io, 4, 4.0, "a")
+            overloaded = await phase(io, 16, 4.0, "b")
+            return at_budget, overloaded
+        finally:
+            await cluster.stop()
+
+    at_budget, overloaded = run(scenario())
+    assert at_budget > 0
+    assert overloaded >= 0.8 * at_budget, \
+        f"goodput collapsed under 4x load: {overloaded} vs {at_budget}"
