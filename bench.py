@@ -64,7 +64,8 @@ def _measured_baselines():
         with open(_MEASURED_PATH) as f:
             doc = json.load(f)
         for row in doc.get("results", []):
-            val = row.get("gbps") or row.get("mappings_per_s")
+            val = row.get("gbps") or row.get("mappings_per_s") \
+                or row.get("mbps")
             if val:
                 out[row["config"]] = float(val)
     except (OSError, ValueError, KeyError, TypeError, AttributeError):
@@ -351,7 +352,7 @@ EC_CONFIGS = [
 
 
 def bench_cluster_io(secs_write=4.0, secs_read=3.0, perf_dump=False,
-                     attribute=False):
+                     attribute=False, concurrency=16, legacy=False):
     """End-to-end cluster I/O (the reference `rados bench` run,
     src/tools/rados/rados.cc:103): a live 3-OSD vstart cluster with an
     EC k2m1 pool, measured through the full client->primary->EC
@@ -378,6 +379,12 @@ def bench_cluster_io(secs_write=4.0, secs_read=3.0, perf_dump=False,
 
     async def scenario():
         config = _fast_config()
+        if legacy:
+            # the seed-equivalent per-op path (round-10 dispatch/encode,
+            # the bisection anchor): what the measured cluster baseline
+            # in BASELINE_MEASURED.json is captured against
+            config.osd_op_shards = 0
+            config.osd_batch_tick_ops = 0
         if attribute:
             # every write of the timing window must stay in the history
             # ring to be attributable (4s at cluster_io rates is well
@@ -401,8 +408,10 @@ def bench_cluster_io(secs_write=4.0, secs_read=3.0, perf_dump=False,
                 from ceph_tpu.trace.attribution import flush_op_history
 
                 await flush_op_history(cluster, 4096)
+                client.objecter.drain_op_tails()  # discard warm-up
             w = await rados_bench(io, secs_write, "write",
-                                  concurrency=16, block_size=1 << 20,
+                                  concurrency=concurrency,
+                                  block_size=1 << 20,
                                   cleanup=False)
             attribution = None
             if attribute:
@@ -411,7 +420,8 @@ def bench_cluster_io(secs_write=4.0, secs_read=3.0, perf_dump=False,
                 # Every OSD's report is merged: primaries spread across
                 # the acting sets, so each tracker holds a disjoint
                 # slice of the bench ops
-                from ceph_tpu.trace.attribution import merge_reports
+                from ceph_tpu.trace.attribution import (aggregate,
+                                                        merge_reports)
 
                 wall_s = w["lat_avg_ms"] / 1e3
                 reports = []
@@ -420,6 +430,14 @@ def bench_cluster_io(secs_write=4.0, secs_read=3.0, perf_dump=False,
                         f"osd.{oid}",
                         {"prefix": "dump_op_attribution",
                          "args": {"match": "write_full"}}))
+                # reply-leg tails (round 11): per-op reply flight +
+                # client wakeup recorded objecter-side.  They EXTEND the
+                # same ops the OSD reports already count, so the tail
+                # report contributes seconds but not ops to the
+                # per-op-average coverage math
+                tails = aggregate(client.objecter.drain_op_tails())
+                tails["ops"] = 0
+                reports.append(tails)
                 attribution = merge_reports(reports,
                                             measured_wall_s=wall_s)
                 # backpressure context for the artifact: nonzero only
@@ -432,7 +450,8 @@ def bench_cluster_io(secs_write=4.0, secs_read=3.0, perf_dump=False,
                                  "osd_qos_preempted",
                                  "osd_ec_hedged_reads")}
             r = await rados_bench(io, secs_read, "rand",
-                                  concurrency=16, block_size=1 << 20)
+                                  concurrency=concurrency,
+                                  block_size=1 << 20)
             dumps = {}
             if perf_dump:
                 # each daemon's perf dump rides the bench artifact so
@@ -448,17 +467,29 @@ def bench_cluster_io(secs_write=4.0, secs_read=3.0, perf_dump=False,
     w, r, dumps, attribution = asyncio.run(scenario())
     rows = []
     for tag, rep in (("write", w), ("rand_read", r)):
-        rows.append({
-            "metric": f"cluster_io_{tag}_ec_k2m1_1MiB_t16",
+        metric = f"cluster_io_{tag}_ec_k2m1_1MiB_t{concurrency}"
+        # measured cluster baseline (round 11): the denominator is the
+        # seed-equivalent per-op path captured in BASELINE_MEASURED.json
+        # on this host (--cluster-legacy run); no fallback constant —
+        # an unmeasured row stays explicitly unmeasured
+        ratio, prov = _vs(rep["mbps"], metric, fallback=None)
+        row = {
+            "metric": metric,
             "value": round(rep["mbps"], 2), "unit": "MB/s",
-            "vs_baseline": None, "baseline": None,
-            "baseline_src": "unmeasured", "mode": "cluster_vstart",
+            "vs_baseline": ratio, **prov, "mode": "cluster_vstart",
             "lat_p50_ms": round(rep["lat_p50_ms"], 2),
             "lat_p95_ms": round(rep["lat_p95_ms"], 2),
-            "iops": round(rep["iops"], 1)})
+            "iops": round(rep["iops"], 1)}
+        if legacy:
+            # a baseline-capture run must never pose as the batched
+            # data plane's number (and never ratio against itself)
+            row["legacy_path"] = True
+            row["vs_baseline"] = None
+        rows.append(row)
     if attribution is not None:
         rows.append({
-            "metric": "cluster_io_write_ec_k2m1_1MiB_t16_attribution",
+            "metric": f"cluster_io_write_ec_k2m1_1MiB_"
+                      f"t{concurrency}_attribution",
             "unit": "json", "mode": "cluster_vstart",
             "vs_baseline": None, "baseline": None,
             "baseline_src": "unmeasured",
@@ -483,6 +514,13 @@ def main():
     ap.add_argument("--attribute", action="store_true",
                     help="per-stage wall-time attribution of the "
                          "cluster_io write bench (graft-trace)")
+    ap.add_argument("--cluster-legacy", action="store_true",
+                    help="run cluster_io on the per-op legacy path "
+                         "(osd_op_shards=0, osd_batch_tick_ops=0): the "
+                         "measured-baseline capture mode")
+    ap.add_argument("--cluster-concurrency", type=int, default=16,
+                    help="cluster_io client concurrency (t1 checks "
+                         "single-op latency; t16 is the headline)")
     args = ap.parse_args()
 
     results = []
@@ -519,8 +557,10 @@ def main():
             print(json.dumps({"metric": "crush_map_10kosd_1Mpg",
                               "error": repr(e)}), file=sys.stderr)
         try:
-            results.extend(bench_cluster_io(perf_dump=args.perf_dump,
-                                            attribute=args.attribute))
+            results.extend(bench_cluster_io(
+                perf_dump=args.perf_dump, attribute=args.attribute,
+                concurrency=args.cluster_concurrency,
+                legacy=args.cluster_legacy))
         except Exception as e:
             print(json.dumps({"metric": "cluster_io", "error": repr(e)}),
                   file=sys.stderr)

@@ -76,32 +76,52 @@ class ECBackendMixin:
     # Byte layout appears only where bytes must: the store transaction and
     # the sub-write wire format.
 
+    async def _ec_write_full_pipelined(self, pool: PGPool, st: PGState,
+                                       oid: str, data: bytes,
+                                       snapc=None) -> int:
+        """Pipelined full write (round 11): encode OUTSIDE the PG lock
+        (parity is a pure function of the payload, so concurrent writes
+        of one PG coalesce at the encode tick instead of serializing),
+        take the lock only for the ordered commit section (version
+        assignment, log append, local apply, sub-write sends), and await
+        the fan-out acks with the lock RELEASED — the reference's
+        in-flight RepGather pipeline, where the PG admits the next write
+        while this one's shards are still committing.  The commit
+        frontier (pg.py _frontier_*) keeps the watermark honest under
+        out-of-order ack arrival."""
+        codec = self._codec(pool)
+        sinfo = self._sinfo(pool, codec)
+        shards, crcs = await self._encode_for_write(
+            codec, sinfo, data, want_crc=True)
+        async with st.lock:
+            token = await self._ec_commit_start(
+                pool, st, oid, len(data), shards, crcs, snapc,
+                codec, sinfo)
+        return await self._ec_commit_finish(st, token)
+
     async def _ec_write(self, pool: PGPool, st: PGState, oid: str,
                         data: bytes, offset: Optional[int],
                         snapc=None) -> int:
         """EC write incl. the RMW sequence (read old stripes, merge,
-        re-encode, fan out shard writes).  Serialization: callers hold the
-        PG-wide st.lock across the whole op, so overlapping RMWs to one
-        object can never interleave (the reference serializes them in the
-        ECBackend pipeline, ECBackend::start_rmw wait queue; our domain is
-        the whole PG, like the reference's PG lock)."""
+        re-encode, fan out shard writes).  Serialization: callers hold
+        the PG-wide st.lock across the whole op, so overlapping RMWs to
+        one object can never interleave (the reference serializes them
+        in the ECBackend pipeline, ECBackend::start_rmw wait queue).
+        Full writes on the client hot path go through
+        ``_ec_write_full_pipelined`` instead, which narrows the lock to
+        the ordered commit section."""
         from ceph_tpu.ec import stripe as stripemod
-        from ceph_tpu.cluster.optracker import mark_current
 
         codec = self._codec(pool)
         sinfo = self._sinfo(pool, codec)
         coll = _coll(st.pgid)
-        eversion = self._next_version(st)
-        version = eversion[1]
 
         if offset is None:
-            # write_full: replace the object
+            # write_full: replace the object — a full-shard rewrite, so
+            # the coalesced tick also batch-computes the shard crcs
             new_size = len(data)
-            chunk_off = 0
-            mark_current("ec_encode")
-            shards = await self._compute(
-                stripemod.encode_stripes, codec, sinfo, data)
-            mark_current("ec_encoded")
+            shards, crcs = await self._encode_for_write(
+                codec, sinfo, data, want_crc=True)
         else:
             sa = self.store.getattr(coll, oid, "size")
             if sa is None:
@@ -136,85 +156,193 @@ class ECBackendMixin:
             merged = stripemod.merge_range(
                 old_bytes, old_in_range, offset - off0, data)
             new_size = max(old_size, offset + len(data))
-            mark_current("ec_encode")
-            shards = await self._compute(
-                stripemod.encode_stripes, codec, sinfo, merged)
-            mark_current("ec_encoded")
+            # RMW touches a sub-range: the replica-side mid-shard crc
+            # merge stays local, so no batch crc here
+            shards, crcs = await self._encode_for_write(
+                codec, sinfo, merged, want_crc=False)
 
+        token = await self._ec_commit_start(
+            pool, st, oid, new_size, shards, crcs, snapc, codec, sinfo,
+            chunk_off=0 if offset is None else chunk_off)
+        return await self._ec_commit_finish(st, token)
+
+    async def _ec_commit_start(self, pool: PGPool, st: PGState, oid: str,
+                               new_size: int, shards, crcs, snapc,
+                               codec, sinfo, chunk_off: int = 0):
+        """Ordered commit section of an EC write (runs under st.lock):
+        version assignment + frontier registration, local shard apply,
+        log append, and the sub-write fan-out SENDS — everything whose
+        PG-wide order must match the version order.  Returns the token
+        ``_ec_commit_finish`` resolves outside the lock."""
+        from ceph_tpu.cluster.optracker import mark_current
+
+        eversion = self._next_version(st)
+        version = eversion[1]
+        self._frontier_open(st, eversion)
         shard_size = sinfo.shard_size(new_size)
         hinfo = {"size": new_size, "version": version}
-        # clone-on-write (make_writeable): the pre-ops clone each
-        # member's SHARD object in place — no snapshot data crosses the
-        # wire — and persist the updated SnapSet; they ride the sub-write
-        # so clone + write are atomic per shard
-        pre_ops = self._cow_pre_ops(st, oid, snapc, erasure=True)
-        n = codec.get_chunk_count()
-        reqid = self._next_reqid()
-        peers = []
-        my_shard = None
-        for shard in range(n):
-            osd = st.acting[shard] if shard < len(st.acting) else CRUSH_ITEM_NONE
-            if osd == self.osd_id:
-                my_shard = shard
-            elif osd != CRUSH_ITEM_NONE:
-                peers.append((osd, shard))
-        if my_shard is not None:
-            self._apply_shard(st.pgid, oid, my_shard,
-                              shards[my_shard].tobytes(), chunk_off,
-                              shard_size, hinfo, pre_ops=pre_ops)
-            mark_current("store:journal_queued")
-        entry = self._log_mutation(st, "modify", oid, eversion)
-        if peers:
-            fut = self._make_waiter(reqid, len(peers))
-            send_failures = 0
-            # span propagation: each shard sub-write carries the current
-            # span id so the replica's apply span joins this op's tree
-            subctx = self.tracer.context()
-            # sub-writes inherit the client op's deadline (None for
-            # recovery traffic): a replica sheds the dead legs
-            from ceph_tpu.cluster.pg import CURRENT_OP_DEADLINE
 
-            sub_deadline = CURRENT_OP_DEADLINE.get()
-            for osd, shard in peers:
-                try:
+        def hinfo_for(shard: int) -> Dict:
+            # full rewrites carry the batch-computed shard crc so no
+            # member (local or replica) re-checksums on its event loop
+            if crcs is None:
+                return hinfo
+            return {**hinfo, "crc": crcs[shard]}
+
+        try:
+            # clone-on-write (make_writeable): the pre-ops clone each
+            # member's SHARD object in place — no snapshot data crosses
+            # the wire — and persist the updated SnapSet; they ride the
+            # sub-write so clone + write are atomic per shard
+            pre_ops = self._cow_pre_ops(st, oid, snapc, erasure=True)
+            n = codec.get_chunk_count()
+            reqid = self._next_reqid()
+            peers = []
+            my_shard = None
+            for shard in range(n):
+                osd = st.acting[shard] if shard < len(st.acting) \
+                    else CRUSH_ITEM_NONE
+                if osd == self.osd_id:
+                    my_shard = shard
+                elif osd != CRUSH_ITEM_NONE:
+                    peers.append((osd, shard))
+            if my_shard is not None:
+                self._apply_shard(st.pgid, oid, my_shard,
+                                  shards[my_shard].tobytes(), chunk_off,
+                                  shard_size, hinfo_for(my_shard),
+                                  pre_ops=pre_ops)
+                mark_current("store:journal_queued")
+            entry = self._log_mutation(st, "modify", oid, eversion)
+            fut = None
+            send_failures = 0
+            if peers:
+                fut = self._make_waiter(reqid, len(peers))
+                # span propagation: each shard sub-write carries the
+                # current span id so the replica's apply span joins
+                # this op's tree
+                subctx = self.tracer.context()
+                # sub-writes inherit the client op's deadline (None for
+                # recovery traffic): a replica sheds the dead legs
+                from ceph_tpu.cluster.pg import CURRENT_OP_DEADLINE
+
+                sub_deadline = CURRENT_OP_DEADLINE.get()
+                subs = []
+                for osd, shard in peers:
                     sub = M.MOSDECSubOpWrite(
                         reqid=reqid, pgid=st.pgid, oid=oid, shard=shard,
-                        data=shards[shard].tobytes(), chunk_off=chunk_off,
-                        shard_size=shard_size, hinfo=hinfo, entry=entry,
+                        data=shards[shard].tobytes(),
+                        chunk_off=chunk_off,
+                        shard_size=shard_size, hinfo=hinfo_for(shard),
+                        entry=entry,
                         pre_ops=pre_ops,
                         epoch=self.osdmap.epoch,
                         deadline=sub_deadline)
                     if subctx is not None:
                         sub.trace = dict(subctx)
-                    await self._send_osd(osd, sub)
-                except (ConnectionError, OSError, RuntimeError):
-                    send_failures += 1
-                    self._waiter_dec(reqid)
-            mark_current("ec_sub_write_sent")
-            try:
-                if not fut.done():
-                    await asyncio.wait_for(
-                        fut, timeout=self._ack_wait_timeout())
-                mark_current("sub_write_acked")
-            except asyncio.TimeoutError:
-                return -110
-            finally:
-                self._pending.pop(reqid, None)
-            if send_failures:
-                # a shard sub-write never left this host: unlike the
-                # replicated path (full copies, reachable set suffices)
-                # every EC shard is unique, so the stripe is NOT k+m
-                # durable and must not ack — the reference blocks EC
-                # writes until EVERY acting shard commits.  Stay un-acked
-                # (-110): the divergent entry rewinds during peering and
-                # the client retries against the post-peering acting set.
-                # (Surfaced by graft-chaos: a just-restarted primary with
-                # dead peer sessions could ack a 1-shard stripe.)
-                return -110
+                    subs.append((osd, sub))
+                if self.config.osd_batch_tick_ops > 0:
+                    # batched fan-out (round 11): same-tick sub-writes
+                    # for one peer share a frame; a failed send still
+                    # surfaces per sub-write, so the every-shard-durable
+                    # rule holds
+                    results = await asyncio.gather(
+                        *(self._sub_batcher.send(o, s) for o, s in subs),
+                        return_exceptions=True)
+                    for res in results:
+                        if isinstance(res, BaseException):
+                            send_failures += 1
+                            self._waiter_dec(reqid)
+                else:
+                    for osd, sub in subs:
+                        try:
+                            await self._send_osd(osd, sub)
+                        except (ConnectionError, OSError, RuntimeError):
+                            send_failures += 1
+                            self._waiter_dec(reqid)
+                mark_current("ec_sub_write_sent")
+        except BaseException:
+            # frontier hygiene: a registered-but-unresolved entry would
+            # wedge the PG's commit watermark forever
+            self._frontier_done(st, eversion, ok=False)
+            raise
+        return (reqid, eversion, fut, send_failures)
+
+    async def _ec_commit_finish(self, st: PGState, token) -> int:
+        """Ack-wait half of an EC write — runs with the PG lock
+        RELEASED on the pipelined path, so the next same-PG write
+        overlaps this one's shard commits.  Resolves the commit
+        frontier however it exits."""
+        from ceph_tpu.cluster.optracker import mark_current
+
+        reqid, eversion, fut, send_failures = token
+        try:
+            if fut is not None:
+                try:
+                    if not fut.done():
+                        await asyncio.wait_for(
+                            fut, timeout=self._ack_wait_timeout())
+                    mark_current("sub_write_acked")
+                except asyncio.TimeoutError:
+                    self._frontier_done(st, eversion, ok=False)
+                    return -110
+                finally:
+                    self._pending.pop(reqid, None)
+                if send_failures:
+                    # a shard sub-write never left this host: unlike the
+                    # replicated path (full copies, reachable set
+                    # suffices) every EC shard is unique, so the stripe
+                    # is NOT k+m durable and must not ack — the
+                    # reference blocks EC writes until EVERY acting
+                    # shard commits.  Stay un-acked (-110): the
+                    # divergent entry rewinds during peering and the
+                    # client retries against the post-peering acting
+                    # set.  (Surfaced by graft-chaos: a just-restarted
+                    # primary with dead peer sessions could ack a
+                    # 1-shard stripe.)
+                    self._frontier_done(st, eversion, ok=False)
+                    return -110
+        except BaseException:
+            self._frontier_done(st, eversion, ok=False)
+            raise
         # every shard acked: this version can never roll back now
-        self._advance_last_complete(st, eversion)
+        self._frontier_done(st, eversion, ok=True)
         mark_current("commit")
         return 0
+
+    async def _encode_for_write(self, codec, sinfo, data: bytes,
+                                want_crc: bool):
+        """Encode one op's stripe range -> (shards, crcs-or-None).
+
+        With ``osd_batch_tick_ops`` > 0 the encode rides the per-tick
+        coalescer (cluster/batcher.py): every same-profile write in the
+        tick shares ONE planar conversion + fused dispatch + crc32c
+        batch, and the op's timeline gets the round-11 attribution
+        stages — ``batch_wait`` (parked awaiting its tick) and
+        ``batch_encode`` (its amortized share of the coalesced
+        dispatch).  At 0 this is exactly the round-10 per-op dispatch.
+        """
+        from ceph_tpu.ec import stripe as stripemod
+        from ceph_tpu.cluster.optracker import CURRENT_OP, mark_current
+
+        if self.config.osd_batch_tick_ops > 0:
+            mark_current("batch_parked")
+            shards, crcs, (t0, t1, batch_n) = \
+                await self._ec_batcher.encode(codec, sinfo, data,
+                                              want_crc)
+            op = CURRENT_OP.get()
+            if op is not None:
+                # amortized attribution: this op's share of the tick's
+                # encode wall; the rest of the window books as parked
+                # time (both stamps stay monotone: t1 - share >= t0)
+                share = (t1 - t0) / max(batch_n, 1)
+                op.mark_at("batch_tick", t1 - share)
+                op.mark_at("batch_encoded", t1)
+            return shards, crcs
+        mark_current("ec_encode")
+        shards = await self._compute(
+            stripemod.encode_stripes, codec, sinfo, data)
+        mark_current("ec_encoded")
+        return shards, None
 
     def _apply_shard(self, pgid: PGid, oid: str, shard: int, data: bytes,
                      chunk_off: int, shard_size: int, hinfo: Dict,
@@ -226,8 +354,12 @@ class ECBackendMixin:
         coll = _coll(pgid)
         old_size = self.store.stat(coll, oid)
         if chunk_off == 0 and len(data) >= shard_size:
-            # full-shard rewrite: one pass over the payload
-            crc = crcmod.crc32c(0xFFFFFFFF, data[:shard_size])
+            # full-shard rewrite: use the tick's batch-computed crc when
+            # the primary shipped one (hinfo["crc"], round 11) — no
+            # per-shard host pass on the event loop; else one pass here
+            crc = hinfo.get("crc")
+            if crc is None:
+                crc = crcmod.crc32c(0xFFFFFFFF, data[:shard_size])
         elif old_size is not None and chunk_off == old_size and \
                 shard_size == chunk_off + len(data):
             # append: combine the stored cumulative crc with the new
@@ -276,13 +408,9 @@ class ECBackendMixin:
            .set_version(coll, oid, hinfo["version"])
         self.store.queue_transaction(txn)
 
-    async def _handle_ec_write(self, conn: Connection,
-                               msg: M.MOSDECSubOpWrite) -> None:
-        if self._sub_op_expired(msg):
-            # dead work: the parent op's client deadline passed — no
-            # apply, no reply (the primary times out and stays un-acked,
-            # so a shed shard can never count toward durability)
-            return
+    def _apply_ec_sub_write(self, msg: M.MOSDECSubOpWrite) -> None:
+        """Apply one shard sub-write (store txn + log) — the shared
+        core of the single-frame and batched handlers."""
         # replica-side span: joins the primary's op tree via the sub-op
         # trace header (NULL_SPAN when untraced/disabled)
         tr = getattr(msg, "trace", None)
@@ -300,12 +428,44 @@ class ECBackendMixin:
                 self._log_mutation(st, msg.entry.op, msg.entry.oid,
                                    msg.entry.version, entry=msg.entry)
             self.perf.inc("osd_ec_sub_writes")
-            await self._reply_osd(conn, msg, M.MOSDECSubOpWriteReply(
-                reqid=msg.reqid, result=0))
         finally:
             if span is not None:
                 span.annotate(shard=msg.shard, oid=msg.oid)
                 span.finish()
+
+    async def _handle_ec_write(self, conn: Connection,
+                               msg: M.MOSDECSubOpWrite) -> None:
+        if self._sub_op_expired(msg):
+            # dead work: the parent op's client deadline passed — no
+            # apply, no reply (the primary times out and stays un-acked,
+            # so a shed shard can never count toward durability)
+            return
+        self._apply_ec_sub_write(msg)
+        await self._reply_osd(conn, msg, M.MOSDECSubOpWriteReply(
+            reqid=msg.reqid, result=0))
+
+    async def _handle_ec_write_batch(self, conn: Connection,
+                                     msg: M.MOSDECSubOpWriteBatch) -> None:
+        """A peer's tick batch: apply every item in list order, ack them
+        in ONE reply.  Expired items are silently absent from the
+        results — the shed contract of the unbatched path."""
+        results = []
+        for item in msg.items:
+            if self._sub_op_expired(item):
+                continue
+            try:
+                self._apply_ec_sub_write(item)
+            except Exception:
+                # per-item fault isolation: one item's failure (e.g. a
+                # chaos store injection) must not abort the rest of the
+                # frame or their acks — the failed item simply never
+                # acks, so ITS primary alone stays un-acked (the
+                # unbatched path's one-op blast radius)
+                self.perf.inc("osd_dispatch_errors")
+                continue
+            results.append((item.reqid, 0, item.shard))
+        await self._reply_osd(conn, msg, M.MOSDECSubOpWriteBatchReply(
+            results=results))
 
     async def _handle_ec_read(self, conn: Connection,
                               msg: M.MOSDECSubOpRead) -> None:

@@ -120,8 +120,25 @@ class ReplicatedBackendMixin:
         self.store.queue_transaction(txn)
         mark_current("store:journal_queued")
         entry = self._log_mutation(st, op, oid, version)
+        # commit-frontier registration (round 11): replicated mutations
+        # share the PG's watermark with pipelined EC writes, so every
+        # advance routes through the contiguous-prefix frontier
+        self._frontier_open(st, version)
         peers = [o for o in st.acting
                  if o != self.osd_id and o != CRUSH_ITEM_NONE]
+        try:
+            return await self._replicate_txn_fanout(
+                st, txn, entry, peers, version)
+        except BaseException:
+            self._frontier_done(st, version, ok=False)
+            raise
+
+    async def _replicate_txn_fanout(self, st: PGState, txn: Transaction,
+                                    entry, peers,
+                                    version: pglog.Eversion) -> int:
+        from ceph_tpu.cluster.optracker import mark_current
+        from ceph_tpu.cluster.pg import CURRENT_OP_DEADLINE
+
         if peers:
             reqid = self._next_reqid()
             fut = self._make_waiter(reqid, len(peers))
@@ -157,11 +174,13 @@ class ReplicatedBackendMixin:
                         fut, timeout=self._ack_wait_timeout())
                 mark_current("sub_op_acked")
             except asyncio.TimeoutError:
+                self._frontier_done(st, version, ok=False)
                 return -110
             finally:
                 self._pending.pop(reqid, None)
         # all acting members acked: advance the never-roll-back watermark
-        self._advance_last_complete(st, version)
+        # (through the frontier, clamped below any pending pipelined op)
+        self._frontier_done(st, version, ok=True)
         mark_current("commit")
         return 0
 

@@ -1,0 +1,194 @@
+"""Per-tick stripe-batch coalescing: the OSD's group-commit encode seam.
+
+Round 11 (ROADMAP items 1-2): concurrent EC writes must stop crossing
+the host/device boundary alone.  Every `_ec_write` submits its stripe
+range here instead of dispatching its own encode; requests that arrive
+while a tick is in flight accumulate, and the next tick encodes ALL of
+them as one `PlanarBatch` round trip (`ec/stripe.encode_stripes_multi`:
+one to_planar conversion, one fused Pallas dispatch, one crc32c batch),
+scattering shard rows back to each op's sub-write fan-out.
+
+The tick is SELF-CLOCKING (group commit): a request hitting an idle
+profile encodes immediately — a lone op (t1 latency) never waits — and
+under load the encode-in-flight window is exactly what accumulates the
+next tick's batch.  That also gives the double-buffering the design
+calls for: while tick T encodes in the executor, tick T-1's ops are
+already fanning out sub-writes and tick T+1 is accumulating.
+`osd_batch_tick_ops` bounds a tick's batch; `osd_batch_tick_window`
+optionally stretches accumulation after an idle-start request.
+
+This module is the ONE sanctioned device-dispatch seam for per-op EC
+encodes under cluster/ — the `per-op-device-dispatch` graftlint rule
+polices the rest of the tree.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Tuple
+
+
+class _Req:
+    __slots__ = ("data", "want_crc", "fut")
+
+    def __init__(self, data, want_crc: bool, fut: asyncio.Future):
+        self.data = data
+        self.want_crc = want_crc
+        self.fut = fut
+
+
+class SubWriteBatcher:
+    """Per-peer group commit for EC shard sub-writes: the tick's
+    sub-writes destined for one peer ride ONE MOSDECSubOpWriteBatch
+    frame (one pickle, one session frame, one transport ack, one
+    batched reply) instead of one frame per op.  Same self-clocking
+    shape as EncodeBatcher: a lone sub-write sends immediately as a
+    plain MOSDECSubOpWrite — the wire format of the unbatched path."""
+
+    def __init__(self, osd):
+        self._osd = osd
+        self._pending: Dict[int, List] = {}      # target osd -> [(sub, fut)]
+        self._workers: Dict[int, asyncio.Task] = {}
+
+    async def send(self, target: int, sub) -> None:
+        """Queue one sub-write for ``target``; returns when the frame
+        carrying it was handed to the session (raises like _send_osd on
+        a failed send, so _ec_write's every-shard-durable rule holds)."""
+        fut = asyncio.get_event_loop().create_future()
+        self._pending.setdefault(target, []).append((sub, fut))
+        if target not in self._workers:
+            task = asyncio.get_event_loop().create_task(
+                self._drain(target))
+            self._workers[target] = task
+            self._osd._track(task)
+        # resolved by the local worker's finally even on cancellation
+        # (exception), never a cross-daemon wait
+        await fut  # graftlint: ignore[rpc-timeout]
+
+    async def _drain(self, target: int) -> None:
+        from ceph_tpu.cluster import messages as M
+
+        osd = self._osd
+        batch: List = []
+        try:
+            while not osd._stopped:
+                pending = self._pending.get(target)
+                if not pending:
+                    break
+                cap = max(1, osd.config.osd_batch_tick_ops)
+                batch = pending[:cap]
+                self._pending[target] = pending[cap:]
+                try:
+                    if len(batch) == 1:
+                        await osd._send_osd(target, batch[0][0])
+                    else:
+                        await osd._send_osd(
+                            target, M.MOSDECSubOpWriteBatch(
+                                items=[s for s, _f in batch],
+                                epoch=osd.osdmap.epoch))
+                        osd.perf.inc("osd_subwrite_batches")
+                        osd.perf.inc("osd_subwrite_batched_items",
+                                     len(batch))
+                    for _s, f in batch:
+                        if not f.done():
+                            f.set_result(None)
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    for _s, f in batch:
+                        if not f.done():
+                            f.set_exception(e)
+                batch = []
+        finally:
+            self._workers.pop(target, None)
+            leftovers = batch + (self._pending.pop(target, None) or [])
+            for _s, f in leftovers:
+                if not f.done():
+                    f.set_exception(
+                        ConnectionError("sub-write batcher stopped"))
+
+
+class EncodeBatcher:
+    """One per OSD daemon; keyed by codec identity so only same-profile
+    writes coalesce (mixed-profile ticks run as independent batches —
+    their math never mixes)."""
+
+    def __init__(self, osd):
+        self._osd = osd
+        self._pending: Dict[Tuple, List[_Req]] = {}
+        self._workers: Dict[Tuple, asyncio.Task] = {}
+
+    async def encode(self, codec, sinfo, data, want_crc: bool):
+        """Coalesced encode of one op's stripe-aligned byte range.
+
+        Returns ``(shards, crcs, (t0, t1, batch_n))``: the op's
+        (k+m, nstripes*unit) shard matrix, the per-shard-row crcs (full
+        rewrites only, else None), and the tick's encode window +
+        batch size for amortized attribution."""
+        key = (id(codec), sinfo.k, sinfo.chunk_size)
+        fut = asyncio.get_event_loop().create_future()
+        self._pending.setdefault(key, []).append(
+            _Req(data, want_crc, fut))
+        if key not in self._workers:
+            task = asyncio.get_event_loop().create_task(
+                self._drain(key, codec, sinfo))
+            self._workers[key] = task
+            self._osd._track(task)
+        # not a cross-daemon RPC wait: the resolver is the local worker
+        # task just armed above, whose finally resolves EVERY parked
+        # request (exception on cancellation) — a bound here would only
+        # add a spurious failure mode under first-call XLA compiles
+        return await fut  # graftlint: ignore[rpc-timeout]
+
+    async def _drain(self, key, codec, sinfo) -> None:
+        """Tick loop for one codec profile; exits when idle (the next
+        request re-arms it).  The empty-check/exit runs with no await in
+        between, so an enqueue can never race the worker's death."""
+        from ceph_tpu.ec import stripe as stripemod
+
+        osd = self._osd
+        batch: List[_Req] = []
+        try:
+            while not osd._stopped:
+                pending = self._pending.get(key)
+                if not pending:
+                    break
+                window = osd.config.osd_batch_tick_window
+                if window and len(pending) == 1:
+                    # optional accumulation stretch after an idle start
+                    await asyncio.sleep(window)
+                    pending = self._pending.get(key) or []
+                cap = max(1, osd.config.osd_batch_tick_ops)
+                batch = pending[:cap]
+                self._pending[key] = pending[cap:]
+                t0 = osd.clock.monotonic()
+                try:
+                    results = await osd._compute(
+                        stripemod.encode_stripes_multi, codec, sinfo,
+                        [r.data for r in batch],
+                        [r.want_crc for r in batch])
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    for r in batch:
+                        if not r.fut.done():
+                            r.fut.set_exception(e)
+                    batch = []
+                    continue
+                t1 = osd.clock.monotonic()
+                osd.perf.inc("osd_batch_ticks")
+                osd.perf.inc("osd_batch_coalesced_ops", len(batch))
+                tick = (t0, t1, len(batch))
+                for r, (shards, crcs) in zip(batch, results):
+                    if not r.fut.done():
+                        r.fut.set_result((shards, crcs, tick))
+                batch = []
+        finally:
+            self._workers.pop(key, None)
+            # cancellation mid-tick (daemon stop): parked requests must
+            # fail loudly, never hang their ops to the full timeout
+            leftovers = batch + (self._pending.pop(key, None) or [])
+            for r in leftovers:
+                if not r.fut.done():
+                    r.fut.set_exception(
+                        ConnectionError("encode batcher stopped"))

@@ -142,10 +142,11 @@ class ClientOpsMixin:
             return True  # control acks bypass admission (see helper)
         if self._admit_op(msg):
             return True
-        if self._opq is not None and \
+        evq = self._qos_evict_source()
+        if evq is not None and \
                 not self._qos_background(msg.reqid[0]):
-            victim = self._opq.peek_evict(self._qos_background)
-            evicted = self._opq.evict(self._qos_background) \
+            victim = evq.peek_evict(self._qos_background)
+            evicted = evq.evict(self._qos_background) \
                 if victim is not None and \
                 self._would_admit_after_evicting(msg, victim[1]) else None
             if evicted is not None:
@@ -177,6 +178,30 @@ class ClientOpsMixin:
             reqid=msg.reqid, result=M.THROTTLED, throttled=True,
             epoch=m.epoch))
         return False
+
+    def _qos_default_for(self, qos_client: str):
+        """First-sight QoS spec for a client class: the configured
+        default, or the background override for osd-internal traffic
+        (no reservation, a fraction of spare capacity, first in line
+        for eviction)."""
+        from ceph_tpu.cluster.dmclock import QoSSpec
+
+        if self._qos_background(qos_client):
+            return QoSSpec(
+                reservation=0.0,
+                weight=self.config.osd_mclock_background_weight,
+                limit=self.config.osd_mclock_background_limit)
+        return self._opq_default
+
+    def _qos_evict_source(self):
+        """The queue QoS-enforced shedding evicts from under admission
+        pressure: the legacy global mclock queue, or the sharded queues
+        (each shard owns a DmClockQueue).  None without mclock."""
+        if self._opq is not None:
+            return self._opq
+        if self._shardedq is not None and self._shardedq.use_mclock:
+            return self._shardedq
+        return None
 
     def _shed_if_expired(self, msg: M.MOSDOp) -> bool:
         """Dead-work shedding at dequeue: an op past its client-stamped
@@ -229,18 +254,20 @@ class ClientOpsMixin:
         # explicit pushback — the end of unbounded queueing
         if not await self._admit_or_pushback(conn, msg, m):
             return
+        if self._shardedq is not None:
+            # sharded dispatch (round 11): the shard owns queueing,
+            # shedding, and the dispatch tick; PG-affine hashing keeps
+            # per-object ordering inside one shard
+            qos_client = None
+            default = None
+            if self._shardedq.use_mclock:
+                qos_client = self._qos_entity(msg.reqid[0])
+                default = self._qos_default_for(qos_client)
+            self._shardedq.enqueue(conn, msg, qos_client, default)
+            return
         if self._opq is not None:
-            from ceph_tpu.cluster.dmclock import QoSSpec
-
             qos_client = self._qos_entity(msg.reqid[0])
-            default = self._opq_default
-            if self._qos_background(qos_client):
-                # background class: no reservation, a fraction of the
-                # spare capacity, and first in line for eviction
-                default = QoSSpec(
-                    reservation=0.0,
-                    weight=self.config.osd_mclock_background_weight,
-                    limit=self.config.osd_mclock_background_limit)
+            default = self._qos_default_for(qos_client)
             self._opq.ensure_client(qos_client, default)
             # queue ONLY (conn, msg, stamp): map/pool/PG/primary state is
             # re-resolved at dequeue time, and ops that outlived the
@@ -393,9 +420,12 @@ class ClientOpsMixin:
         """Live per-client QoS update (mclock profile analog)."""
         from ceph_tpu.cluster.dmclock import QoSSpec
 
+        spec = QoSSpec(reservation=reservation, weight=weight,
+                       limit=limit)
         if self._opq is not None:
-            self._opq.set_client(client, QoSSpec(
-                reservation=reservation, weight=weight, limit=limit))
+            self._opq.set_client(client, spec)
+        if self._shardedq is not None and self._shardedq.use_mclock:
+            self._shardedq.set_client(client, spec)
 
     # ops whose effects are not idempotent under at-least-once delivery;
     # a resend must return the cached original reply (reference pg_log
@@ -643,12 +673,28 @@ class ClientOpsMixin:
             if result < 0:
                 break
         data = outs[0] if len(msg.ops) == 1 else outs
-        await conn.send(M.MOSDOpReply(
-            reqid=msg.reqid, result=result, data=data, epoch=m.epoch))
+        reply = M.MOSDOpReply(
+            reqid=msg.reqid, result=result, data=data, epoch=m.epoch)
+        tr = getattr(msg, "trace", None)
+        if tr is not None:
+            # reply-leg trace (round 11): the messengers stamp the
+            # send/recv hops and the objecter closes with its wakeup —
+            # the previously-untraced tail of wall_coverage
+            reply.trace = {"id": tr.get("id"), "events": []}
+        await conn.send(reply)
 
     async def _do_one_op(self, conn, msg, m, pool, st, opname, args):
         """One op of the vector -> (result, out_data)."""
         if opname == "write_full":
+            if pool.is_erasure():
+                # pipelined (round 11): encode outside the PG lock,
+                # ordered commit under it, ack wait after release — the
+                # PG admits the next write while this one's shards
+                # commit (per-object ordering still absolute: the
+                # dispatch group serializes same-object ops end to end)
+                r = await self._ec_write_full_pipelined(
+                    pool, st, msg.oid, args["data"], snapc=msg.snapc)
+                return r, None
             async with st.lock:
                 r = await self._op_write_full(
                     pool, st, msg.oid, args["data"], snapc=msg.snapc)

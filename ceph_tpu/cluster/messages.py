@@ -278,6 +278,27 @@ class MOSDECSubOpWriteReply(Message):
 
 
 @dataclass
+class MOSDECSubOpWriteBatch(Message):
+    """A dispatch tick's shard sub-writes for ONE peer in ONE frame
+    (round 11): each item is a complete MOSDECSubOpWrite, applied in
+    list order.  Collapses the per-op frame/ack churn of the fan-out —
+    the wire analog of the tick's coalesced encode."""
+
+    items: List[Any] = field(default_factory=list)
+    epoch: int = 0
+
+
+@dataclass
+class MOSDECSubOpWriteBatchReply(Message):
+    """Per-item acks for a sub-write batch: (reqid, result, shard)
+    triples.  Items the replica SHED (expired deadline) are absent —
+    their primaries must stay un-acked, exactly like the unbatched
+    path's no-reply contract."""
+
+    results: List[Tuple] = field(default_factory=list)
+
+
+@dataclass
 class MOSDECSubOpRead(Message):
     """Shard read (reference handle_sub_read, ECBackend.cc:986).
     off/length select a chunk sub-range (None = whole shard)."""

@@ -50,6 +50,27 @@ Addr = Tuple[str, int]
 
 _SID = itertools.count(1)
 
+# stream buffer limit: asyncio's 64 KiB default pauses/resumes the
+# transport several times inside EVERY 1 MiB data frame (flow-control
+# churn per sub-write); sized to hold a whole large frame.  Socket
+# buffers get the same treatment so a burst of shard sub-writes drains
+# in few syscalls (TCP_NODELAY is asyncio's default already).
+_STREAM_LIMIT = 4 << 20
+_SOCK_BUF = 2 << 20
+
+
+def _tune_socket(writer) -> None:
+    import socket as _socket
+
+    sock = writer.get_extra_info("socket")
+    if sock is None:
+        return
+    try:
+        sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_SNDBUF, _SOCK_BUF)
+        sock.setsockopt(_socket.SOL_SOCKET, _socket.SO_RCVBUF, _SOCK_BUF)
+    except OSError:  # pragma: no cover - exotic transports
+        pass
+
 
 @dataclass(frozen=True)
 class EntityName:
@@ -181,17 +202,32 @@ class Connection:
         async with self._send_lock:
             self._seq += 1
             msg.seq = self._seq
+            if msg.trace is not None:
+                # hop stamp for replies riding raw connections (the
+                # reply-leg half of op attribution; send_message stamps
+                # session traffic the same way)
+                msg.trace.setdefault("events", []).append(
+                    (f"msgr:{self.messenger.name}:send", _time.time()))
             hs = _encode_hs(msg)
             if hs is not None:
-                frame = hs  # handshake: fixed struct, pre-session, unsigned
+                # handshake: fixed struct, pre-session, unsigned
+                bufs = [struct.pack("<I", len(hs)), hs]
             else:
                 payload = pickle.dumps(msg)
                 secret = self._sign_key()
-                if secret is not None:
-                    payload += _sign(secret, payload)
-                frame = bytes([_FT_MSG]) + payload
+                sig = _sign(secret, payload) if secret is not None \
+                    else b""
+                # zero-copy framing: header/payload/signature go to the
+                # transport as separate buffers — a 1 MiB payload is
+                # never re-materialized into a fresh frame bytes
+                bufs = [struct.pack("<IB",
+                                    1 + len(payload) + len(sig),
+                                    _FT_MSG), payload]
+                if sig:
+                    bufs.append(sig)
             try:
-                self.writer.write(struct.pack("<I", len(frame)) + frame)
+                for b in bufs:
+                    self.writer.write(b)
                 await self.writer.drain()
             except (ConnectionError, RuntimeError):
                 self.closed = True
@@ -396,11 +432,13 @@ class Messenger:
         self.dispatchers.append(d)
 
     async def bind(self, host: str = "127.0.0.1", port: int = 0) -> Addr:
-        self._server = await asyncio.start_server(self._accept, host, port)
+        self._server = await asyncio.start_server(
+            self._accept, host, port, limit=_STREAM_LIMIT)
         self.my_addr = self._server.sockets[0].getsockname()[:2]
         return self.my_addr
 
     async def _accept(self, reader, writer) -> None:
+        _tune_socket(writer)
         conn = Connection(self, reader, writer)
         if self._closing:
             # a peer raced our shutdown: refuse, or the read loop would
@@ -423,10 +461,14 @@ class Messenger:
                 if n < 1:
                     raise ConnectionError("empty frame")
                 frame = await conn.reader.readexactly(n)
-                ftype, payload = frame[0], frame[1:]
+                # memoryview slicing: verification, signature strip, and
+                # unpickle all run on views of the one received buffer —
+                # no per-frame payload re-materialization (round 11)
+                ftype, payload = frame[0], memoryview(frame)[1:]
                 if ftype != _FT_MSG:
                     # handshake frames: fixed struct decode, no pickle
-                    msg = _decode_hs(ftype, payload)
+                    # (tiny; decoded from a plain bytes copy)
+                    msg = _decode_hs(ftype, bytes(payload))
                     if self.auth is None or not await \
                             self._handle_auth_frame(conn, msg):
                         raise ConnectionError(
@@ -553,7 +595,7 @@ class Messenger:
                           b"authreq:" + self.auth.entity.encode() + nonce,
                           hashlib.sha256).digest()[:SIG_LEN]
         reader, writer = await asyncio.open_connection(
-            mon_addr[0], mon_addr[1])
+            mon_addr[0], mon_addr[1], limit=_STREAM_LIMIT)
         conn = Connection(self, reader, writer, peer_addr=tuple(mon_addr))
         fut = asyncio.get_event_loop().create_future()
         self._auth_waiters[id(conn)] = fut
@@ -580,7 +622,9 @@ class Messenger:
         conn = self._out.get(tuple(addr))
         if conn is not None and not conn.closed:
             return conn
-        reader, writer = await asyncio.open_connection(addr[0], addr[1])
+        reader, writer = await asyncio.open_connection(
+            addr[0], addr[1], limit=_STREAM_LIMIT)
+        _tune_socket(writer)
         conn = Connection(self, reader, writer, peer_addr=tuple(addr))
         if self.auth is not None:
             # authorizer-first (reference connection handshake): present
@@ -663,10 +707,10 @@ class Messenger:
                     await self._reconnect_replay(sess, addr)
                     return
                 conn = await self.connect(addr)
-                frame = self._frame(conn, payload)
-                conn.writer.write(frame)
+                bufs = self._frame_bufs(conn, payload)
+                self._write_frame(conn, bufs)
                 if fate is not None and fate.dup:
-                    conn.writer.write(frame)  # duplicate delivery:
+                    self._write_frame(conn, bufs)  # duplicate delivery:
                     # handlers are idempotent by contract — prove it
                 await conn.writer.drain()
                 # flush boundary on the CURRENT op's timeline (sub-op
@@ -710,7 +754,7 @@ class Messenger:
         await asyncio.sleep(delay)
         try:
             conn = await self.connect(addr)
-            conn.writer.write(self._frame(conn, payload))
+            self._write_frame(conn, self._frame_bufs(conn, payload))
             await conn.writer.drain()
         except (ConnectionError, OSError, RuntimeError):
             if self._closing:
@@ -730,12 +774,24 @@ class Messenger:
 
         return track_task(self._tasks, task)
 
-    def _frame(self, conn: Connection, payload: bytes) -> bytes:
+    def _frame_bufs(self, conn: Connection, payload: bytes) -> list:
+        """Frame as a buffer list (header, payload, signature), written
+        sequentially: large payloads pass straight to the transport
+        instead of being copied into a fresh frame bytes per hop (the
+        round-11 zero-copy framing; replay buffers still hold only the
+        single pickled payload)."""
         key = conn._sign_key()
-        if key is not None:
-            payload = payload + _sign(key, payload)
-        frame = bytes([_FT_MSG]) + payload
-        return struct.pack("<I", len(frame)) + frame
+        sig = _sign(key, payload) if key is not None else b""
+        bufs = [struct.pack("<IB", 1 + len(payload) + len(sig),
+                            _FT_MSG), payload]
+        if sig:
+            bufs.append(sig)
+        return bufs
+
+    @staticmethod
+    def _write_frame(conn: Connection, bufs: list) -> None:
+        for b in bufs:
+            conn.writer.write(b)
 
     async def _reconnect_replay(self, sess: _Session, addr: Addr,
                                 retries: int = 3) -> None:
@@ -776,7 +832,8 @@ class Messenger:
             try:
                 conn = await self.connect(addr)
                 for payload in sess.unacked.values():
-                    conn.writer.write(self._frame(conn, payload))
+                    self._write_frame(conn, self._frame_bufs(conn,
+                                                             payload))
                 await conn.writer.drain()
                 sess.needs_replay = False
                 return

@@ -80,6 +80,14 @@ class Objecter(Dispatcher):
         # THROTTLED (-EBUSY) pushback — the primary flow-control signal,
         # replacing blind wait_for timeouts.  Wide open until the first
         # pushback, so with throttles off (default) it never constrains.
+        self._primary_cache: Tuple[Optional[int], Dict] = (None, {})
+        # reply-leg tail timelines (round 11): the OSD's terminal reply
+        # carries a trace whose hop stamps + our completion stamp cover
+        # the previously-untraced reply flight + client wakeup; bench
+        # --attribute merges these so wall_coverage holds on short ops
+        from collections import deque as _deque
+
+        self._op_tails: "_deque" = _deque(maxlen=4096)
         self.cwnd = AIMDWindow(self.config.objecter_inflight_max)
         self._cwnd_inflight = 0
         self._cwnd_event = asyncio.Event()
@@ -187,8 +195,41 @@ class Objecter(Dispatcher):
         return PGid(pool_id, seed)
 
     def _target_osd(self, pgid: PGid) -> int:
-        _, _, acting, acting_primary = self.osdmap.pg_to_up_acting_osds(pgid)
-        return acting_primary
+        # per-epoch primary cache: the scalar CRUSH walk per op was a
+        # measurable slice of the t16 hot path; any map change bumps the
+        # epoch and drops the whole cache (pg_temp/primary_temp ride
+        # epochs too, so staleness is impossible by construction)
+        m = self.osdmap
+        epoch, cache = self._primary_cache
+        if epoch != m.epoch:
+            cache = {}
+            self._primary_cache = (m.epoch, cache)
+        primary = cache.get(pgid)
+        if primary is None:
+            _, _, _, primary = m.pg_to_up_acting_osds(pgid)
+            cache[pgid] = primary
+        return primary
+
+    def _record_reply_tail(self, reply) -> None:
+        """Keep the reply's hop timeline + our wakeup stamp (no-op for
+        untraced replies)."""
+        tr = getattr(reply, "trace", None)
+        if tr is None:
+            return
+        import time as _time
+
+        # header events are (name, wall_ts); attribution timelines are
+        # (time, name) pairs
+        evs = [(ts, name) for name, ts in tr.get("events", ())]
+        evs.append((_time.time(), "objecter:complete"))
+        self._op_tails.append(evs)
+
+    def drain_op_tails(self):
+        """Return and clear the recorded reply tails (bench --attribute
+        drains once after warm-up, once after the timing window)."""
+        out = [list(e) for e in self._op_tails]
+        self._op_tails.clear()
+        return out
 
     async def _refresh_map(self) -> None:
         self._map_event.clear()
@@ -342,6 +383,7 @@ class Objecter(Dispatcher):
                     if reply.result != -11:  # not misdirected
                         self.cwnd.on_ack()
                         self._pushback_backoff.reset()
+                        self._record_reply_tail(reply)
                         return reply
                 except (ConnectionError, OSError, asyncio.TimeoutError):
                     self._inflight.pop(reqid, None)

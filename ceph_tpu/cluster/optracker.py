@@ -92,6 +92,12 @@ class TrackedOp:
     def mark(self, event: str) -> None:
         self.events.append((self._clock.monotonic() - self.start, event))
 
+    def mark_at(self, event: str, mono_ts: float) -> None:
+        """Record an event at an explicit ``clock.monotonic()`` stamp —
+        for shared timestamps computed elsewhere (the encode coalescer's
+        tick window lands on every op of the batch)."""
+        self.events.append((mono_ts - self.start, event))
+
     def finish(self) -> None:
         if self.duration is None:
             self.mark("done")

@@ -186,14 +186,34 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         # orders a client session's ops per PG (ShardedOpWQ pg queues)
         self._ordered_q: Dict[Tuple[int, PGid], object] = {}
         self._ordered_active: Set[Tuple[int, PGid]] = set()
+        self._opq_default = None
         if self.config.osd_op_queue == "mclock":
             from ceph_tpu.cluster.dmclock import DmClockQueue, QoSSpec
 
-            self._opq = DmClockQueue()
             self._opq_default = QoSSpec(
                 reservation=self.config.osd_mclock_default_reservation,
                 weight=self.config.osd_mclock_default_weight,
                 limit=self.config.osd_mclock_default_limit)
+            if self.config.osd_op_shards == 0:
+                # legacy global queue; with shards on, each shard owns
+                # its own DmClockQueue (mClockClientQueue-per-shard)
+                self._opq = DmClockQueue()
+        # sharded dispatch (round 11, ShardedOpWQ analog): PG-affine
+        # shards with tick-bounded drain; 0 = the legacy path above
+        self._shardedq = None
+        if self.config.osd_op_shards > 0:
+            from ceph_tpu.cluster.sharded_wq import ShardedOpWQ
+
+            self._shardedq = ShardedOpWQ(self,
+                                         self.config.osd_op_shards)
+        # per-tick stripe-batch coalescer + per-peer sub-write frame
+        # batcher (cluster/batcher.py): EC writes ride both when
+        # osd_batch_tick_ops > 0
+        from ceph_tpu.cluster.batcher import (EncodeBatcher,
+                                              SubWriteBatcher)
+
+        self._ec_batcher = EncodeBatcher(self)
+        self._sub_batcher = SubWriteBatcher(self)
         # boot instance nonce: lets the mon fence a fast rebounce even if
         # the new daemon lands on the identical address
         import itertools as _it
@@ -228,6 +248,8 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         self._track(loop.create_task(self._tier_agent_loop()))
         if self._opq is not None:
             self._track(loop.create_task(self._opq_drain()))
+        if self._shardedq is not None:
+            self._shardedq.start()
         if self.loopmon.enabled:
             self._track(loop.create_task(self.loopmon.sample()))
         return addr
@@ -292,6 +314,17 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         return (f"osd.{self.osd_id}", self._tid)
 
     @property
+    def _mclock_dispatch(self) -> bool:
+        """Is client-op dispatch QoS-queued (global legacy queue or
+        per-shard mclock)?  Governs the internal-op loopback choice:
+        under FIFO-ordered dispatch a self-targeted nested op must run
+        direct (same-(conn,PG) group serialization would deadlock);
+        under mclock each dequeue is a free task, so self-messaging is
+        safe and required."""
+        return self._opq is not None or (
+            self._shardedq is not None and self._shardedq.use_mclock)
+
+    @property
     def mon_addr(self) -> Addr:
         return self.monc.current
 
@@ -350,7 +383,7 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
             msg = M.MOSDOp(reqid=reqid, pgid=pgid, oid=oid, ops=ops,
                            epoch=m.epoch, snapc=snapc, snapid=snapid,
                            deadline=wall_deadline)
-            if primary == self.osd_id and self._opq is None:
+            if primary == self.osd_id and not self._mclock_dispatch:
                 # self-targeted: dispatch DIRECTLY instead of messaging
                 # ourselves — a nested internal op would share the outer
                 # op's self-connection, whose read loop is blocked in the
@@ -502,6 +535,18 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
             return True
         if isinstance(msg, M.MOSDECSubOpWrite):
             await self._handle_ec_write(conn, msg)
+            return True
+        if isinstance(msg, M.MOSDECSubOpWriteBatch):
+            await self._handle_ec_write_batch(conn, msg)
+            return True
+        if isinstance(msg, M.MOSDECSubOpWriteBatchReply):
+            # scatter the batched acks to each op's waiter; the shim
+            # carries src+shard so the per-responder ack dedup holds
+            from types import SimpleNamespace
+
+            for reqid, result, shard in msg.results:
+                self._ack(reqid, result,
+                          SimpleNamespace(src=msg.src, shard=shard))
             return True
         if isinstance(msg, M.MOSDECSubOpRead):
             await self._handle_ec_read(conn, msg)
@@ -659,6 +704,21 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         self.perf.add_u64("osd_recovery_yields",
                           desc="background recovery/scrub rounds "
                                "delayed under client admission pressure")
+        # batched data plane (round 11): coalesced dispatch telemetry —
+        # coalesced_ops / ticks is the realized batch factor
+        self.perf.add_u64("osd_batch_ticks",
+                          prio=perfmod.PRIO_INTERESTING,
+                          desc="coalesced EC encode ticks dispatched")
+        self.perf.add_u64("osd_batch_coalesced_ops",
+                          prio=perfmod.PRIO_INTERESTING,
+                          desc="EC writes encoded through coalesced "
+                               "ticks (ops/ticks = batch factor)")
+        self.perf.add_u64("osd_subwrite_batches",
+                          desc="multi-item sub-write frames sent "
+                               "(per peer per tick)")
+        self.perf.add_u64("osd_subwrite_batched_items",
+                          desc="shard sub-writes that rode a "
+                               "multi-item frame")
 
     def _build_admin_socket(self):
         """Register this daemon's command table (reference OSD::asok_
@@ -712,9 +772,11 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                       "completed graft-trace spans (args: trace_id | n)")
 
         def _dmclock(cmd):
-            if self._opq is None:
-                return {"enabled": False}
-            return {"enabled": True, **self._opq.dump()}
+            if self._opq is not None:
+                return {"enabled": True, **self._opq.dump()}
+            if self._shardedq is not None and self._shardedq.use_mclock:
+                return {"enabled": True, **self._shardedq.dump()}
+            return {"enabled": False}
 
         asok.register("dump_dmclock", _dmclock,
                       "dmclock conformance counters + per-client queue "

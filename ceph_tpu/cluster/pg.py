@@ -78,6 +78,15 @@ class PGState:
     # waits for that instance's replies rather than re-executing
     reqid_inflight: Dict[Tuple, asyncio.Future] = field(
         default_factory=dict)
+    # in-flight client mutations awaiting their fan-out acks (round-11
+    # pipelined writes, the RepGather in-progress-ops analog): version
+    # -> acked?  Insertion order IS version order (registered under the
+    # PG lock right after version assignment), and the commit watermark
+    # only advances over the contiguous resolved prefix — an op whose
+    # acks land out of order can never bless an earlier still-pending
+    # write (see PGLogMixin._frontier_done)
+    pipeline_pending: "OrderedDict[pglog.Eversion, bool]" = field(
+        default_factory=OrderedDict)
 
     def info(self) -> PGInfo:
         return PGInfo(last_update=self.last_update, log_tail=self.log.tail,
@@ -152,12 +161,52 @@ class PGLogMixin:
         self.store.queue_transaction(txn)
         return entry
 
+    def _frontier_open(self, st: PGState, version: pglog.Eversion) -> None:
+        """Register an in-flight client mutation (called under the PG
+        lock, immediately after version assignment, so insertion order
+        is version order): the commit watermark may not advance past a
+        PENDING entry — an out-of-order later ack blessing bytes that
+        can still fail and roll back would break read-your-ack."""
+        st.pipeline_pending[version] = False
+
+    def _frontier_done(self, st: PGState, version: pglog.Eversion,
+                       ok: bool) -> None:
+        """Resolve one in-flight mutation and advance the watermark over
+        the contiguous RESOLVED prefix.  A failed (un-acked) entry is
+        removed without blocking later acked entries — the pre-pipeline
+        semantics, where a later fully-acked op advanced past an earlier
+        failed one and peering owns the failed entry's fate."""
+        fl = st.pipeline_pending
+        if version not in fl:
+            # unregistered caller (recovery / roll-forward): direct
+            # advance, still clamped below any pending entry
+            if ok:
+                self._advance_last_complete(st, version)
+            return
+        if ok:
+            fl[version] = True
+        else:
+            del fl[version]
+        new = None
+        while fl:
+            v = next(iter(fl))
+            if not fl[v]:
+                break
+            new = v
+            del fl[v]
+        if new is not None:
+            self._advance_last_complete(st, new)
+
     def _advance_last_complete(self, st: PGState, version: pglog.Eversion,
                                txn: Optional[Transaction] = None) -> None:
         """Raise the never-roll-back watermark and prune the rollback
         journal up to it (rollback info exists only to undo UN-acked
-        entries, ecbackend.rst:10-27)."""
+        entries, ecbackend.rst:10-27).  Never past a pending pipelined
+        write: entries awaiting their fan-out acks are not durable."""
         if version <= st.last_complete:
+            return
+        if st.pipeline_pending and \
+                version >= next(iter(st.pipeline_pending)):
             return
         st.last_complete = version
         coll = _coll(st.pgid)

@@ -169,9 +169,14 @@ class MemStore(ObjectStore):
             _, coll, oid, offset, data = op
             o = self._coll(coll).setdefault(oid, Obj())
             end = offset + len(data)
-            if len(o.data) < end:
-                o.data.extend(b"\0" * (end - len(o.data)))
-            o.data[offset:end] = data
+            if offset == 0 and len(o.data) <= end:
+                # full rewrite/extend from 0 (the EC full-shard write):
+                # one copy, no zero-fill of bytes about to be replaced
+                o.data[:] = data
+            else:
+                if len(o.data) < end:
+                    o.data.extend(b"\0" * (end - len(o.data)))
+                o.data[offset:end] = data
             o.version += 1
         elif kind == "truncate":
             _, coll, oid, size = op

@@ -236,6 +236,11 @@ def _fast_config() -> Config:
         # XLA first-compiles of codec shapes can take tens of seconds on a
         # loaded CPU; client retries must outlast them
         rados_osd_op_timeout=90.0,
+        # batched data plane (round 11): vstart clusters run the sharded
+        # dispatch + per-tick stripe-batch coalescing path — the plain
+        # Config() zero-defaults remain the per-op bisection anchor
+        osd_op_shards=2,
+        osd_batch_tick_ops=16,
     )
 
 

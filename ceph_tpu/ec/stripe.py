@@ -125,6 +125,140 @@ def encode_stripes(codec, sinfo: StripeInfo, data: bytes) -> np.ndarray:
     return full.transpose(1, 0, 2).reshape(n, nstripes * unit)
 
 
+def _host_engine_ok(codec) -> bool:
+    """Should the coalesced encode use the vectorized host GF engine?
+
+    On CPU jax backends XLA's emulation of the packed GF(2) bit-matmul
+    (built for the MXU) runs ~100x below memory bandwidth, so the
+    coalesced write path computes parity with table-driven numpy GF
+    arithmetic instead — bit-exact by construction (same field, same
+    coding matrix; the cross-engine equality is a tier-1 test).  Device
+    backends keep the planar fused dispatch (BENCH_NOTES round 11)."""
+    import jax
+
+    if jax.default_backend() != "cpu":
+        return False
+    eng = getattr(codec, "engine", None)
+    return eng is not None and getattr(eng, "w", 0) == 8 and \
+        getattr(eng, "coding", None) is not None
+
+
+def _encode_parity_host(coding: np.ndarray, batch: np.ndarray) -> np.ndarray:
+    """(B, k, S) -> (B, m, S) parity via table-driven GF(2^8) numpy:
+    coefficient-1 terms are pure XOR (the whole of RS m=1), others one
+    256-entry LUT gather per term."""
+    from ceph_tpu.ops.gf8 import GF_MUL
+    from ceph_tpu.utils.perf import KERNELS
+
+    m, k = coding.shape
+    b, _k, s = batch.shape
+    KERNELS.inc("ec_host_matmul_calls")
+    KERNELS.inc("ec_host_matmul_bytes", b * k * s)
+    out = np.empty((b, m, s), dtype=np.uint8)
+    for j in range(m):
+        acc = None
+        for i in range(k):
+            c = int(coding[j, i])
+            if c == 0:
+                continue
+            term = batch[:, i, :] if c == 1 else GF_MUL[c][batch[:, i, :]]
+            if acc is None:
+                acc = term.copy() if c == 1 else term
+            else:
+                np.bitwise_xor(acc, term, out=acc)
+        out[:, j, :] = acc if acc is not None else 0
+    return out
+
+
+def encode_stripes_multi(codec, sinfo: StripeInfo, datas,
+                         want_crcs=None):
+    """Coalesced encode: N ops' stripe ranges in ONE device round trip.
+
+    The tick-level batch of the round-11 data plane: every op's stripe
+    batch concatenates along the batch axis, the combined batch pays one
+    planar conversion + one fused encode dispatch, and shard rows of
+    full-shard writes checksum in one crc32c batch.  Bit-exact with
+    per-op ``encode_stripes`` by construction — the code is stripe-local
+    (parity of stripe j never depends on other batch rows), so batch
+    composition cannot change any op's shards.
+
+    Returns ``[(shards, crcs), ...]`` aligned with ``datas``: ``shards``
+    is the per-op (k+m, nstripes*unit) uint8 matrix ``encode_stripes``
+    would return; ``crcs`` is the per-shard-row ``ceph_crc32c(~0, row)``
+    list for ops whose ``want_crcs`` flag is set (full-shard rewrites),
+    else None.
+    """
+    from ceph_tpu.ops.crc32c import crc32c_rows
+    from ceph_tpu.utils.perf import KERNELS
+
+    k = sinfo.k
+    unit = sinfo.chunk_size
+    n = codec.get_chunk_count()
+    if want_crcs is None:
+        want_crcs = [False] * len(datas)
+    counts = [sinfo.object_stripes(len(d)) for d in datas]
+    total = sum(counts)
+    out = [None] * len(datas)
+    if total == 0:
+        for i in range(len(datas)):
+            shards = np.zeros((n, 0), dtype=np.uint8)
+            out[i] = (shards,
+                      crc32c_rows(shards) if want_crcs[i] else None)
+        return out
+    KERNELS.inc("ec_coalesced_ticks")
+    KERNELS.inc("ec_coalesced_ops", len(datas))
+    batch = np.zeros((total, k, unit), dtype=np.uint8)
+    pad = 0
+    ofs = 0
+    for d, ns in zip(datas, counts):
+        if ns == 0:
+            continue
+        flat = batch[ofs:ofs + ns].reshape(ns * k * unit)
+        flat[: len(d)] = np.frombuffer(d, dtype=np.uint8)
+        pad += ns * sinfo.stripe_width - len(d)
+        ofs += ns
+    if _host_engine_ok(codec):
+        # CPU backend: no layout conversion, no bucket padding — the
+        # host GF engine is shape-agnostic and bandwidth-bound
+        KERNELS.inc("ec_stripe_pad_bytes", pad)
+        parity = _encode_parity_host(codec.engine.coding, batch)
+    else:
+        bb = _bucket(total)
+        if bb != total:
+            batch = np.concatenate(
+                [batch, np.zeros((bb - total, k, unit), dtype=np.uint8)])
+        KERNELS.inc("ec_stripe_pad_bytes",
+                    pad + (bb - total) * k * unit)
+        if _planar_ok(codec, unit):
+            pb = codec.to_planar(batch)
+            parity = np.asarray(
+                codec.encode_planar(pb).to_batch())[:total]
+        else:
+            parity = np.asarray(codec.encode_batch(batch))[:total]
+    # split parity back per op and assemble each op's shard rows
+    crc_rows = []           # (out-index, shard row matrix) for one batch
+    ofs = 0
+    for i, ns in enumerate(counts):
+        full = np.concatenate(
+            [batch[ofs:ofs + ns], parity[ofs:ofs + ns]], axis=1)
+        shards = full.transpose(1, 0, 2).reshape(n, ns * unit)
+        ofs += ns
+        out[i] = (shards, None)
+        if want_crcs[i]:
+            crc_rows.append((i, shards))
+    # one crc32c batch per shard length group (a tick's ops usually
+    # share object size; mixed sizes split into one dispatch per size)
+    by_len = {}
+    for i, shards in crc_rows:
+        by_len.setdefault(shards.shape[1], []).append((i, shards))
+    for _length, group in by_len.items():
+        stacked = np.concatenate([s for _i, s in group], axis=0)
+        crcs = crc32c_rows(stacked)
+        for gi, (i, shards) in enumerate(group):
+            out[i] = (out[i][0], crcs[gi * n:(gi + 1) * n])
+    return out
+
+
 def decode_stripes(
     codec,
     sinfo: StripeInfo,

@@ -51,6 +51,18 @@ EVENT_STAGE = {
     "objecter:throttle_wait": "throttle_wait",
     "shed_expired": "shed",
     "ec_hedge_sent": "hedge",
+    # batched data plane (round 11): an EC write parks at the encode
+    # coalescer until its dispatch tick (batch_wait = queued-for-tick +
+    # the other ops' share of the coalesced encode) and then books its
+    # AMORTIZED share of the tick's device dispatch (batch_encode) —
+    # so wall_coverage holds with sharded dispatch + coalescing on
+    "batch_parked": "op_prepare",
+    "batch_tick": "batch_wait",
+    "batch_encoded": "batch_encode",
+    # reply-leg tail (round 11): the delta from the reply's client-side
+    # recv stamp to the caller actually resuming — event-loop wakeup,
+    # previously the untraced slice of wall_coverage
+    "objecter:complete": "client_wakeup",
 }
 
 
@@ -69,6 +81,12 @@ def stage_for(event: str) -> str:
         return "throttle_wait"
     if event.startswith("msgr:"):
         return "wire" if event.endswith(":recv") else "messenger_send"
+    if event.startswith("shard:"):
+        # sharded dispatch stamps (shard:<idx>:queued / :tick): the
+        # delta reaching the tick stamp is time parked in the shard
+        # queue awaiting its dispatch tick
+        return "batch_wait" if event.endswith(":tick") \
+            else "dispatch_queue"
     return f"other:{event}"
 
 

@@ -77,6 +77,23 @@ OPTIONS: List[Option] = [
            "background scrub period per primary PG (0 disables)"),
     Option("osd_op_queue", str, "fifo",
            "client op scheduling: fifo | mclock (dmClock QoS)"),
+    # sharded dispatch + per-tick stripe-batch coalescing (round 11):
+    # the ShardedOpWQ analog.  Zero defaults preserve the round-10
+    # per-op dispatch/encode path exactly — the bisection anchor; vstart
+    # _fast_config (tests + bench) turns both on.
+    Option("osd_op_shards", int, 0,
+           "client-op dispatch shards (PG-affine hashing; each shard "
+           "drains on a bounded dispatch tick and owns its own "
+           "mclock/FIFO queue + shedding).  0 = the per-(conn,PG) "
+           "FIFO / global-mclock legacy path", min=0),
+    Option("osd_batch_tick_ops", int, 0,
+           "max EC stripe-batch encodes coalesced into ONE device "
+           "dispatch per tick (one to_planar, one fused encode, one "
+           "crc32c batch).  0 = per-op encode (legacy)", min=0),
+    Option("osd_batch_tick_window", float, 0.0,
+           "extra accumulation window (s) after a tick's first encode "
+           "request; 0 = pure group-commit self-clocking (a lone op "
+           "never waits)", min=0),
     Option("osd_op_complaint_time", float, 30.0,
            "ops blocked this long raise 'slow ops' warnings "
            "(reference osd_op_complaint_time; 0 disables)", min=0),

@@ -1,0 +1,24 @@
+"""per-op-device-dispatch BAD corpus: device entry points reachable
+per-op inside cluster/ async handlers (linted as if under
+ceph_tpu/cluster/)."""
+
+from ceph_tpu.ec import stripe as stripemod
+
+
+class BadBackend:
+    async def direct_planar_call(self, codec, batch):
+        # direct device dispatch inside an async handler: every op pays
+        # its own host/device round trip
+        pb = codec.to_planar(batch)
+        return codec.encode_planar(pb)
+
+    async def executor_hop(self, codec, sinfo, data):
+        # the dominant idiom: the device callable handed to an executor
+        # wrapper — the hop does not change who pays the dispatch
+        return await self._compute(
+            stripemod.encode_stripes, codec, sinfo, data)
+
+    async def per_op_crc(self, rows):
+        from ceph_tpu.ops.crc32c import crc32c_batch
+
+        return crc32c_batch(rows)

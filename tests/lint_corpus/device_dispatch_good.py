@@ -1,0 +1,25 @@
+"""per-op-device-dispatch GOOD corpus: cluster/ async handlers that keep
+device work behind the coalescer seam (linted as if under
+ceph_tpu/cluster/)."""
+
+import asyncio
+
+
+class GoodBackend:
+    async def _ec_write(self, codec, sinfo, data):
+        # the sanctioned shape: the op submits its stripe range to the
+        # tick coalescer; the batcher owns the device dispatch
+        shards, crcs, tick = await self._ec_batcher.encode(
+            codec, sinfo, data, True)
+        return shards
+
+    async def _plain_host_work(self, payload):
+        # ordinary host calls (store, messenger) are not device entry
+        # points and never match
+        await asyncio.sleep(0)
+        return payload[:10]
+
+    def _sync_helper(self, codec, batch):
+        # sync (non-handler) code is out of scope for this rule: the
+        # per-op contract is about async dispatch paths
+        return codec.encode_batch(batch)

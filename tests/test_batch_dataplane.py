@@ -1,0 +1,289 @@
+"""Round-11 batched data plane: bit-exactness + unit coverage.
+
+The coalesced tick must be invisible in the bytes: N concurrent writes
+through sharded dispatch + per-tick stripe-batch coalescing produce
+byte-identical shards (and stored CRCs) to the same writes issued
+serially through the round-10 per-op path — including mixed-profile
+ticks and the 1-op-tick degenerate case.  Unit level, the multi-op
+encode and the batched row CRC must match their per-op/host
+equivalents exactly.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from tests._flaky import contention_retry
+
+from ceph_tpu.cluster.vstart import _fast_config, start_cluster
+from ceph_tpu.ec import factory
+from ceph_tpu.ec.stripe import (
+    StripeInfo,
+    encode_stripes,
+    encode_stripes_multi,
+)
+from ceph_tpu.ops import crc32c as crcmod
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _coll(pgid):
+    return f"pg_{pgid.pool}_{pgid.seed}"
+
+
+# ------------------------------------------------------------- unit level
+
+
+def test_encode_stripes_multi_bit_exact_and_crcs():
+    """One coalesced dispatch == N per-op dispatches, byte for byte;
+    batch CRCs == the host ceph_crc32c each shard row would get."""
+    codec = factory({"plugin": "jerasure", "technique": "reed_sol_van",
+                     "k": "2", "m": "1"})
+    sinfo = StripeInfo(2, 4096)
+    rng = np.random.default_rng(11)
+    datas = [rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+             for n in (8192, 40960, 1, 8192, 0, 12345)]
+    multi = encode_stripes_multi(codec, sinfo, datas,
+                                 want_crcs=[True] * len(datas))
+    for data, (shards, crcs) in zip(datas, multi):
+        solo = encode_stripes(codec, sinfo, data)
+        assert shards.shape == solo.shape
+        assert np.array_equal(shards, solo)
+        assert crcs is not None and len(crcs) == shards.shape[0]
+        for row, crc in zip(shards, crcs):
+            assert crc == crcmod.crc32c(0xFFFFFFFF, row.tobytes())
+
+
+def test_encode_stripes_multi_single_op_degenerate():
+    """The 1-op tick: no coalescing partner, still bit-exact."""
+    codec = factory({"plugin": "jerasure", "technique": "reed_sol_van",
+                     "k": "2", "m": "1"})
+    sinfo = StripeInfo(2, 4096)
+    data = bytes(range(256)) * 64
+    [(shards, crcs)] = encode_stripes_multi(codec, sinfo, [data], [True])
+    assert np.array_equal(shards, encode_stripes(codec, sinfo, data))
+    assert crcs == [crcmod.crc32c(0xFFFFFFFF, r.tobytes())
+                    for r in shards]
+
+
+def test_crc32c_rows_matches_host():
+    rng = np.random.default_rng(7)
+    # block-aligned rows: the device batch + vectorized fold path
+    rows = rng.integers(0, 256, (5, 3 * 4096), dtype=np.uint8)
+    got = crcmod.crc32c_rows(rows)
+    assert got == [crcmod.crc32c(0xFFFFFFFF, r.tobytes()) for r in rows]
+    # non-multiple length: the per-row host fallback
+    odd = rng.integers(0, 256, (3, 1000), dtype=np.uint8)
+    assert crcmod.crc32c_rows(odd) == \
+        [crcmod.crc32c(0xFFFFFFFF, r.tobytes()) for r in odd]
+    # empty rows
+    assert crcmod.crc32c_rows(np.zeros((2, 0), dtype=np.uint8)) == \
+        [0xFFFFFFFF, 0xFFFFFFFF]
+
+
+def test_batch_attribution_amortized_stage_math():
+    """The coalescer's amortized marks: batch_wait + batch_encode
+    partition the parked->encoded window, batch_encode gets exactly
+    the tick's wall / batch size, and the stage sums stay equal to the
+    traced total (the attribution invariant)."""
+    from ceph_tpu.trace.attribution import attribute_events
+
+    # an op parked at t=1.0; tick ran 2.0 -> 5.0 with 3 ops coalesced
+    share = (5.0 - 2.0) / 3
+    evs = [(0.0, "initiated"), (0.5, "dispatched"),
+           (1.0, "batch_parked"),
+           (5.0 - share, "batch_tick"), (5.0, "batch_encoded"),
+           (5.2, "done")]
+    stages, total = attribute_events(evs)
+    assert abs(sum(stages.values()) - total) < 1e-9
+    assert abs(stages["batch_encode"] - share) < 1e-9
+    assert abs(stages["batch_wait"] - (4.0 - share)) < 1e-9
+    assert stages["op_prepare"] == pytest.approx(0.5)
+
+
+def test_commit_frontier_blocks_out_of_order_acks():
+    """The pipelined-write watermark invariant: a later write's acks
+    arriving first must NOT advance last_complete past an earlier
+    still-pending write; a FAILED earlier write unblocks the later one
+    (the pre-pipeline skip semantics)."""
+    from ceph_tpu.cluster.pg import PGState
+    from ceph_tpu.osdmap.osdmap import PGid
+
+    from ceph_tpu.cluster.pg import PGLogMixin
+
+    class _Store:
+        def omap_get(self, coll, oid):
+            return {}
+
+        def queue_transaction(self, txn):
+            pass
+
+    class _Host(PGLogMixin):
+        def __init__(self):
+            self.store = _Store()
+
+    h = _Host()
+    st = PGState(PGid(1, 0))
+    zero = st.last_complete
+    v5, v6, v7 = (1, 5), (1, 6), (1, 7)
+    for v in (v5, v6, v7):
+        h._frontier_open(st, v)
+    # v6 acks first: watermark must NOT move (v5 still pending)
+    h._frontier_done(st, v6, ok=True)
+    assert st.last_complete == zero
+    # direct advances (recovery-style) are clamped below pending too
+    h._advance_last_complete(st, v7)
+    assert st.last_complete == zero
+    # v5 fails: removed without blessing, v6's ack now advances to 6
+    h._frontier_done(st, v5, ok=False)
+    assert st.last_complete == v6
+    # v7 acks: contiguous prefix advances to 7
+    h._frontier_done(st, v7, ok=True)
+    assert st.last_complete == v7
+
+
+def test_fast_config_enables_batched_data_plane():
+    """The vstart config (tests, bench, chaos scenarios incl. the
+    tier-1 overload-smoke run) exercises sharded dispatch + coalescing;
+    plain Config() keeps the zero-default per-op path for bisection."""
+    from ceph_tpu.utils import Config
+
+    cfg = _fast_config()
+    assert cfg.osd_op_shards > 0 and cfg.osd_batch_tick_ops > 0
+    plain = Config()
+    assert plain.osd_op_shards == 0 and plain.osd_batch_tick_ops == 0
+
+
+# ---------------------------------------------------------- cluster level
+
+
+async def _write_workload(cluster, concurrent: bool):
+    """The shared workload: full writes across two EC profiles (a
+    mixed-profile tick when concurrent) + an RMW partial write + a
+    1-op-tick straggler.  Returns {pool_name: (pool_id, [oids])}."""
+    client = await cluster.client()
+    pool_a = await client.pool_create(
+        "bxa", "erasure", pg_num=4,
+        ec_profile={"plugin": "jerasure", "technique": "reed_sol_van",
+                    "k": "2", "m": "1"})
+    pool_b = await client.pool_create(
+        "bxb", "erasure", pg_num=4,
+        ec_profile={"plugin": "jerasure", "technique": "reed_sol_van",
+                    "k": "3", "m": "2"})
+    io_a = client.ioctx(pool_a)
+    io_b = client.ioctx(pool_b)
+    rng = np.random.default_rng(42)
+    jobs = []
+    oids_a, oids_b = [], []
+    for i in range(6):
+        oid = f"obj_a{i}"
+        oids_a.append(oid)
+        payload = rng.integers(0, 256, 65536 + i * 4096,
+                               dtype=np.uint8).tobytes()
+        jobs.append((io_a, oid, payload))
+    for i in range(4):
+        oid = f"obj_b{i}"
+        oids_b.append(oid)
+        payload = rng.integers(0, 256, 49152, dtype=np.uint8).tobytes()
+        jobs.append((io_b, oid, payload))
+    if concurrent:
+        await asyncio.gather(*(io.write_full(oid, payload, timeout=120)
+                               for io, oid, payload in jobs))
+    else:
+        for io, oid, payload in jobs:
+            await io.write_full(oid, payload, timeout=120)
+    # RMW partial overwrite crossing a stripe boundary (no batch crc)
+    patch = rng.integers(0, 256, 10000, dtype=np.uint8).tobytes()
+    await io_a.write("obj_a0", patch, offset=5000, timeout=120)
+    # 1-op tick: a lone write with nothing to coalesce against
+    await io_a.write_full("obj_a_solo", b"\xa5" * 20480, timeout=120)
+    oids_a.append("obj_a_solo")
+    return client, {"bxa": (pool_a, oids_a), "bxb": (pool_b, oids_b)}
+
+
+def _shard_snapshot(cluster, client, pools):
+    """Every member's stored shard state per object: (bytes, shard,
+    size, hinfo_crc) — the on-disk truth the two paths must agree on."""
+    out = {}
+    for pname, (pool, oids) in pools.items():
+        for oid in oids:
+            pgid = client.objecter.object_pgid(pool, oid)
+            coll = _coll(pgid)
+            for osd_id, osd in cluster.osds.items():
+                if osd.store.stat(coll, oid) is None:
+                    continue
+                out[(pname, oid, osd_id)] = (
+                    bytes(osd.store.read(coll, oid)),
+                    osd.store.getattr(coll, oid, "shard"),
+                    osd.store.getattr(coll, oid, "size"),
+                    osd.store.getattr(coll, oid, "hinfo_crc"),
+                )
+    return out
+
+
+@contention_retry()
+def test_coalesced_writes_bit_exact_vs_per_op_path():
+    """THE round-11 acceptance invariant: concurrent writes through
+    sharded dispatch + coalescing leave every OSD's stored shards and
+    CRCs byte-identical to the same writes issued serially through the
+    legacy per-op path (mixed-profile ticks + RMW + 1-op tick
+    included)."""
+    async def run_path(coalesced: bool):
+        cfg = _fast_config()
+        if not coalesced:
+            cfg.osd_op_shards = 0
+            cfg.osd_batch_tick_ops = 0
+        cluster = await start_cluster(5, config=cfg)
+        try:
+            client, pools = await _write_workload(
+                cluster, concurrent=coalesced)
+            snap = _shard_snapshot(cluster, client, pools)
+            if coalesced:
+                # every full write really rode the coalescer
+                ticks = sum(o.perf.get("osd_batch_ticks")
+                            for o in cluster.osds.values())
+                coalesced_ops = sum(
+                    o.perf.get("osd_batch_coalesced_ops")
+                    for o in cluster.osds.values())
+                assert ticks > 0 and coalesced_ops >= 12
+            return snap
+        finally:
+            await cluster.stop()
+
+    batched = run(run_path(True))
+    serial = run(run_path(False))
+    assert set(batched) == set(serial)
+    for key in sorted(serial):
+        assert batched[key] == serial[key], key
+
+
+@contention_retry()
+def test_coalesced_concurrent_appends_apply_exactly_once():
+    """Same-object concurrency under sharded dispatch: every append
+    lands exactly once and the object stays readable (per-object
+    ordering lives inside one shard by PG affinity)."""
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create(
+                "bxo", "erasure", pg_num=4,
+                ec_profile={"plugin": "jerasure",
+                            "technique": "reed_sol_van",
+                            "k": "2", "m": "1"})
+            io = client.ioctx(pool)
+            await io.write_full("log", b"", timeout=120)
+            pieces = [bytes([65 + i]) * 512 for i in range(8)]
+            await asyncio.gather(
+                *(io.append("log", p) for p in pieces))
+            data = await io.read("log", timeout=120)
+            assert len(data) == sum(len(p) for p in pieces)
+            for p in pieces:
+                assert data.count(p[:1]) == len(p)
+        finally:
+            await cluster.stop()
+
+    run(scenario())

@@ -270,6 +270,45 @@ def test_rpc_timeout_scoped_to_cluster():
     assert findings == []
 
 
+def test_device_dispatch_good_clean():
+    from ceph_tpu.analysis import device_dispatch
+
+    findings, _ = lint_files(
+        device_dispatch, "device_dispatch_good.py",
+        relpath_as="ceph_tpu/cluster/device_dispatch_good.py")
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_device_dispatch_bad_fires():
+    from ceph_tpu.analysis import device_dispatch
+
+    findings, _ = lint_files(
+        device_dispatch, "device_dispatch_bad.py",
+        relpath_as="ceph_tpu/cluster/device_dispatch_bad.py")
+    # direct planar calls (2), the executor-hop callable, and the
+    # per-op batched crc all fire
+    assert len(findings) == 4, [f.render() for f in findings]
+    assert all(f.rule == "per-op-device-dispatch" for f in findings)
+    msgs = "\n".join(f.message for f in findings)
+    assert "to_planar()" in msgs and "encode_planar()" in msgs
+    assert "encode_stripes handed to self._compute()" in msgs
+    assert "crc32c_batch()" in msgs
+    assert "batch coalescer" in msgs
+
+
+def test_device_dispatch_scoped_and_coalescer_exempt():
+    from ceph_tpu.analysis import device_dispatch
+
+    # outside ceph_tpu/cluster/: quiet
+    findings, _ = lint_files(device_dispatch, "device_dispatch_bad.py")
+    assert findings == []
+    # the coalescer module itself is the sanctioned seam: quiet
+    findings, _ = lint_files(
+        device_dispatch, "device_dispatch_bad.py",
+        relpath_as="ceph_tpu/cluster/batcher.py")
+    assert findings == []
+
+
 # ------------------------------------------------------- runtime wiring
 
 
