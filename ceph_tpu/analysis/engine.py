@@ -192,11 +192,12 @@ class LintContext:
 
 def all_rules():
     """The registered rule families, import-cycle-free."""
-    from ceph_tpu.analysis import asyncio_rules, device_dispatch, \
-        jax_hygiene, lockgraph, rpc_timeout, symmetry, taskspawn
+    from ceph_tpu.analysis import async_errors, asyncio_rules, \
+        device_dispatch, jax_hygiene, lockgraph, rpc_timeout, \
+        symmetry, taskspawn
 
     return [lockgraph, jax_hygiene, symmetry, asyncio_rules, taskspawn,
-            rpc_timeout, device_dispatch]
+            rpc_timeout, device_dispatch, async_errors]
 
 
 # cached last report (admin socket `graftlint report` serves this)

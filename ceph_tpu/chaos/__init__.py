@@ -11,6 +11,18 @@ random decision derives from per-injector streams of one seed
 ``is None`` test (``chaos report`` / ``chaos_total()`` prove it).
 """
 
+import asyncio as _asyncio
+
+
+class ChaosCrash(_asyncio.CancelledError):
+    """Raised by an armed crash point (OSD._chaos_point): unwinds the
+    current coroutine exactly like a task dying mid-await — the closest
+    in-process model of 'the process ceased at this instant'.  A
+    CancelledError subclass so every ``except asyncio.CancelledError:
+    raise`` hygiene path propagates it and the dying tasks never warn
+    about unretrieved exceptions."""
+
+
 from ceph_tpu.chaos.clock import ChaosClock  # noqa: F401
 from ceph_tpu.chaos.counters import (  # noqa: F401
     CHAOS,

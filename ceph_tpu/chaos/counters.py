@@ -34,6 +34,12 @@ for _name, _desc in (
     ("daemon_revives", "daemons revived by the daemon injector"),
     ("daemon_restarts", "daemons bounced keeping their store"),
     ("clock_skews", "clock-skew changes applied to a daemon time source"),
+    ("net_batch_item_drops",
+     "sub-write items dropped INSIDE a delivered batch frame"),
+    ("net_batch_ack_dups", "batched-ack result entries duplicated"),
+    ("net_batch_ack_reorders", "batched-ack result lists shuffled"),
+    ("crash_points_fired",
+     "daemons power-cut at an armed tick/commit crash seam"),
 ):
     CHAOS.add_u64(_name, desc=_desc)
 

@@ -23,6 +23,26 @@ from ceph_tpu.chaos.net import ensure_injector
 class DaemonInjector:
     def __init__(self, cluster):
         self.cluster = cluster
+        # frontier monotonicity marks (round 12): (osd_id, pgid) ->
+        # the PERSISTED last_complete right before a store-preserving
+        # bounce.  The frontier invariant asserts the revived daemon's
+        # watermark never regressed below it — a reloaded watermark
+        # ahead of (or behind) what the store actually holds is exactly
+        # the crash bug class the reconstruction prevents.  Not
+        # recorded for torn/lost-tail crashes (tail loss is the
+        # injected fault) and dropped when a daemon revives empty.
+        self.frontier_marks: Dict[Tuple[int, object], tuple] = {}
+
+    def _mark_frontier(self, osd_id: int) -> None:
+        osd = self.cluster.osds.get(osd_id)
+        if osd is None:
+            return
+        for pgid in list(osd.pgs):
+            try:
+                self.frontier_marks[(osd_id, pgid)] = \
+                    osd._load_last_complete(pgid)
+            except Exception:
+                pass
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -32,6 +52,8 @@ class DaemonInjector:
 
     async def crash_osd(self, osd_id: int, torn_tail: bool = False,
                         lose_frames: int = 0) -> None:
+        if not torn_tail and not lose_frames:
+            self._mark_frontier(osd_id)
         await self.cluster.crash_osd(osd_id, torn_tail=torn_tail,
                                      lose_frames=lose_frames)
         CHAOS.inc("daemon_kills")
@@ -39,10 +61,16 @@ class DaemonInjector:
 
     async def revive_osd(self, osd_id: int,
                          with_store: bool = False) -> None:
+        if not with_store:
+            # booting empty: the recorded watermark no longer binds
+            for key in [k for k in self.frontier_marks
+                        if k[0] == osd_id]:
+                del self.frontier_marks[key]
         await self.cluster.revive_osd(osd_id, with_store=with_store)
         CHAOS.inc("daemon_revives")
 
     async def restart_osd(self, osd_id: int) -> None:
+        self._mark_frontier(osd_id)
         await self.cluster.restart_osd(osd_id)
         CHAOS.inc("daemon_restarts")
 
@@ -127,6 +155,10 @@ def zero_rates(cluster) -> None:
         "chaos_net_delay": 0.0, "chaos_net_delay_prob": 0.0,
         "chaos_net_reorder": 0.0, "chaos_net_reset": 0.0,
         "chaos_net_partition": "",
+        "chaos_net_batch_item_drop": 0.0,
+        "chaos_net_batch_ack_dup": 0.0,
+        "chaos_net_batch_ack_reorder": 0.0,
+        "chaos_crash_point": "", "chaos_crash_point_skip": 0,
         "chaos_disk_read_err": 0.0, "chaos_disk_enospc": 0.0,
         "chaos_disk_bitrot": 0.0, "chaos_clock_skew": 0.0,
     }

@@ -34,8 +34,14 @@ Addr = Tuple[str, int]
 CONFIG_FIELDS = (
     "chaos_net_drop", "chaos_net_dup", "chaos_net_delay",
     "chaos_net_delay_prob", "chaos_net_reorder", "chaos_net_reset",
-    "chaos_net_partition",
+    "chaos_net_partition", "chaos_net_batch_item_drop",
+    "chaos_net_batch_ack_dup", "chaos_net_batch_ack_reorder",
 )
+
+# message type names the batch mutator understands (duck-typed so the
+# chaos layer never imports cluster wire classes)
+_BATCH_FRAME = "MOSDECSubOpWriteBatch"
+_BATCH_REPLY = "MOSDECSubOpWriteBatchReply"
 
 
 @dataclass
@@ -67,7 +73,10 @@ class NetInjector:
     def __init__(self, rng, drop: float = 0.0, dup: float = 0.0,
                  delay: float = 0.0, delay_prob: float = 0.0,
                  reorder: float = 0.0, reset: float = 0.0,
-                 partitions: Optional[Set[Addr]] = None):
+                 partitions: Optional[Set[Addr]] = None,
+                 batch_item_drop: float = 0.0,
+                 batch_ack_dup: float = 0.0,
+                 batch_ack_reorder: float = 0.0):
         self.rng = rng
         self.drop = drop
         self.dup = dup
@@ -76,6 +85,11 @@ class NetInjector:
         self.reorder = reorder
         self.reset = reset
         self.partitions: Set[Addr] = set(partitions or ())
+        # batch-frame faults (round 12): per-item loss INSIDE a
+        # coalesced tick frame, duplicated/shuffled batched acks
+        self.batch_item_drop = batch_item_drop
+        self.batch_ack_dup = batch_ack_dup
+        self.batch_ack_reorder = batch_ack_reorder
 
     @classmethod
     def from_config(cls, config, name: str,
@@ -92,7 +106,10 @@ class NetInjector:
             parts |= keep_partitions
         rates = (config.chaos_net_drop, config.chaos_net_dup,
                  config.chaos_net_delay_prob, config.chaos_net_reorder,
-                 config.chaos_net_reset)
+                 config.chaos_net_reset,
+                 config.chaos_net_batch_item_drop,
+                 config.chaos_net_batch_ack_dup,
+                 config.chaos_net_batch_ack_reorder)
         if not any(rates) and not parts:
             return None
         return cls(stream(config.chaos_seed, f"net:{name}"),
@@ -100,7 +117,10 @@ class NetInjector:
                    delay=config.chaos_net_delay,
                    delay_prob=config.chaos_net_delay_prob,
                    reorder=config.chaos_net_reorder,
-                   reset=config.chaos_net_reset, partitions=parts)
+                   reset=config.chaos_net_reset, partitions=parts,
+                   batch_item_drop=config.chaos_net_batch_item_drop,
+                   batch_ack_dup=config.chaos_net_batch_ack_dup,
+                   batch_ack_reorder=config.chaos_net_batch_ack_reorder)
 
     # -- partition management (scenario runner API) -------------------------
 
@@ -158,6 +178,59 @@ class NetInjector:
             fate.reset = True
             CHAOS.inc("net_resets")
         return fate
+
+    def mutate_batch(self, msg) -> None:
+        """Per-item batch-frame faults (round 12), applied IN PLACE just
+        before the frame is pickled for the wire — so session replay
+        re-delivers the same mutated frame (the item loss is real, like
+        a torn frame the transport reassembled short):
+
+        - ``batch_item_drop``: each sub-write item inside a multi-item
+          MOSDECSubOpWriteBatch is independently dropped while the rest
+          of the frame delivers — a PARTIAL tick on the wire.  At least
+          one item always survives (whole-frame loss is chaos_net_drop's
+          job, with retransmission semantics).
+        - ``batch_ack_dup``: entries of a batched ack are duplicated —
+          the per-responder ack dedup must absorb them or a duplicate
+          would stand in for a shard that never committed.
+        - ``batch_ack_reorder``: the batched ack's result order is
+          shuffled — ack handling must be order-independent.
+
+        Each family consumes its own rng draws only when enabled, so
+        toggling one never shifts another's stream."""
+        from ceph_tpu.chaos.counters import CHAOS
+
+        name = type(msg).__name__
+        rng = self.rng
+        if name == _BATCH_FRAME and self.batch_item_drop and \
+                len(msg.items) > 1:
+            kept = [it for it in msg.items
+                    if rng.random() >= self.batch_item_drop]
+            if not kept:
+                kept = [msg.items[rng.randrange(len(msg.items))]]
+            dropped = len(msg.items) - len(kept)
+            if dropped:
+                CHAOS.inc("net_batch_item_drops", dropped)
+                msg.items = kept
+        elif name == _BATCH_REPLY and msg.results:
+            if self.batch_ack_dup:
+                out = []
+                dups = 0
+                for entry in msg.results:
+                    out.append(entry)
+                    if rng.random() < self.batch_ack_dup:
+                        out.append(entry)
+                        dups += 1
+                if dups:
+                    CHAOS.inc("net_batch_ack_dups", dups)
+                    msg.results = out
+            if self.batch_ack_reorder and \
+                    rng.random() < self.batch_ack_reorder and \
+                    len(msg.results) > 1:
+                shuffled = list(msg.results)
+                rng.shuffle(shuffled)
+                CHAOS.inc("net_batch_ack_reorders")
+                msg.results = shuffled
 
 
 def ensure_injector(messenger) -> NetInjector:

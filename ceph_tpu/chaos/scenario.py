@@ -162,7 +162,23 @@ def build_schedule(scenario: Scenario, seed: int) -> List[Dict]:
                        "after_writes": e.after_writes,
                        "args": dict(e.args)}
         target = e.target
-        if e.action in ("kill_osd", "crash_osd", "restart_osd"):
+        if e.action == "crash_point":
+            # arm a named tick/commit crash seam on one daemon: it
+            # power-cuts itself when its write path next passes the
+            # point.  Planned as a probable kill (floor bookkeeping);
+            # the skip count resolves from the seeded stream so WHICH
+            # traversal dies replays bit-identically.
+            if target == "random_osd":
+                pool = sorted(alive)
+                if len(pool) <= scenario.pool_size:
+                    continue
+                target = f"osd.{rng.choice(pool)}"
+            if entry["args"].get("at") is None:
+                entry["args"]["at"] = rng.randrange(0, 3)
+            osd_id = int(target.split(".")[1])
+            alive.discard(osd_id)
+            dead.append(osd_id)
+        elif e.action in ("kill_osd", "crash_osd", "restart_osd"):
             if target == "random_osd":
                 floor = scenario.pool_size if e.action != "restart_osd" \
                     else 1
@@ -364,6 +380,9 @@ async def run_scenario(scenario: Scenario, seed: int,
                 snaps[sid] = dict(acked)
 
         # -- heal: scenarios must converge fault-free -------------------
+        # crash-point teardowns still in flight must finish first, or
+        # the revive sweep below races a daemon mid-power-cut
+        await cluster.drain_chaos()
         zero_rates(cluster)
         for osd_id in sorted(set(cluster.osd_configs) -
                              set(cluster.osds)):
@@ -399,6 +418,12 @@ async def run_scenario(scenario: Scenario, seed: int,
                 failures += deadline_misses
             elif name == "shed":
                 failures += inv.check_shed(cluster)
+            elif name == "frontier":
+                failures += await inv.check_frontier(
+                    cluster, marks=dmn.frontier_marks,
+                    timeout=scenario.converge_timeout)
+            elif name == "batch":
+                failures += inv.check_batch(cluster)
             else:
                 failures.append(f"unknown invariant {name!r}")
     finally:
@@ -435,6 +460,11 @@ async def _apply_event(cluster, dmn: DaemonInjector, client, io,
         osd_id = int(target.split(".")[1])
         if osd_id in cluster.osds:
             await dmn.restart_osd(osd_id)
+    elif action == "crash_point":
+        for cfg in _target_configs(cluster, target):
+            cfg.injectargs({
+                "chaos_crash_point": args["point"],
+                "chaos_crash_point_skip": int(args.get("at", 0))})
     elif action in ("net", "disk"):
         for cfg in _target_configs(cluster, target):
             cfg.injectargs({k: v for k, v in args.items()
@@ -618,6 +648,73 @@ def builtin_scenarios() -> Dict[str, Scenario]:
             invariants=("durability", "deadline", "shed", "acting",
                         "health", "lockdep"),
             converge_timeout=45.0),
+        # tier-1 batch-chaos smoke (round 12): seeded per-item frame
+        # drops + duplicated/shuffled batched acks on every daemon,
+        # plus one tick-boundary crash point, under concurrent EC
+        # writes on a durable store.  Verdict: durability + the new
+        # frontier invariant (no open entry survives convergence, the
+        # persisted watermark matches memory and never regressed) +
+        # batch (the coalesced plane actually ran).
+        "batch-smoke": Scenario(
+            name="batch-smoke", osds=4, pool_kind="erasure",
+            pool_size=3, pg_num=8, store="file",
+            ec_profile=(("plugin", "jerasure"),
+                        ("technique", "reed_sol_van"),
+                        ("k", "2"), ("m", "1")),
+            rounds=2, objects_per_round=12, payload_repeat=30,
+            durability_mode="attempted", burst_concurrency=12,
+            events=(
+                ev(0, "net", target="all_osds",
+                   chaos_net_batch_item_drop=0.15,
+                   chaos_net_batch_ack_dup=0.2,
+                   chaos_net_batch_ack_reorder=0.2),
+                ev(0, "crash_point", point="commit_mid_fanout"),
+                ev(1, "revive_osd"),
+            ),
+            invariants=("durability", "frontier", "batch", "acting",
+                        "health", "lockdep"),
+            converge_timeout=60.0),
+        # tick-boundary crash points across the commit pipeline + a
+        # peer killed mid-tick applying a batch frame (slow)
+        "batch-kill-midtick": Scenario(
+            name="batch-kill-midtick", osds=5, pool_kind="erasure",
+            pool_size=3, pg_num=8, store="file",
+            ec_profile=(("plugin", "jerasure"),
+                        ("technique", "reed_sol_van"),
+                        ("k", "2"), ("m", "1")),
+            rounds=4, objects_per_round=8, payload_repeat=40,
+            durability_mode="attempted", burst_concurrency=8,
+            events=(
+                ev(0, "net", target="all_osds",
+                   chaos_net_batch_item_drop=0.1),
+                ev(0, "crash_point", point="batch_apply_mid"),
+                ev(1, "revive_osd"),
+                ev(1, "crash_point", point="tick_post_encode"),
+                ev(2, "revive_osd"),
+                ev(2, "crash_point", point="frontier_pre_done"),
+                ev(3, "revive_osd"),
+            ),
+            invariants=("durability", "frontier", "batch", "acting",
+                        "health", "scrub", "lockdep"),
+            converge_timeout=120.0),
+        # ROADMAP item-5 flavored (slow): bounce several OSDs under
+        # sustained writes on the sharded WQ; time-to-HEALTH_OK is
+        # bounded by the health invariant's converge_timeout, with
+        # zero durability/frontier violations
+        "rolling-restart-sharded": Scenario(
+            name="rolling-restart-sharded", osds=6, pool_size=3,
+            pg_num=16, rounds=4, objects_per_round=10,
+            payload_repeat=40, durability_mode="attempted",
+            store="file",
+            events=(
+                ev(0, "restart_osd", during_writes=True),
+                ev(1, "restart_osd", during_writes=True),
+                ev(2, "restart_osd", during_writes=True),
+                ev(3, "restart_osd", during_writes=True),
+            ),
+            invariants=("durability", "frontier", "acting", "health",
+                        "scrub", "lockdep"),
+            converge_timeout=90.0),
         # EC primaries crashed mid-write (the rewind thrasher)
         "thrash-ec-midwrite": Scenario(
             name="thrash-ec-midwrite", osds=4, pool_kind="erasure",

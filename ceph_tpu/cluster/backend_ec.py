@@ -18,6 +18,17 @@ from ceph_tpu.ops import crc32c as crcmod
 from ceph_tpu.osdmap.osdmap import PGid, PGPool
 
 
+class ECUndersized(Exception):
+    """The live acting set is below the pool's EC write floor
+    (min_size, never below k): admitting the write would create a
+    generation with fewer than k unique shards — acked-but-
+    unreconstructable by construction, and a subsequent roll-forward
+    would wedge the PG on a generation nothing can ever decode
+    (surfaced by graft-chaos batch-kill-midtick: a primary alone in a
+    bounced acting set committed a 1-of-3-shard write).  Mapped to -11
+    so the client refreshes its map and retries once the set heals."""
+
+
 class ECSizeMismatch(Exception):
     """The chosen decode group's object size disagrees with the size the
     caller assumed from its LOCAL shard attrs — the local shard is a
@@ -76,95 +87,167 @@ class ECBackendMixin:
     # Byte layout appears only where bytes must: the store transaction and
     # the sub-write wire format.
 
-    async def _ec_write_full_pipelined(self, pool: PGPool, st: PGState,
-                                       oid: str, data: bytes,
-                                       snapc=None) -> int:
-        """Pipelined full write (round 11): encode OUTSIDE the PG lock
-        (parity is a pure function of the payload, so concurrent writes
-        of one PG coalesce at the encode tick instead of serializing),
-        take the lock only for the ordered commit section (version
-        assignment, log append, local apply, sub-write sends), and await
-        the fan-out acks with the lock RELEASED — the reference's
-        in-flight RepGather pipeline, where the PG admits the next write
-        while this one's shards are still committing.  The commit
-        frontier (pg.py _frontier_*) keeps the watermark honest under
-        out-of-order ack arrival."""
+    async def _ec_write_pipelined(self, pool: PGPool, st: PGState,
+                                  oid: str, data: bytes,
+                                  offset: Optional[int],
+                                  snapc=None) -> int:
+        """Pipelined EC mutation — full rewrite (offset None) AND RMW
+        (round 12 unified): prepare (read-merge for RMW, coalesced
+        encode) under the per-OBJECT write lock, take the PG lock only
+        for the ordered commit section (version assignment, log append,
+        local apply, sub-write sends), and await the fan-out acks with
+        both RELEASED — the reference's in-flight RepGather pipeline,
+        where the PG admits the next write while this one's shards are
+        still committing.  The object lock is what the full PG lock
+        used to provide for RMW: no other write to the SAME object can
+        commit inside the read-merge window (lost-update exclusion,
+        ECBackend::start_rmw wait queue), while the rest of the PG
+        proceeds.  The commit frontier (pg.py _frontier_*) keeps the
+        watermark honest under out-of-order ack arrival."""
+        async with self._obj_write_lock(st, oid):
+            token = await self._ec_start_objlocked(
+                pool, st, oid, data, offset, snapc)
+        return await self._ec_commit_finish(st, token)
+
+    async def _ec_start_objlocked(self, pool: PGPool, st: PGState,
+                                  oid: str, data: bytes,
+                                  offset: Optional[int], snapc):
+        """Prepare + commit-start half of a pipelined EC write; the
+        caller holds the object write lock and awaits
+        ``_ec_commit_finish`` on the returned token OUTSIDE it (an int
+        token is an already-final result, e.g. -11 undersized)."""
         codec = self._codec(pool)
         sinfo = self._sinfo(pool, codec)
-        shards, crcs = await self._encode_for_write(
-            codec, sinfo, data, want_crc=True)
-        async with st.lock:
-            token = await self._ec_commit_start(
-                pool, st, oid, len(data), shards, crcs, snapc,
-                codec, sinfo)
+        if not self._ec_acting_writeable(pool, codec, st):
+            return -11  # retry after the map heals; no encode burned
+        shards, crcs, new_size, chunk_off = await self._ec_prepare_write(
+            pool, st, oid, data, offset, codec, sinfo)
+        if offset is not None:
+            self.perf.inc("osd_rmw_pipelined")
+        try:
+            async with st.lock:
+                return await self._ec_commit_start(
+                    pool, st, oid, new_size, shards, crcs, snapc,
+                    codec, sinfo, chunk_off=chunk_off)
+        except ECUndersized:
+            return -11
+
+    def _ec_acting_writeable(self, pool: PGPool, codec, st: PGState
+                             ) -> bool:
+        """EC write admission floor (reference: a PG below min_size is
+        not active and ops wait): at least min_size live members —
+        never below k — or every 'committed' stripe would be missing
+        shards it can never reconstruct."""
+        live = sum(1 for o in st.acting if o != CRUSH_ITEM_NONE)
+        k = codec.get_data_chunk_count()
+        need = min(codec.get_chunk_count(), max(k, pool.min_size))
+        if live >= need:
+            return True
+        self.perf.inc("osd_ec_undersized_blocks")
+        return False
+
+    async def _ec_truncate_pipelined(self, pool: PGPool, st: PGState,
+                                     oid: str, size: int,
+                                     snapc=None) -> int:
+        """Pipelined EC truncate (round 12): read the surviving prefix
+        and re-encode it as a full rewrite, all under the OBJECT write
+        lock (the read-then-rewrite window must exclude other writes to
+        this object — the full PG lock's old job), committing through
+        the same frontier path as every other pipelined write."""
+        async with self._obj_write_lock(st, oid):
+            cur = self._head_size(pool, st, oid)
+            if size == cur:
+                return 0
+            if size < cur:
+                head = await self._op_read(pool, st, oid, 0, size)
+                head = head.ljust(size, b"\0")
+            else:
+                head = (await self._op_read(pool, st, oid, 0, cur)
+                        ).ljust(size, b"\0")
+            token = await self._ec_start_objlocked(
+                pool, st, oid, head, None, snapc)
         return await self._ec_commit_finish(st, token)
 
     async def _ec_write(self, pool: PGPool, st: PGState, oid: str,
                         data: bytes, offset: Optional[int],
                         snapc=None) -> int:
-        """EC write incl. the RMW sequence (read old stripes, merge,
-        re-encode, fan out shard writes).  Serialization: callers hold
-        the PG-wide st.lock across the whole op, so overlapping RMWs to
-        one object can never interleave (the reference serializes them
-        in the ECBackend pipeline, ECBackend::start_rmw wait queue).
-        Full writes on the client hot path go through
-        ``_ec_write_full_pipelined`` instead, which narrows the lock to
-        the ordered commit section."""
-        from ceph_tpu.ec import stripe as stripemod
-
+        """Serial (full-PG-lock) EC write incl. the RMW sequence — the
+        ``osd_pipeline_writes=0`` fallback and the path for compound
+        read-modify callers that hold st.lock across multiple ops
+        (copy_from, rollback, EC truncate's read-then-rewrite).
+        Callers hold the PG-wide st.lock across the whole op, so
+        overlapping RMWs can never interleave.  The hot path uses
+        ``_ec_write_pipelined`` instead, which narrows the locks to the
+        ordered commit section."""
         codec = self._codec(pool)
         sinfo = self._sinfo(pool, codec)
-        coll = _coll(st.pgid)
+        if not self._ec_acting_writeable(pool, codec, st):
+            return -11
+        shards, crcs, new_size, chunk_off = await self._ec_prepare_write(
+            pool, st, oid, data, offset, codec, sinfo)
+        try:
+            token = await self._ec_commit_start(
+                pool, st, oid, new_size, shards, crcs, snapc, codec,
+                sinfo, chunk_off=chunk_off)
+        except ECUndersized:
+            return -11
+        return await self._ec_commit_finish(st, token)
 
+    async def _ec_prepare_write(self, pool: PGPool, st: PGState,
+                                oid: str, data: bytes,
+                                offset: Optional[int], codec, sinfo):
+        """The pure-compute half of an EC write: RMW read-merge (when
+        offset is given) + coalesced encode.  Returns ``(shards, crcs,
+        new_size, chunk_off)``.  Shared verbatim by the serial and
+        pipelined paths so the two stay bit-identical by construction
+        (the tier-1 exactness gate compares their stored bytes)."""
+        from ceph_tpu.ec import stripe as stripemod
+
+        coll = _coll(st.pgid)
         if offset is None:
             # write_full: replace the object — a full-shard rewrite, so
             # the coalesced tick also batch-computes the shard crcs
-            new_size = len(data)
             shards, crcs = await self._encode_for_write(
                 codec, sinfo, data, want_crc=True)
+            return shards, crcs, len(data), 0
+        sa = self.store.getattr(coll, oid, "size")
+        if sa is None:
+            # no local shard (lost, or never held): the committed
+            # size must come from the acting set — merging against
+            # an assumed-empty object would truncate committed bytes
+            _, old_size, _ = await self._gather_shards(
+                pool, st, oid, codec.get_data_chunk_count(), 0, 0)
         else:
-            sa = self.store.getattr(coll, oid, "size")
-            if sa is None:
-                # no local shard (lost, or never held): the committed
-                # size must come from the acting set — merging against
-                # an assumed-empty object would truncate committed bytes
-                _, old_size, _ = await self._gather_shards(
-                    pool, st, oid, codec.get_data_chunk_count(), 0, 0)
-            else:
-                old_size = int(sa)
-            off0, len0 = sinfo.offset_len_to_stripe_bounds(offset, len(data))
-            chunk_off = sinfo.aligned_logical_offset_to_chunk_offset(off0)
-            old_bytes = b""
-            for _attempt in range(2):
-                old_in_range = max(0, min(old_size - off0, len0))
-                if not old_in_range:
-                    break
-                try:
-                    old_bytes = await self._ec_read_stripes(
-                        pool, st, oid, chunk_off, old_in_range,
-                        expected_size=old_size)
-                    break
-                except ECSizeMismatch as e:
-                    if _attempt:
-                        # still unstable (write racing recovery): fail
-                        # the op rather than merge against absent bytes
-                        raise IOError(
-                            f"{oid}: object size unstable under RMW")
-                    # stale local size attr: redo the RMW against the
-                    # decode group's (committed) size
-                    old_size, old_bytes = e.size, b""
-            merged = stripemod.merge_range(
-                old_bytes, old_in_range, offset - off0, data)
-            new_size = max(old_size, offset + len(data))
-            # RMW touches a sub-range: the replica-side mid-shard crc
-            # merge stays local, so no batch crc here
-            shards, crcs = await self._encode_for_write(
-                codec, sinfo, merged, want_crc=False)
-
-        token = await self._ec_commit_start(
-            pool, st, oid, new_size, shards, crcs, snapc, codec, sinfo,
-            chunk_off=0 if offset is None else chunk_off)
-        return await self._ec_commit_finish(st, token)
+            old_size = int(sa)
+        off0, len0 = sinfo.offset_len_to_stripe_bounds(offset, len(data))
+        chunk_off = sinfo.aligned_logical_offset_to_chunk_offset(off0)
+        old_bytes = b""
+        for _attempt in range(2):
+            old_in_range = max(0, min(old_size - off0, len0))
+            if not old_in_range:
+                break
+            try:
+                old_bytes = await self._ec_read_stripes(
+                    pool, st, oid, chunk_off, old_in_range,
+                    expected_size=old_size)
+                break
+            except ECSizeMismatch as e:
+                if _attempt:
+                    # still unstable (write racing recovery): fail
+                    # the op rather than merge against absent bytes
+                    raise IOError(
+                        f"{oid}: object size unstable under RMW")
+                # stale local size attr: redo the RMW against the
+                # decode group's (committed) size
+                old_size, old_bytes = e.size, b""
+        merged = stripemod.merge_range(
+            old_bytes, old_in_range, offset - off0, data)
+        new_size = max(old_size, offset + len(data))
+        # RMW touches a sub-range: the replica-side mid-shard crc
+        # merge stays local, so no batch crc here
+        shards, crcs = await self._encode_for_write(
+            codec, sinfo, merged, want_crc=False)
+        return shards, crcs, new_size, chunk_off
 
     async def _ec_commit_start(self, pool: PGPool, st: PGState, oid: str,
                                new_size: int, shards, crcs, snapc,
@@ -176,9 +259,16 @@ class ECBackendMixin:
         ``_ec_commit_finish`` resolves outside the lock."""
         from ceph_tpu.cluster.optracker import mark_current
 
+        # re-checked UNDER the lock: the acting set can shrink during
+        # the prepare awaits, and a commit into an undersized set is
+        # the unreconstructable-write bug whatever the prepare-time
+        # check saw
+        if not self._ec_acting_writeable(pool, codec, st):
+            raise ECUndersized(f"{st.pgid}: acting {st.acting}")
         eversion = self._next_version(st)
         version = eversion[1]
         self._frontier_open(st, eversion)
+        self._chaos_point("frontier_open")
         shard_size = sinfo.shard_size(new_size)
         hinfo = {"size": new_size, "version": version}
 
@@ -213,6 +303,7 @@ class ECBackendMixin:
                                   pre_ops=pre_ops)
                 mark_current("store:journal_queued")
             entry = self._log_mutation(st, "modify", oid, eversion)
+            self._chaos_point("commit_pre_fanout")
             fut = None
             send_failures = 0
             if peers:
@@ -249,6 +340,13 @@ class ECBackendMixin:
                         *(self._sub_batcher.send(o, s) for o, s in subs),
                         return_exceptions=True)
                     for res in results:
+                        if isinstance(res, asyncio.CancelledError):
+                            # daemon stop / chaos crash mid-fan-out:
+                            # propagate — counting cancellation as a
+                            # peer send failure would swallow the
+                            # teardown (the swallowed-async-error bug
+                            # class graftlint now polices)
+                            raise res
                         if isinstance(res, BaseException):
                             send_failures += 1
                             self._waiter_dec(reqid)
@@ -265,7 +363,7 @@ class ECBackendMixin:
             # wedge the PG's commit watermark forever
             self._frontier_done(st, eversion, ok=False)
             raise
-        return (reqid, eversion, fut, send_failures)
+        return (reqid, eversion, fut, send_failures, entry)
 
     async def _ec_commit_finish(self, st: PGState, token) -> int:
         """Ack-wait half of an EC write — runs with the PG lock
@@ -274,7 +372,9 @@ class ECBackendMixin:
         frontier however it exits."""
         from ceph_tpu.cluster.optracker import mark_current
 
-        reqid, eversion, fut, send_failures = token
+        if isinstance(token, int):
+            return token  # already-final result (e.g. -11 undersized)
+        reqid, eversion, fut, send_failures, entry = token
         try:
             if fut is not None:
                 try:
@@ -304,7 +404,18 @@ class ECBackendMixin:
         except BaseException:
             self._frontier_done(st, eversion, ok=False)
             raise
+        if not self._entry_still_logged(st, entry):
+            # a concurrent peering round REWOUND this entry (or
+            # replaced the log) while our acks were in flight: whatever
+            # the shards said, the entry is no longer part of the PG's
+            # history — stay un-acked so the client retries (and
+            # dup-resolves) against the post-peering state.  Checked by
+            # entry IDENTITY: head/version comparisons are foolable
+            # once post-rewind writes re-advance (or re-mint) versions.
+            self._frontier_done(st, eversion, ok=False)
+            return -110
         # every shard acked: this version can never roll back now
+        self._chaos_point("frontier_pre_done")
         self._frontier_done(st, eversion, ok=True)
         mark_current("commit")
         return 0
@@ -451,6 +562,11 @@ class ECBackendMixin:
         results — the shed contract of the unbatched path."""
         results = []
         for item in msg.items:
+            if results:
+                # crash seam: peer dies MID-TICK — some of the frame's
+                # items applied (and will ack via nothing), the rest
+                # never land; the primaries' acks all die with us
+                self._chaos_point("batch_apply_mid")
             if self._sub_op_expired(item):
                 continue
             try:
@@ -633,6 +749,15 @@ class ECBackendMixin:
                     self.store.get_version(_coll(st.pgid), oid),
                     int(sa) if sa else 0)
         committed_seq = st.last_complete[1]
+
+        def _committed(v: int) -> bool:
+            # at/below the watermark, OR a resolved frontier entry the
+            # contiguous-prefix sweep hasn't reached (round 12: fully
+            # acked writes stay readable while an earlier open entry —
+            # e.g. a crash-restart reconstruction awaiting peering —
+            # holds last_complete back; read-your-ack must not regress)
+            return v <= committed_seq or st.frontier_acked(v)
+
         peers = [(shard, osd) for shard, osd in enumerate(st.acting)
                  if osd not in (self.osd_id, CRUSH_ITEM_NONE)
                  and shard not in got and shard not in exclude_shards]
@@ -654,7 +779,7 @@ class ECBackendMixin:
                     (e.version[1] for e in reversed(st.log.entries)
                      if e.oid == oid), None)
 
-                def _viable(acc, _local=dict(got), _c=committed_seq,
+                def _viable(acc, _local=dict(got), _c=_committed,
                             _k=need_k, _lv=logged_ver):
                     """k same-generation shards at/below the commit
                     watermark — pinned to the logged generation when
@@ -667,10 +792,10 @@ class ECBackendMixin:
                             byver.setdefault(
                                 reply.hinfo.get("version", 0),
                                 set()).add(reply.shard)
-                    if _lv is not None and _lv <= _c:
+                    if _lv is not None and _c(_lv):
                         ss = byver.get(_lv)
                         return ss is not None and len(ss) >= _k
-                    return any(v <= _c and len(ss) >= _k
+                    return any(_c(v) and len(ss) >= _k
                                for v, ss in byver.items())
 
                 acc = await self._subread_round(
@@ -715,7 +840,7 @@ class ECBackendMixin:
                 viable.append((v, group))
         chosen = None
         for v, group in viable:
-            if v <= committed_seq:
+            if _committed(v):
                 chosen = (v, group)
                 break
         if chosen is None and viable:
@@ -725,7 +850,7 @@ class ECBackendMixin:
         # fail the read (EIO/unfound) so recovery repairs the object
         # instead (reference serves committed object_info state or
         # returns unfound, never silently older bytes)
-        acked_newest = max((v for v in versions if v <= committed_seq),
+        acked_newest = max((v for v in versions if _committed(v)),
                            default=None)
         if (acked_newest is not None and chosen is not None
                 and chosen[0] < acked_newest):

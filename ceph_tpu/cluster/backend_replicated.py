@@ -18,6 +18,30 @@ from ceph_tpu.osdmap.osdmap import PGPool
 
 class ReplicatedBackendMixin:
 
+    # --- replicated txn shapes (ONE builder per verb, round 12): the
+    # serial _op_* methods and the pipelined client_ops routing both
+    # build through these, so the two paths are txn-identical by
+    # construction (the replicated analog of _ec_prepare_write).
+
+    def _txn_write_full(self, st: PGState, oid: str, data: bytes,
+                        snapc, version) -> Transaction:
+        return (self._snap_pre_txn(st, oid, snapc)
+                .remove(_coll(st.pgid), oid)
+                .write(_coll(st.pgid), oid, 0, data)
+                .set_version(_coll(st.pgid), oid, version[1]))
+
+    def _txn_write(self, st: PGState, oid: str, offset: int,
+                   data: bytes, snapc, version) -> Transaction:
+        return (self._snap_pre_txn(st, oid, snapc)
+                .write(_coll(st.pgid), oid, offset, data)
+                .set_version(_coll(st.pgid), oid, version[1]))
+
+    def _txn_truncate(self, st: PGState, oid: str, size: int,
+                      snapc, version) -> Transaction:
+        return (self._snap_pre_txn(st, oid, snapc)
+                .truncate(_coll(st.pgid), oid, size)
+                .set_version(_coll(st.pgid), oid, version[1]))
+
     # replicated write: local txn + MOSDRepOp fan-out (ReplicatedBackend)
     async def _op_write_full(self, pool: PGPool, st: PGState, oid: str,
                              data: bytes, snapc=None) -> int:
@@ -25,10 +49,7 @@ class ReplicatedBackendMixin:
             return await self._ec_write(pool, st, oid, data, offset=None,
                                         snapc=snapc)
         version = self._next_version(st)
-        txn = self._snap_pre_txn(st, oid, snapc)
-        txn.remove(_coll(st.pgid), oid) \
-           .write(_coll(st.pgid), oid, 0, data) \
-           .set_version(_coll(st.pgid), oid, version[1])
+        txn = self._txn_write_full(st, oid, data, snapc, version)
         return await self._replicate_txn(st, txn, "modify", oid, version)
 
     async def _op_write(self, pool: PGPool, st: PGState, oid: str,
@@ -39,9 +60,7 @@ class ReplicatedBackendMixin:
             return await self._ec_write(pool, st, oid, data, offset=offset,
                                         snapc=snapc)
         version = self._next_version(st)
-        txn = self._snap_pre_txn(st, oid, snapc)
-        txn.write(_coll(st.pgid), oid, offset, data) \
-           .set_version(_coll(st.pgid), oid, version[1])
+        txn = self._txn_write(st, oid, offset, data, snapc, version)
         return await self._replicate_txn(st, txn, "modify", oid, version)
 
     def _head_size(self, pool: PGPool, st: PGState, oid: str,
@@ -74,12 +93,39 @@ class ReplicatedBackendMixin:
                         ).ljust(size, b"\0")
             return await self._ec_write(pool, st, oid, head, offset=None,
                                         snapc=snapc)
-        coll = _coll(st.pgid)
         version = self._next_version(st)
-        txn = self._snap_pre_txn(st, oid, snapc)
-        txn.truncate(coll, oid, size) \
-           .set_version(coll, oid, version[1])
+        txn = self._txn_truncate(st, oid, size, snapc, version)
         return await self._replicate_txn(st, txn, "modify", oid, version)
+
+    async def _op_delete_pipelined(self, pool: PGPool, st: PGState,
+                                   oid: str, snapc=None) -> int:
+        """Pipelined delete: same txn shape as ``_op_delete`` (COW
+        pre-ops + EC rollback capture + remove), built under the PG
+        lock inside the commit section, acks awaited outside.  On EC
+        pools the commit additionally holds the OBJECT write lock: a
+        delete slipping inside an in-flight RMW's read-merge window
+        would be resurrected by the RMW's merged full-stripe commit —
+        the lost-update race the object lock exists to exclude."""
+        coll = _coll(st.pgid)
+
+        def _build(version):
+            txn = Transaction()
+            txn.ops.extend(self._cow_pre_ops(st, oid, snapc,
+                                             erasure=pool.is_erasure()))
+            if pool.is_erasure():
+                from ceph_tpu.cluster.pg import PGRB
+
+                txn.rb_capture(coll, oid, PGRB,
+                               self._rb_key(version[1]))
+            txn.remove(coll, oid)
+            return txn
+
+        if pool.is_erasure():
+            async with self._obj_write_lock(st, oid):
+                return await self._rep_mutate_pipelined(st, oid, _build,
+                                                        op="delete")
+        return await self._rep_mutate_pipelined(st, oid, _build,
+                                                op="delete")
 
     def _cow_pre_ops(self, st: PGState, oid: str, snapc,
                      erasure: bool) -> list:
@@ -113,7 +159,22 @@ class ReplicatedBackendMixin:
                              version: pglog.Eversion) -> int:
         """Apply locally + fan out with the log entry; commit when all
         acting replicas ack (reference PrimaryLogPG::issue_repop,
-        PrimaryLogPG.cc:9173)."""
+        PrimaryLogPG.cc:9173).  Serial shape — the caller holds st.lock
+        across the whole call (compound/meta/trim mutations and the
+        ``osd_pipeline_writes=0`` fallback).  The hot data path uses
+        the start/finish split so the ack wait runs with the PG lock
+        released (round 12: one durability story with pipelined EC)."""
+        token = await self._replicate_txn_start(st, txn, op, oid, version)
+        return await self._replicate_txn_finish(st, token)
+
+    async def _replicate_txn_start(self, st: PGState, txn: Transaction,
+                                   op: str, oid: str,
+                                   version: pglog.Eversion):
+        """Ordered commit section of a replicated mutation (runs under
+        st.lock): local txn apply, log append, commit-frontier
+        registration, and the MOSDRepOp fan-out SENDS.  Returns the
+        token ``_replicate_txn_finish`` resolves — with the lock
+        RELEASED on the pipelined path."""
         from ceph_tpu.cluster.optracker import mark_current
         from ceph_tpu.cluster.pg import CURRENT_OP_DEADLINE
 
@@ -126,63 +187,96 @@ class ReplicatedBackendMixin:
         self._frontier_open(st, version)
         peers = [o for o in st.acting
                  if o != self.osd_id and o != CRUSH_ITEM_NONE]
+        fut = None
+        reqid = None
         try:
-            return await self._replicate_txn_fanout(
-                st, txn, entry, peers, version)
+            self._chaos_point("commit_pre_fanout")
+            if peers:
+                reqid = self._next_reqid()
+                fut = self._make_waiter(reqid, len(peers))
+                # span propagation: replicas' apply spans join this op's
+                # tree.  Message built PER PEER: send_message stamps hop
+                # events into msg.trace, so a shared dict would leak one
+                # replica's send stamp into the next replica's header
+                subctx = self.tracer.context()
+                txn_blob = txn.encode()
+                # sub-writes inherit the client op's deadline (None for
+                # recovery/trim traffic): replicas shed the dead legs
+                sub_deadline = CURRENT_OP_DEADLINE.get()
+                for o in peers:
+                    rep = M.MOSDRepOp(reqid=reqid, pgid=st.pgid,
+                                      txn_blob=txn_blob,
+                                      entry=entry,
+                                      epoch=self.osdmap.epoch,
+                                      deadline=sub_deadline)
+                    if subctx is not None:
+                        rep.trace = dict(subctx)
+                    try:
+                        await self._send_osd(o, rep)
+                    except (ConnectionError, OSError, RuntimeError):
+                        # peer unreachable (map lag around a failure):
+                        # the op proceeds on the reachable set; the
+                        # logged entry delta-recovers the peer at rejoin
+                        # (reference: acting shrinks, missing grows)
+                        self._waiter_dec(reqid)
+                mark_current("sub_op_sent")
+        except BaseException:
+            if reqid is not None:
+                self._pending.pop(reqid, None)
+            self._frontier_done(st, version, ok=False)
+            raise
+        return (reqid, version, fut, entry)
+
+    async def _replicate_txn_finish(self, st: PGState, token) -> int:
+        """Ack-wait half of a replicated mutation; resolves the commit
+        frontier however it exits."""
+        from ceph_tpu.cluster.optracker import mark_current
+
+        reqid, version, fut, entry = token
+        try:
+            if fut is not None:
+                try:
+                    if not fut.done():
+                        await asyncio.wait_for(
+                            fut, timeout=self._ack_wait_timeout())
+                    mark_current("sub_op_acked")
+                except asyncio.TimeoutError:
+                    self._frontier_done(st, version, ok=False)
+                    return -110
+                finally:
+                    self._pending.pop(reqid, None)
         except BaseException:
             self._frontier_done(st, version, ok=False)
             raise
-
-    async def _replicate_txn_fanout(self, st: PGState, txn: Transaction,
-                                    entry, peers,
-                                    version: pglog.Eversion) -> int:
-        from ceph_tpu.cluster.optracker import mark_current
-        from ceph_tpu.cluster.pg import CURRENT_OP_DEADLINE
-
-        if peers:
-            reqid = self._next_reqid()
-            fut = self._make_waiter(reqid, len(peers))
-            # span propagation: replicas' apply spans join this op's
-            # tree.  Message built PER PEER: send_message stamps hop
-            # events into msg.trace, so a shared dict would leak one
-            # replica's send stamp into the next replica's header
-            subctx = self.tracer.context()
-            txn_blob = txn.encode()
-            # sub-writes inherit the client op's deadline (None for
-            # recovery/trim traffic): replicas shed the dead legs
-            sub_deadline = CURRENT_OP_DEADLINE.get()
-            for o in peers:
-                rep = M.MOSDRepOp(reqid=reqid, pgid=st.pgid,
-                                  txn_blob=txn_blob,
-                                  entry=entry,
-                                  epoch=self.osdmap.epoch,
-                                  deadline=sub_deadline)
-                if subctx is not None:
-                    rep.trace = dict(subctx)
-                try:
-                    await self._send_osd(o, rep)
-                except (ConnectionError, OSError, RuntimeError):
-                    # peer unreachable (map lag around a failure): the op
-                    # proceeds on the reachable set; the logged entry
-                    # delta-recovers the peer at rejoin (reference: the
-                    # acting set shrinks, missing grows)
-                    self._waiter_dec(reqid)
-            mark_current("sub_op_sent")
-            try:
-                if not fut.done():
-                    await asyncio.wait_for(
-                        fut, timeout=self._ack_wait_timeout())
-                mark_current("sub_op_acked")
-            except asyncio.TimeoutError:
-                self._frontier_done(st, version, ok=False)
-                return -110
-            finally:
-                self._pending.pop(reqid, None)
+        if not self._entry_still_logged(st, entry):
+            # entry rewound/replaced by a concurrent peering round
+            # mid-ack-wait: no longer part of the PG's history — stay
+            # un-acked (see the EC finish; same race, same
+            # identity-based rule)
+            self._frontier_done(st, version, ok=False)
+            return -110
         # all acting members acked: advance the never-roll-back watermark
         # (through the frontier, clamped below any pending pipelined op)
+        self._chaos_point("frontier_pre_done")
         self._frontier_done(st, version, ok=True)
         mark_current("commit")
         return 0
+
+    async def _rep_mutate_pipelined(self, st: PGState, oid: str,
+                                    build, op: str = "modify") -> int:
+        """Pipelined replicated mutation (round 12): take the PG lock
+        only for version assignment + txn build + the commit-start
+        section, await the fan-out acks with it released.
+        ``build(version) -> Transaction`` runs UNDER the lock, so
+        reads it does (snap COW state, current size) are consistent
+        with the version order exactly as in the serial path."""
+        async with st.lock:
+            version = self._next_version(st)
+            txn = build(version)
+            token = await self._replicate_txn_start(
+                st, txn, op, oid, version)
+        self.perf.inc("osd_rep_pipelined")
+        return await self._replicate_txn_finish(st, token)
 
     async def _op_delete(self, pool: PGPool, st: PGState, oid: str,
                          snapc=None) -> int:

@@ -89,6 +89,10 @@ class SubWriteBatcher:
                         osd.perf.inc("osd_subwrite_batches")
                         osd.perf.inc("osd_subwrite_batched_items",
                                      len(batch))
+                    # crash seam: THIS peer's tick frame left, other
+                    # peers' frames (and these acks) never happen — the
+                    # partial fan-out peering must rule on
+                    osd._chaos_point("commit_mid_fanout")
                     for _s, f in batch:
                         if not f.done():
                             f.set_result(None)
@@ -161,6 +165,9 @@ class EncodeBatcher:
                 cap = max(1, osd.config.osd_batch_tick_ops)
                 batch = pending[:cap]
                 self._pending[key] = pending[cap:]
+                # crash seam: the tick's batch is composed but the
+                # encode never runs — every parked op dies un-encoded
+                osd._chaos_point("tick_mid_encode")
                 t0 = osd.clock.monotonic()
                 try:
                     results = await osd._compute(
@@ -176,6 +183,9 @@ class EncodeBatcher:
                     batch = []
                     continue
                 t1 = osd.clock.monotonic()
+                # crash seam: encoded but no op of the tick has entered
+                # its commit section — nothing may survive as acked
+                osd._chaos_point("tick_post_encode")
                 osd.perf.inc("osd_batch_ticks")
                 osd.perf.inc("osd_batch_coalesced_ops", len(batch))
                 tick = (t0, t1, len(batch))

@@ -435,6 +435,21 @@ class ClientOpsMixin:
     # ops that gate the rest of their vector (CEPH_OSD_OP_CMPXATTR etc.)
     _GUARD_OPS = frozenset({"cmpxattr"})
 
+    def _compound_write_guard(self, pool, st: PGState, oid: str):
+        """Object-lock guard for compound EC mutations that commit
+        UNDER st.lock (copy_from, rollback): with pipelined writes on,
+        an in-flight RMW reads-merges under only the object lock — a
+        compound data commit slipping inside that window would be
+        overwritten by the RMW's merged full stripe (lost update).
+        Acquired BEFORE st.lock (the pg.objlock -> pg.lock order).
+        Replicated pools / pipeline-off need no guard (their commits
+        and RMW reads share st.lock already)."""
+        if pool.is_erasure() and self.config.osd_pipeline_writes > 0:
+            return self._obj_write_lock(st, oid)
+        import contextlib
+
+        return contextlib.nullcontext()
+
     async def _dispatch_client_op(self, conn, msg, m, pool, st) -> None:
         caps = getattr(conn, "peer_caps", None)
         if caps is not None:
@@ -684,25 +699,63 @@ class ClientOpsMixin:
         await conn.send(reply)
 
     async def _do_one_op(self, conn, msg, m, pool, st, opname, args):
-        """One op of the vector -> (result, out_data)."""
+        """One op of the vector -> (result, out_data).
+
+        Round 12: the hot mutation verbs (write_full, write, zero,
+        append, truncate, delete, create) commit through ONE pipelined
+        frontier path for both pool kinds — prepare under the object
+        write lock (EC read-merge-encode) or the PG lock (replicated
+        txn build), ordered commit section under the PG lock, ack wait
+        with everything released.  ``osd_pipeline_writes=0`` restores
+        the round-10 full-PG-lock serial commits as the bit-exactness
+        anchor.  Compound read-modify verbs (copy_from, rollback, exec,
+        xattr/omap) keep the serial shape — they still register with
+        the same commit frontier via _replicate_txn."""
+        pipe = self.config.osd_pipeline_writes > 0
         if opname == "write_full":
             if pool.is_erasure():
-                # pipelined (round 11): encode outside the PG lock,
-                # ordered commit under it, ack wait after release — the
-                # PG admits the next write while this one's shards
-                # commit (per-object ordering still absolute: the
-                # dispatch group serializes same-object ops end to end)
-                r = await self._ec_write_full_pipelined(
-                    pool, st, msg.oid, args["data"], snapc=msg.snapc)
+                if pipe:
+                    # encode outside the PG lock, ordered commit under
+                    # it, ack wait after release — the PG admits the
+                    # next write while this one's shards commit
+                    r = await self._ec_write_pipelined(
+                        pool, st, msg.oid, args["data"], None,
+                        snapc=msg.snapc)
+                else:
+                    async with st.lock:
+                        r = await self._ec_write(
+                            pool, st, msg.oid, args["data"], None,
+                            snapc=msg.snapc)
+                return r, None
+            if pipe:
+                r = await self._rep_mutate_pipelined(
+                    st, msg.oid,
+                    lambda version: self._txn_write_full(
+                        st, msg.oid, args["data"], msg.snapc, version))
                 return r, None
             async with st.lock:
                 r = await self._op_write_full(
                     pool, st, msg.oid, args["data"], snapc=msg.snapc)
             return r, None
-        if opname == "write":
+        if opname in ("write", "zero"):
+            data = args["data"] if opname == "write" \
+                else b"\0" * args["length"]
+            offset = args["offset"]
+            if pipe:
+                if pool.is_erasure():
+                    r = await self._ec_write_pipelined(
+                        pool, st, msg.oid, data, offset,
+                        snapc=msg.snapc)
+                else:
+                    r = await self._rep_mutate_pipelined(
+                        st, msg.oid,
+                        lambda version: self._txn_write(
+                            st, msg.oid, offset, data, msg.snapc,
+                            version))
+                return r, None
             async with st.lock:
                 r = await self._op_write(pool, st, msg.oid,
-                                         args["offset"], args["data"],
+                                         offset, data,
                                          snapc=msg.snapc)
             return r, None
         if opname == "read":
@@ -715,13 +768,40 @@ class ClientOpsMixin:
             except FileNotFoundError:
                 return -2, None
         if opname == "delete":
+            if pipe:
+                r = await self._op_delete_pipelined(pool, st, msg.oid,
+                                                    snapc=msg.snapc)
+                return r, None
             async with st.lock:
                 r = await self._op_delete(pool, st, msg.oid,
                                           snapc=msg.snapc)
             return r, None
         if opname == "append":
-            # CEPH_OSD_OP_APPEND: a write at the CURRENT size,
-            # atomic under the PG lock (do_osd_ops:4917 case)
+            # CEPH_OSD_OP_APPEND: a write at the CURRENT size — atomic
+            # under the object write lock (pipelined; concurrent
+            # appends serialize per object, do_osd_ops:4917 case) or
+            # the PG lock (serial fallback)
+            if pipe and pool.is_erasure():
+                async with self._obj_write_lock(st, msg.oid):
+                    size = self._head_size(pool, st, msg.oid)
+                    token = await self._ec_start_objlocked(
+                        pool, st, msg.oid, args["data"], size,
+                        msg.snapc)
+                r = await self._ec_commit_finish(st, token)
+                return r, size
+            if pipe:
+                sizebox = []
+
+                def _build(version):
+                    sizebox.append(
+                        self._head_size(pool, st, msg.oid))
+                    return self._txn_write(st, msg.oid, sizebox[0],
+                                           args["data"], msg.snapc,
+                                           version)
+
+                r = await self._rep_mutate_pipelined(st, msg.oid,
+                                                     _build)
+                return r, sizebox[0] if sizebox else 0
             async with st.lock:
                 size = self._head_size(pool, st, msg.oid)
                 r = await self._op_write(pool, st, msg.oid,
@@ -729,21 +809,48 @@ class ClientOpsMixin:
                                          snapc=msg.snapc)
             return r, size
         if opname == "truncate":
+            if pipe and pool.is_erasure():
+                r = await self._ec_truncate_pipelined(
+                    pool, st, msg.oid, args["size"], snapc=msg.snapc)
+                return r, None
+            if pipe:
+                r = await self._rep_mutate_pipelined(
+                    st, msg.oid,
+                    lambda version: self._txn_truncate(
+                        st, msg.oid, args["size"], msg.snapc,
+                        version))
+                return r, None
             async with st.lock:
                 r = await self._op_truncate(pool, st, msg.oid,
                                             args["size"],
                                             snapc=msg.snapc)
             return r, None
-        if opname == "zero":
-            # CEPH_OSD_OP_ZERO: write zeros over the range
-            async with st.lock:
-                r = await self._op_write(pool, st, msg.oid,
-                                         args["offset"],
-                                         b"\0" * args["length"],
-                                         snapc=msg.snapc)
-            return r, None
         if opname == "create":
-            # exclusive create (CEPH_OSD_OP_CREATE + EXCL flag)
+            # exclusive create (CEPH_OSD_OP_CREATE + EXCL flag): the
+            # exists-check must be atomic with the commit start, so the
+            # pipelined shape holds the object lock (EC) / PG lock
+            # (replicated) across both
+            if pipe and pool.is_erasure():
+                async with self._obj_write_lock(st, msg.oid):
+                    if self._head_size(pool, st, msg.oid,
+                                       missing=None) is not None:
+                        return -17, None  # EEXIST
+                    token = await self._ec_start_objlocked(
+                        pool, st, msg.oid, b"", None, msg.snapc)
+                r = await self._ec_commit_finish(st, token)
+                return r, None
+            if pipe:
+                async with st.lock:
+                    if self._head_size(pool, st, msg.oid,
+                                       missing=None) is not None:
+                        return -17, None  # EEXIST
+                    version = self._next_version(st)
+                    txn = self._txn_write_full(st, msg.oid, b"",
+                                               msg.snapc, version)
+                    token = await self._replicate_txn_start(
+                        st, txn, "modify", msg.oid, version)
+                r = await self._replicate_txn_finish(st, token)
+                return r, None
             async with st.lock:
                 if self._head_size(pool, st, msg.oid, missing=None) \
                         is not None:
@@ -817,13 +924,16 @@ class ClientOpsMixin:
             if reply.result < 0:
                 return reply.result, None
             data, xattrs, omap = reply.data
-            async with st.lock:
-                r = await self._op_write_full(pool, st, msg.oid, data,
-                                              snapc=msg.snapc)
-                if r < 0:
-                    return r, None
-                r = await self._replace_meta(st, msg.oid, xattrs or {},
-                                             omap or {})
+            async with self._compound_write_guard(pool, st, msg.oid):
+                async with st.lock:
+                    r = await self._op_write_full(pool, st, msg.oid,
+                                                  data,
+                                                  snapc=msg.snapc)
+                    if r < 0:
+                        return r, None
+                    r = await self._replace_meta(st, msg.oid,
+                                                 xattrs or {},
+                                                 omap or {})
             return (r, None) if r < 0 else (0, len(data))
         if opname == "rollback":
             # CEPH_OSD_OP_ROLLBACK (reference PrimaryLogPG::_rollback_to):
@@ -843,12 +953,15 @@ class ClientOpsMixin:
                       self.store.get_xattrs(coll, src).items()
                       if k.startswith("_")}
             omap = self.store.omap_get(coll, src)
-            async with st.lock:
-                r = await self._op_write_full(pool, st, msg.oid, data,
-                                              snapc=msg.snapc)
-                if r < 0:
-                    return r, None
-                r = await self._replace_meta(st, msg.oid, xattrs, omap)
+            async with self._compound_write_guard(pool, st, msg.oid):
+                async with st.lock:
+                    r = await self._op_write_full(pool, st, msg.oid,
+                                                  data,
+                                                  snapc=msg.snapc)
+                    if r < 0:
+                        return r, None
+                    r = await self._replace_meta(st, msg.oid, xattrs,
+                                                 omap)
             return (r, None) if r < 0 else (0, None)
         if opname == "notify_ack":
             entry = self._notifies.get(args["notify_id"])

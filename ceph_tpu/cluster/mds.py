@@ -271,7 +271,7 @@ class MDSDaemon(Dispatcher):
         try:
             await self._meta_io.selfmanaged_snap_remove(rec["meta_id"])
             await self._data_io.selfmanaged_snap_remove(rec["data_id"])
-        except Exception:
+        except (IOError, OSError, TimeoutError, ConnectionError):
             pass  # trimming is advisory; the table entry is gone
         await self._load_snaptable()
 
@@ -326,7 +326,9 @@ class MDSDaemon(Dispatcher):
                 await self._load_subtrees()
                 await self._load_snaptable()
             except Exception:
-                pass
+                # table convergence retries next beacon; counted so a
+                # persistently-failing load is visible in perf dump
+                self.perf.inc("mds_table_load_errors")
 
     # -- journal (MDLog analog) --------------------------------------------
 
@@ -641,8 +643,9 @@ class MDSClient:
                     await self.objecter._refresh_map()
                     await self._refresh_subtrees()
                     rank = self._owner_rank(args[0]) if args else 0
-                except Exception:
-                    pass
+                except (IOError, OSError, TimeoutError,
+                        ConnectionError):
+                    pass  # stale map/rank: the retry loop re-resolves
                 await asyncio.sleep(0.2)
         if reply.result == -17:
             raise FileExistsError(reply.error)

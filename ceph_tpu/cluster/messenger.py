@@ -238,8 +238,9 @@ class Connection:
         try:
             self.writer.close()
             await self.writer.wait_closed()
-        except Exception:
-            pass
+        except (ConnectionError, OSError, RuntimeError,
+                asyncio.TimeoutError):
+            pass  # best-effort close of an already-dying transport
 
 
 class Dispatcher:
@@ -547,7 +548,12 @@ class Messenger:
                 try:
                     await d.ms_handle_reset(conn)
                 except Exception:
-                    pass
+                    # a broken reset hook must not kill the read loop,
+                    # but it is a BUG in the dispatcher — surface it
+                    import logging
+
+                    logging.getLogger("ceph_tpu.msgr").exception(
+                        "%s: ms_handle_reset hook failed", self.name)
 
     async def _handle_auth_frame(self, conn: Connection, msg) -> bool:
         """cephx transport frames (already struct-decoded — the pickle
@@ -658,6 +664,11 @@ class Messenger:
                 # endpoint put the message on the wire
                 msg.trace.setdefault("events", []).append(
                     (f"msgr:{self.name}:send", _time.time()))
+            if self.chaos is not None:
+                # batch-frame faults mutate the message BEFORE pickling
+                # so the buffered replay frame carries the same partial
+                # tick — the item loss is real, not racing replay
+                self.chaos.mutate_batch(msg)
             payload = pickle.dumps(msg)
             # buffer the UNSIGNED payload and sign at write time with the
             # connection's key: a cephx ticket renewal mints a new session
@@ -858,6 +869,10 @@ class Messenger:
 
     async def shutdown(self) -> None:
         self._closing = True
+        if self.config is not None:
+            # the config outlives this messenger (daemon bounces reuse
+            # it): leave no observer behind to pin dead incarnations
+            self.config.remove_observer(self._chaos_observer)
         if self._server:
             self._server.close()
         for conn in list(self._out.values()) + list(self._accepted):
@@ -869,6 +884,8 @@ class Messenger:
         for t in pending:
             t.cancel()
         if pending:
-            await asyncio.gather(*pending, return_exceptions=True)
+            # teardown drain of just-cancelled reader tasks; their
+            # results are void by definition
+            await asyncio.gather(*pending, return_exceptions=True)  # graftlint: ignore[swallowed-async-error]
         if self._server:
             await self._server.wait_closed()

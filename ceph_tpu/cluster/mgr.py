@@ -190,8 +190,8 @@ class MgrDaemon(Dispatcher):
         finally:
             try:
                 writer.close()
-            except Exception:
-                pass
+            except (ConnectionError, OSError, RuntimeError):
+                pass  # best-effort close of a dying scrape socket
 
     async def _beacon_loop(self, addr: Addr) -> None:
         while not self._stopped:

@@ -412,8 +412,8 @@ class Objecter(Dispatcher):
                 await self.op_submit(pool_id, oid,
                                      [("watch", {"cookie": cookie})],
                                      timeout=10.0)
-            except Exception:
-                pass
+            except (IOError, OSError, TimeoutError):
+                pass  # rewatch is best-effort; next reset retries
 
     async def _handle_watch_notify(self, msg: M.MWatchNotify) -> None:
         cb = self._watches.get((msg.pool, msg.oid, msg.cookie))
@@ -438,8 +438,8 @@ class Objecter(Dispatcher):
                              ops=[("notify_ack",
                                    {"notify_id": msg.notify_id})],
                              epoch=self.osdmap.epoch), tuple(addr))
-        except Exception:
-            pass
+        except (ConnectionError, OSError, RuntimeError, KeyError):
+            pass  # unacked notify: the notifier's timeout covers it
 
     async def watch(self, pool_id: int, oid: str, callback) -> int:
         self._cookie += 1

@@ -228,7 +228,54 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         # removed snaps already trimmed per PG (purged_snaps analog;
         # in-memory — a restart re-runs one idempotent trim pass)
         self._purged_snaps: Dict[Tuple, set] = {}
+        # chaos crash points (round 12): remaining traversals of the
+        # armed point before it fires; the launcher (vstart Cluster)
+        # installs _chaos_crash_cb so a self-crash keeps the cluster's
+        # revive bookkeeping coherent
+        self._crash_skip = self.config.chaos_crash_point_skip
+        self._crash_fired = False
+        self._chaos_crash_cb = None
+        self.config.add_observer(self._chaos_crash_observer)
         self._stopped = False
+
+    def _chaos_crash_observer(self, name: str, value) -> None:
+        if name == "chaos_crash_point_skip":
+            self._crash_skip = int(value)
+        elif name == "chaos_crash_point":
+            self._crash_fired = False
+
+    def _chaos_point(self, name: str) -> None:
+        """Named crash seam (round 12): when the armed chaos_crash_point
+        matches, power-cut this daemon AT THIS INSTANT — _stopped flips
+        before anything else runs, the actual store-crash/teardown is
+        handed to the launcher's callback, and ChaosCrash (a
+        CancelledError) unwinds the current path exactly like a task
+        dying mid-await.  One falsy test when unarmed (no-op contract).
+        """
+        cp = self.config.chaos_crash_point
+        if not cp or cp != name or self._stopped or self._crash_fired:
+            return
+        if self._crash_skip > 0:
+            self._crash_skip -= 1
+            return
+        from ceph_tpu.chaos import ChaosCrash
+        from ceph_tpu.chaos.counters import CHAOS
+
+        self._crash_fired = True
+        self._stopped = True
+        CHAOS.inc("crash_points_fired")
+        if hasattr(self.store, "crash"):
+            # freeze the disk AT the instant: nothing the unwinding
+            # coroutines do past this point may persist (a real power
+            # cut doesn't run except-handlers against the platter)
+            self.store.crash()
+        cb = self._chaos_crash_cb
+        if cb is not None:
+            # the callback task is OWNED BY THE LAUNCHER (it outlives
+            # this daemon's stop(); tracking it here would cancel the
+            # crash mid-flight)
+            cb(name)
+        raise ChaosCrash(f"chaos crash point {name!r} fired")
 
     # ------------------------------------------------------------ lifecycle
 
@@ -287,10 +334,18 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         may tear or lose the journal tail; a MemStore's contents are
         simply what a dead host's RAM is."""
         self._stopped = True
+        # deregister config observers: the per-daemon config OUTLIVES
+        # this incarnation (restart/revive reuse it), and stale
+        # observers would pin every dead daemon and mutate its state
+        # on later injectargs
+        self.config.remove_observer(self._chaos_disk_observer)
+        self.config.remove_observer(self._chaos_crash_observer)
         for t in list(self._tasks) + list(self._opq_running):
             t.cancel()
         if self._opq_running:
-            await asyncio.gather(*self._opq_running,
+            # teardown drain of already-cancelled op tasks; their
+            # results are void by definition
+            await asyncio.gather(*self._opq_running,  # graftlint: ignore[swallowed-async-error]
                                  return_exceptions=True)
         await self.messenger.shutdown()
         if crash:
@@ -461,7 +516,9 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
             try:
                 await self._mon_send(M.MLog(entries=(entry,)))
             except Exception:
-                pass
+                # fire-and-forget by contract, but observable: a clog
+                # line lost to transport is counted, never silent
+                self.perf.inc("osd_clog_send_errors")
 
         try:
             self._track(asyncio.get_event_loop().create_task(_send()))
@@ -472,6 +529,11 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
     # ------------------------------------------------------------- dispatch
 
     async def ms_dispatch(self, conn: Connection, msg) -> bool:
+        if self._stopped:
+            # a stopped (or chaos-crashed) daemon serves nothing: its
+            # store is frozen, so handling a frame here could neither
+            # apply nor ack — exactly a dead process on the wire
+            return True
         try:
             return await self._dispatch(conn, msg)
         except Exception as e:
@@ -719,6 +781,29 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         self.perf.add_u64("osd_subwrite_batched_items",
                           desc="shard sub-writes that rode a "
                                "multi-item frame")
+        # crash-safe batched plane (round 12): frontier recovery +
+        # batched-ack dedup telemetry
+        self.perf.add_u64("osd_frontier_rebuilt",
+                          desc="open commit-frontier entries "
+                               "reconstructed from the pg log at boot "
+                               "(resolved by peering roll-forward or "
+                               "rewind)")
+        self.perf.add_u64("osd_dup_acks_ignored",
+                          desc="duplicate sub-op acks absorbed by the "
+                               "per-responder dedup (session replay, "
+                               "chaos dup/batch-ack faults)")
+        self.perf.add_u64("osd_rmw_pipelined",
+                          desc="EC RMW writes committed through the "
+                               "pipelined frontier path (PG lock held "
+                               "only for the commit section)")
+        self.perf.add_u64("osd_rep_pipelined",
+                          desc="replicated-pool mutations committed "
+                               "through the pipelined frontier path")
+        self.perf.add_u64("osd_ec_undersized_blocks",
+                          desc="EC writes/roll-forwards refused because "
+                               "the live acting set was below the "
+                               "pool's min_size floor (acked-but-"
+                               "unreconstructable guard)")
 
     def _build_admin_socket(self):
         """Register this daemon's command table (reference OSD::asok_
@@ -833,6 +918,9 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                 seen = set()
                 fut.ackers = seen  # type: ignore[attr-defined]
             if sk in seen:
+                # counted so batch-chaos runs can PROVE the dedup path
+                # absorbed their injected duplicate acks
+                self.perf.inc("osd_dup_acks_ignored")
                 return
             seen.add(sk)
         acc.append((result, payload))
@@ -1019,6 +1107,12 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                             self._maybe_split(pool, st)
                         st.last_update, st.log = self._load_pg_meta(pgid)
                         st.last_complete = self._load_last_complete(pgid)
+                        # round 12: logged entries above the persisted
+                        # watermark are OPEN frontier entries — their
+                        # acks died with the previous process life, so
+                        # last_complete must not bless them until
+                        # peering rules on each (roll forward / rewind)
+                        self._frontier_rebuild(st)
                         self.pgs[pgid] = st
                     else:
                         if old.acting != acting:
@@ -1045,6 +1139,15 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                 self.store.queue_transaction(
                     Transaction().remove_collection(coll))
                 self.perf.inc("osd_pgs_removed")
+        if not changed and any(st.frontier_recovering
+                               and st.primary == self.osd_id
+                               for st in self.pgs.values()):
+            # round 12: a crash-restarted primary whose acting set came
+            # back IDENTICAL still owes peering a round — its
+            # reconstructed open frontier entries resolve only by
+            # verified presence/rewind, and nothing else would ever
+            # trigger it (recovery otherwise runs on membership change)
+            changed = True
         return changed
 
     def _pool_memberships(self, m: OSDMap, pool_id: int, pool: PGPool):
@@ -1110,7 +1213,9 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                 # drained op queue clears SLOW_OPS
                 self.loopmon.reset_window()
             except Exception:
-                pass
+                # the heartbeat loop must survive any transport hiccup,
+                # but a dropped beacon is counted, never silent
+                self.perf.inc("osd_beacon_send_errors")
             # perf-counter stream to the active mgr (MgrClient::send_report)
             mgr_addr = getattr(m, "mgr_addr", None)
             if mgr_addr:

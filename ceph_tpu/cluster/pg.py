@@ -8,6 +8,7 @@ recovery-time full rewrite/load paths."""
 from __future__ import annotations
 
 import asyncio
+import contextlib
 import contextvars
 import pickle
 from collections import OrderedDict
@@ -87,6 +88,29 @@ class PGState:
     # write (see PGLogMixin._frontier_done)
     pipeline_pending: "OrderedDict[pglog.Eversion, bool]" = field(
         default_factory=OrderedDict)
+    # crash-restart frontier reconstruction (round 12): logged entries
+    # above the persisted watermark whose fan-out acks died with the
+    # previous process life.  They sit in pipeline_pending as OPEN
+    # entries (so last_complete cannot bless them) until peering
+    # verifies every acting member holds them (roll forward) or rewinds
+    # them; a recovery round is not complete while any remain.
+    frontier_recovering: set = field(default_factory=set)
+    # per-object write serialization for the pipelined RMW path (round
+    # 12, reference ECBackend::start_rmw wait queue): read-merge-encode
+    # runs under the OBJECT's lock, not the PG's, so one object's RMW
+    # can never interleave with (or lose) another write to the same
+    # object while the rest of the PG proceeds.  Entries are created on
+    # demand and dropped when uncontended (see OSD._obj_write_lock).
+    obj_locks: Dict[str, object] = field(default_factory=dict)
+    obj_lock_refs: Dict[str, int] = field(default_factory=dict)
+
+    def frontier_acked(self, seq: int) -> bool:
+        """Is seq a RESOLVED (fully acked) frontier entry that the
+        contiguous-prefix watermark merely hasn't swept yet?  Reads may
+        serve such a generation: its durability is established even
+        though last_complete is held back by an earlier open entry."""
+        return any(ok and v[1] == seq
+                   for v, ok in self.pipeline_pending.items())
 
     def info(self) -> PGInfo:
         return PGInfo(last_update=self.last_update, log_tail=self.log.tail,
@@ -154,12 +178,103 @@ class PGLogMixin:
             txn.omap_rmkeys(coll, PGMETA,
                             [self._meta_key(e.version) for e in dropped])
         # learn the primary's commit watermark from the entry stream and
-        # drop rollback records for entries that can no longer rewind
+        # drop rollback records for entries that can no longer rewind.
+        # Routed through _frontier_learn: the primary's word resolves
+        # any boot-reconstructed open entries at/below it (a replica's
+        # own frontier must never wedge on entries the primary already
+        # committed cluster-wide)
         committed = getattr(entry, "committed", pglog.ZERO)
         if committed > st.last_complete:
-            self._advance_last_complete(st, committed, txn)
+            self._frontier_learn(st, committed, txn)
         self.store.queue_transaction(txn)
         return entry
+
+    def _frontier_rebuild(self, st: PGState) -> None:
+        """Crash-restart frontier reconstruction (round 12): the
+        round-11 frontier was purely in-memory, so a restarted daemon
+        forgot which logged entries were still awaiting their fan-out
+        acks — and a post-restart write that fully acked would advance
+        ``last_complete`` PAST them, blessing writes whose acks died
+        with the process (peering might still rewind them: broken
+        read-your-ack by construction).  Re-register every logged entry
+        above the persisted watermark as an OPEN frontier entry;
+        peering resolves each by verifying every acting member holds it
+        (roll forward, reference PG::activate) or rewinding it."""
+        for e in st.log.entries:
+            if e.version > st.last_complete:
+                st.pipeline_pending[e.version] = False
+                st.frontier_recovering.add(e.version)
+        if st.frontier_recovering:
+            self.perf.inc("osd_frontier_rebuilt",
+                          len(st.frontier_recovering))
+
+    def _frontier_learn(self, st: PGState, version: pglog.Eversion,
+                        txn=None) -> None:
+        """An AUTHORITATIVE commit watermark arrived — the primary's
+        entry stream, or a peering round that verified every acting
+        member holds every entry up to ``version``.  Resolve open
+        frontier entries at/below it (their durability is now
+        established by authority, not by our own ack bookkeeping),
+        sweep any contiguous resolved prefix beyond, and advance."""
+        fl = st.pipeline_pending
+        for v in [v for v in fl if v <= version]:
+            del fl[v]
+            st.frontier_recovering.discard(v)
+        new = version
+        while fl:
+            v = next(iter(fl))
+            if not fl[v]:
+                break
+            new = v
+            del fl[v]
+            st.frontier_recovering.discard(v)
+        self._advance_last_complete(st, new, txn)
+
+    @contextlib.asynccontextmanager
+    async def _obj_write_lock(self, st: PGState, oid: str):
+        """Per-object write serialization for the pipelined mutation
+        path (round 12): an RMW holds this across its read-merge-encode
+        window and commit start, and every other pipelined write to the
+        SAME object takes it around its commit start — so no write can
+        commit inside an RMW's read window (the lost-update race the
+        full PG lock used to exclude), while writes to different
+        objects of the PG proceed concurrently.  Always acquired BEFORE
+        st.lock (the lockdep order pg.objlock -> pg.lock)."""
+        lock = st.obj_locks.get(oid)
+        if lock is None:
+            lock = st.obj_locks[oid] = DepLock("pg.objlock")
+        st.obj_lock_refs[oid] = st.obj_lock_refs.get(oid, 0) + 1
+        try:
+            async with lock:
+                yield
+        finally:
+            n = st.obj_lock_refs.get(oid, 1) - 1
+            if n <= 0:
+                st.obj_lock_refs.pop(oid, None)
+                st.obj_locks.pop(oid, None)
+            else:
+                st.obj_lock_refs[oid] = n
+
+    def _entry_still_logged(self, st: PGState, entry) -> bool:
+        """Is THIS LogEntry object still part of the PG's history?  The
+        commit finishes use it to detect a concurrent peering rewind:
+        comparing the version against the log head is foolable — new
+        post-rewind writes re-advance ``last_update`` past (or a retry
+        round at the same epoch re-MINTS) the rewound eversion, and a
+        rolled-back write would ack as success.  Object identity cannot
+        be re-minted.  A log ADOPTION (peering replaced the entries
+        with auth copies) also fails the check — conservatively
+        un-acked, and the client's retry dup-resolves against the log.
+        Scans newest-first with an ordering early-exit: an in-flight
+        commit's entry sits at/near the head."""
+        if entry is None:
+            return True
+        for e in reversed(st.log.entries):
+            if e is entry:
+                return True
+            if e.version < entry.version:
+                return False
+        return False
 
     def _frontier_open(self, st: PGState, version: pglog.Eversion) -> None:
         """Register an in-flight client mutation (called under the PG
@@ -178,15 +293,20 @@ class PGLogMixin:
         failed one and peering owns the failed entry's fate."""
         fl = st.pipeline_pending
         if version not in fl:
-            # unregistered caller (recovery / roll-forward): direct
-            # advance, still clamped below any pending entry
-            if ok:
+            # unregistered caller (recovery / roll-forward, or a commit
+            # whose entry a concurrent peering round REWOUND out from
+            # under its ack wait — version > last_update): direct
+            # advance, still clamped below any pending entry and never
+            # past the log head (blessing a rewound version would put
+            # the watermark over history that no longer exists)
+            if ok and version <= st.last_update:
                 self._advance_last_complete(st, version)
             return
         if ok:
             fl[version] = True
         else:
             del fl[version]
+            st.frontier_recovering.discard(version)
         new = None
         while fl:
             v = next(iter(fl))
@@ -194,6 +314,7 @@ class PGLogMixin:
                 break
             new = v
             del fl[v]
+            st.frontier_recovering.discard(v)
         if new is not None:
             self._advance_last_complete(st, new)
 
@@ -205,8 +326,22 @@ class PGLogMixin:
         write: entries awaiting their fan-out acks are not durable."""
         if version <= st.last_complete:
             return
+        if version > st.last_update:
+            # never past the log head: a watermark over rewound (or
+            # never-logged) history is unresolvable — peering elections
+            # would find NO member whose log covers it
+            return
         if st.pipeline_pending and \
                 version >= next(iter(st.pipeline_pending)):
+            return
+        pgs = getattr(self, "pgs", None)
+        if pgs is not None and pgs.get(st.pgid) is not st:
+            # superseded PGState (the PG left and rejoined this OSD
+            # while an op's ack-wait half was still in flight): its
+            # watermark no longer owns the store attr — persisting it
+            # here would race the LIVE state's view (surfaced by the
+            # round-12 frontier invariant as persisted != in-memory).
+            # The live state recomputes via peering / the entry stream.
             return
         st.last_complete = version
         coll = _coll(st.pgid)
@@ -268,6 +403,12 @@ class PGLogMixin:
             self.perf.inc("osd_log_rewinds")
         st.log.entries = [e for e in st.log.entries
                           if e.version <= auth_head]
+        # rolled-back entries leave the commit frontier too: a rewound
+        # version can never ack, and a reconstructed open entry for it
+        # would wedge the watermark forever
+        for v in [v for v in st.pipeline_pending if v > auth_head]:
+            del st.pipeline_pending[v]
+            st.frontier_recovering.discard(v)
         # in-place entries rewrite: the lazy reqid dup index must rebuild,
         # or has_reqid would ack ops whose effects were just rolled back
         st.log._reqids = None

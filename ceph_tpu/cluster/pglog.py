@@ -170,5 +170,13 @@ def choose_authoritative(infos: Dict[int, PGInfo],
     committed = max(i.last_complete for i in infos.values())
     candidates = {o: i for o, i in infos.items()
                   if i.last_update >= committed}
+    if not candidates:
+        # infos raced in-flight commits (a member's watermark moved
+        # after another snapshotted): no member's log covers the
+        # claimed watermark IN THIS SNAPSHOT.  Fall back to the whole
+        # set rather than crash the peering round — the per-member
+        # rewind guards refuse unsafe targets and the caller's
+        # stale-round check + retry re-elect from fresh infos.
+        candidates = dict(infos)
     return min(candidates,
                key=lambda o: (candidates[o].last_update, o))

@@ -76,8 +76,23 @@ class RecoveryMixin:
         outage would otherwise never retry — the primary stays stale
         forever, serving old-generation state (surfaced by graft-chaos as
         persistent torn EC reads)."""
-        async with st.lock:
-            complete = await self._recover_pg_locked(st)
+        try:
+            async with st.lock:
+                complete = await self._recover_pg_locked(st)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # a round that RAISES must still re-arm (round 12): infos
+            # racing in-flight commits can be transiently inconsistent,
+            # and a wedged retry chain leaves reconstructed frontier
+            # entries unresolved forever
+            self.perf.inc("osd_recovery_errors")
+            import logging
+
+            logging.getLogger("ceph_tpu.osd").exception(
+                "osd.%d: peering round for pg %s errored",
+                self.osd_id, st.pgid)
+            complete = False
         if complete:
             self._recovery_backoffs.pop(st.pgid, None)
         else:
@@ -104,6 +119,12 @@ class RecoveryMixin:
         auth = pglog.choose_authoritative(
             infos, require_rollback=pool.is_erasure())
         auth_head = infos[auth].last_update
+        if auth_head < st.last_complete:
+            # STALE ROUND (round 12): in-flight ack waits advanced our
+            # watermark while we were collecting infos — rewinding (or
+            # syncing) toward a head below it would roll back ACKED
+            # writes.  Drop this round; the retry collects fresh infos.
+            return False
         if pool.is_erasure() and st.last_update > auth_head:
             # we hold entries the authoritative log rolls back: an
             # un-acked partial-stripe write that not every shard applied
@@ -161,11 +182,43 @@ class RecoveryMixin:
         # forever: no rewind fires (nothing is divergent) and no later
         # ack arrives (surfaced by graft-chaos as a stuck-incomplete PG)
         live = [o for o in st.acting if o != CRUSH_ITEM_NONE]
-        if all(o in infos for o in live):
+        # EC undersized guard (round 12): with fewer than min_size live
+        # members, "every member holds it" is vacuous — rolling the
+        # watermark forward over entries only a sub-k shard subset
+        # holds commits a generation nothing can ever decode (the same
+        # bug class _ec_acting_writeable blocks at admission)
+        undersized = pool.is_erasure() and not self._ec_acting_writeable(
+            pool, self._codec(pool), st)
+        if all(o in infos for o in live) and not undersized:
             floor = min(i.last_update for i in infos.values())
+            if complete and floor < st.last_update and members:
+                # this round PUSHED the delta above the floor: re-query
+                # the members' heads before rolling the watermark over
+                # the pushed entries — roll-forward must rest on a
+                # REPORT that every member holds them, never on a send
+                # having been queued (round 12: reconstructed frontier
+                # entries resolve only by verified presence)
+                for osd in members:
+                    reply = await self._query_pg(osd, st.pgid)
+                    if reply is None:
+                        complete = False
+                        infos.pop(osd, None)
+                        continue
+                    infos[osd] = reply.info or PGInfo()
+                if all(o in infos for o in live):
+                    floor = min(i.last_update for i in infos.values())
             floor = min(floor, st.last_update)
-            if floor > st.last_complete:
-                self._advance_last_complete(st, floor)
+            # routed through the frontier (round 12): entries at/below
+            # the verified floor resolve — including crash-restart
+            # reconstructions (_frontier_rebuild) whose acks died with
+            # the previous process life
+            if floor > st.last_complete or st.pipeline_pending:
+                self._frontier_learn(st, floor)
+        if st.frontier_recovering:
+            # open boot entries above what this round could verify:
+            # the PG is not crash-consistent yet — retry (the members
+            # behind them are still syncing, or unreachable)
+            complete = False
         self.perf.inc("osd_pg_recoveries")
         return complete
 

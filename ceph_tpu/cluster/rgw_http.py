@@ -70,8 +70,8 @@ class RGWFrontend:
             for w in self._conns:
                 try:
                     w.close()
-                except Exception:
-                    pass
+                except (ConnectionError, OSError, RuntimeError):
+                    pass  # best-effort close of a dying keep-alive
             await self._server.wait_closed()
 
     # -- HTTP plumbing -----------------------------------------------------

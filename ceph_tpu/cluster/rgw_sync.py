@@ -179,7 +179,9 @@ class RGWSyncAgent:
             try:
                 await self.sync_once()
             except Exception:
-                pass  # transient (peer down); next tick retries
+                # transient (peer down); next tick retries — counted so
+                # a permanently-failing agent is visible in its stats
+                self.stats["errors"] = self.stats.get("errors", 0) + 1
             await asyncio.sleep(self.interval)
 
     async def stop(self) -> None:

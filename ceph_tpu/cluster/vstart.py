@@ -45,6 +45,37 @@ class Cluster:
     # durable stores of killed/crashed OSDs: a crash-revive remounts the
     # same store and replays its journal (MemStore kills stay lost-RAM)
     osd_stores: Dict[int, object] = field(default_factory=dict)
+    # chaos crash-point teardown tasks (round 12): a daemon that
+    # self-crashes at an armed seam hands its teardown HERE — the dying
+    # daemon cannot own the task (its stop() would cancel the crash
+    # mid-flight).  Self-discarding; drain_chaos() awaits stragglers so
+    # a scenario's heal phase never races a crash still in progress.
+    _chaos_tasks: set = field(default_factory=set)
+
+    def _arm_chaos_crash(self, osd: OSDDaemon) -> None:
+        """Install the crash-point callback: when the daemon's write
+        path trips an armed chaos_crash_point, the cluster performs the
+        same bookkeeping as an injector-driven crash_osd (config +
+        durable store remembered for revive)."""
+        from ceph_tpu.utils.tasks import track_task
+
+        def fire(point: str) -> None:
+            async def _crash():
+                if self.osds.get(osd.osd_id) is osd:
+                    await self.crash_osd(osd.osd_id)
+
+            track_task(self._chaos_tasks,
+                       asyncio.get_event_loop().create_task(_crash()))
+
+        osd._chaos_crash_cb = fire
+
+    async def drain_chaos(self) -> None:
+        """Wait out in-flight crash-point teardowns (scenario runner
+        calls this before healing/reviving)."""
+        while self._chaos_tasks:
+            # teardown drain: each task's outcome is the crash itself
+            await asyncio.gather(*list(self._chaos_tasks),  # graftlint: ignore[swallowed-async-error]
+                                 return_exceptions=True)
 
     async def start_mds(self, meta_pool: int, data_pool: int,
                         rank: int = 0):
@@ -173,6 +204,7 @@ class Cluster:
         osd = OSDDaemon(osd_id, self.mon_addr, config=cfg, store=store)
         await osd.start()
         self.osds[osd_id] = osd
+        self._arm_chaos_crash(osd)
         return osd
 
     async def restart_osd(self, osd_id: int) -> OSDDaemon:
@@ -187,6 +219,7 @@ class Cluster:
                         store=store)
         await osd.start()
         self.osds[osd_id] = osd
+        self._arm_chaos_crash(osd)
         return osd
 
     async def wait_for_epoch(self, epoch: int, timeout: float = 10.0) -> None:
@@ -289,6 +322,7 @@ async def start_cluster(n_osds: int = 3, osds_per_host: int = 1,
                         store=store_factory(o) if store_factory else None)
         await osd.start()
         cluster.osds[o] = osd
+        cluster._arm_chaos_crash(osd)
     deadline = asyncio.get_event_loop().time() + 10
     while asyncio.get_event_loop().time() < deadline:
         if all(cluster.mon.osdmap.osd_up[o] for o in range(n_osds)):

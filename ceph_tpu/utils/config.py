@@ -94,6 +94,18 @@ OPTIONS: List[Option] = [
            "extra accumulation window (s) after a tick's first encode "
            "request; 0 = pure group-commit self-clocking (a lone op "
            "never waits)", min=0),
+    # unified pipelined commit frontier (round 12): EC RMW and
+    # replicated-pool mutations commit through the same split
+    # commit-start (under the PG lock) / ack-wait (lock released)
+    # path as round-11 pipelined EC full writes, all registered with
+    # the PG's commit frontier.  0 = the round-10 full-PG-lock commit
+    # for EVERY mutation — the serial bit-exactness anchor.
+    Option("osd_pipeline_writes", int, 1,
+           "pipeline mutation commits: hold the PG lock only for the "
+           "ordered commit section, await fan-out acks with it "
+           "released (EC full/RMW + replicated unified).  0 = legacy "
+           "full-lock serial commits (bisection anchor)",
+           min=0, max=1),
     Option("osd_op_complaint_time", float, 30.0,
            "ops blocked this long raise 'slow ops' warnings "
            "(reference osd_op_complaint_time; 0 disables)", min=0),
@@ -194,6 +206,31 @@ OPTIONS: List[Option] = [
            min=0, max=1),
     Option("chaos_clock_skew", float, 0.0,
            "seconds added to this daemon's time source"),
+    # batch-aware fault injection (round 12): per-item faults INSIDE a
+    # coalesced tick's frames, and named crash points at the
+    # tick/commit seams.  All-zero/empty defaults keep the no-op
+    # contract (mutate_batch is never consulted, _chaos_point is one
+    # falsy test).
+    Option("chaos_net_batch_item_drop", float, 0.0,
+           "per-item drop probability INSIDE a MOSDECSubOpWriteBatch "
+           "frame (the rest of the frame still delivers — a partial "
+           "tick on the wire)", min=0, max=1),
+    Option("chaos_net_batch_ack_dup", float, 0.0,
+           "per-entry duplication probability in a batched sub-write "
+           "ack (exercises per-responder ack dedup)", min=0, max=1),
+    Option("chaos_net_batch_ack_reorder", float, 0.0,
+           "probability of shuffling a batched ack's result order "
+           "(acks must be order-independent)", min=0, max=1),
+    Option("chaos_crash_point", str, "",
+           "named crash seam: the daemon power-cuts itself the next "
+           "time its write path passes this point (tick_mid_encode, "
+           "tick_post_encode, commit_pre_fanout, commit_mid_fanout, "
+           "frontier_open, frontier_pre_done, batch_apply_mid); "
+           "one-shot, '' = off"),
+    Option("chaos_crash_point_skip", int, 0,
+           "traversals of the armed crash point to let pass before "
+           "firing (seed-resolved by scenarios for deterministic "
+           "crash timing)", min=0),
 ]
 
 _BY_NAME = {o.name: o for o in OPTIONS}
@@ -244,6 +281,17 @@ class Config:
 
     def add_observer(self, fn: Callable[[str, Any], None]) -> None:
         self._observers.append(fn)
+
+    def remove_observer(self, fn: Callable[[str, Any], None]) -> None:
+        """Deregister an observer (daemon teardown).  Configs are
+        REUSED across daemon incarnations (vstart restart/revive keep
+        the per-daemon config so injected options survive bounces), so
+        a stop() that leaves its observers behind pins every dead
+        incarnation in memory for the config's lifetime."""
+        try:
+            self._observers.remove(fn)
+        except ValueError:
+            pass
 
     def auth_secret(self):
         """Messenger signing key, or None for auth 'none'."""

@@ -1,0 +1,25 @@
+"""Bad corpus for the swallowed-async-error rule: every shape fires."""
+
+import asyncio
+
+
+class Daemon:
+    async def bad_bare_except(self, conn):
+        try:
+            await conn.send(b"x")
+        except:  # noqa: E722  (also eats CancelledError)
+            pass
+
+    async def bad_broad_except(self, peers):
+        for p in peers:
+            try:
+                await p.send_sub_write()
+            except Exception:
+                pass  # a lost sub-op failure = a leaked un-acked shard
+
+    async def bad_gather_discarded(self, subs):
+        await asyncio.gather(*subs, return_exceptions=True)
+
+    async def bad_gather_unused_binding(self, subs):
+        results = await asyncio.gather(*subs, return_exceptions=True)
+        return None

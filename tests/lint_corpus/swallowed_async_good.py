@@ -1,0 +1,32 @@
+"""Good corpus for the swallowed-async-error rule: zero findings."""
+
+import asyncio
+
+
+class Daemon:
+    async def good_narrow_except(self, conn):
+        try:
+            await conn.send(b"x")
+        except (ConnectionError, OSError):
+            pass  # typed protocol decision, not a blanket swallow
+
+    async def good_observed_broad(self, peers):
+        for p in peers:
+            try:
+                await p.send_sub_write()
+            except Exception:
+                self.perf.inc("send_errors")
+
+    async def good_gather_consumed(self, subs):
+        results = await asyncio.gather(*subs, return_exceptions=True)
+        return sum(1 for r in results if isinstance(r, BaseException))
+
+    async def good_gather_raising(self, subs):
+        # no return_exceptions: failures propagate, nothing swallowed
+        await asyncio.gather(*subs)
+
+    def good_sync_function(self):
+        try:
+            self.close()
+        except Exception:
+            pass  # sync scope: outside this rule's async-handler remit

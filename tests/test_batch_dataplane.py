@@ -131,6 +131,9 @@ def test_commit_frontier_blocks_out_of_order_acks():
     v5, v6, v7 = (1, 5), (1, 6), (1, 7)
     for v in (v5, v6, v7):
         h._frontier_open(st, v)
+    # commit starts log before their acks: the head covers the opens
+    # (round 12: the watermark can never pass the log head)
+    st.last_update = v7
     # v6 acks first: watermark must NOT move (v5 still pending)
     h._frontier_done(st, v6, ok=True)
     assert st.last_complete == zero
@@ -143,6 +146,72 @@ def test_commit_frontier_blocks_out_of_order_acks():
     # v7 acks: contiguous prefix advances to 7
     h._frontier_done(st, v7, ok=True)
     assert st.last_complete == v7
+
+
+def test_frontier_rebuild_and_learn():
+    """Round-12 crash-restart reconstruction: logged entries above the
+    persisted watermark re-register as OPEN frontier entries, a
+    post-restart fully-acked write can NOT advance the watermark past
+    them, and an authoritative learn (peering roll-forward / primary
+    entry stream) resolves them — while a rewind drops them."""
+    from ceph_tpu.cluster.pg import PGLogMixin, PGState
+    from ceph_tpu.cluster.pglog import LogEntry, PGLog
+    from ceph_tpu.osdmap.osdmap import PGid
+    from ceph_tpu.utils import PerfCounters
+
+    class _Store:
+        def omap_get(self, coll, oid):
+            return {}
+
+        def queue_transaction(self, txn):
+            pass
+
+    class _Host(PGLogMixin):
+        def __init__(self):
+            self.store = _Store()
+            self.perf = PerfCounters("t")
+
+    h = _Host()
+    st = PGState(PGid(1, 0))
+    st.last_complete = (1, 5)
+    st.log = PGLog(entries=[
+        LogEntry(op="modify", oid=f"o{s}", version=(1, s))
+        for s in (4, 5, 6, 7, 8)])
+    st.last_update = (1, 8)
+    h._frontier_rebuild(st)
+    # only the entries ABOVE the persisted watermark are open
+    assert list(st.pipeline_pending) == [(1, 6), (1, 7), (1, 8)]
+    assert st.frontier_recovering == {(1, 6), (1, 7), (1, 8)}
+    # a new write fully acks out of order: watermark must NOT move
+    h._frontier_open(st, (1, 9))
+    st.last_update = (1, 9)
+    h._frontier_done(st, (1, 9), ok=True)
+    assert st.last_complete == (1, 5)
+    # ... but reads may serve the resolved entry (read-your-ack)
+    assert st.frontier_acked(9) and not st.frontier_acked(7)
+    # peering verified every member holds up to 7: 6,7 resolve; 8 stays
+    h._frontier_learn(st, (1, 7))
+    assert st.last_complete == (1, 7)
+    assert list(st.pipeline_pending) == [(1, 8), (1, 9)]
+    assert st.frontier_recovering == {(1, 8)}
+    # ... and verifying up to 8 sweeps straight through the resolved 9
+    h._frontier_learn(st, (1, 8))
+    assert st.last_complete == (1, 9)
+    assert not st.pipeline_pending and not st.frontier_recovering
+
+    # the rewind path, on a fresh reconstruction: divergent open
+    # entries leave the frontier with the log (they can never ack)
+    st2 = PGState(PGid(1, 1))
+    st2.last_complete = (1, 2)
+    st2.log = PGLog(entries=[
+        LogEntry(op="modify", oid=f"r{s}", version=(1, s))
+        for s in (3, 4)])
+    st2.last_update = (1, 4)
+    h._frontier_rebuild(st2)
+    assert set(st2.pipeline_pending) == {(1, 3), (1, 4)}
+    h.rewind_divergent_log(st2, (1, 3))
+    assert list(st2.pipeline_pending) == [(1, 3)]
+    assert st2.frontier_recovering == {(1, 3)}
 
 
 def test_fast_config_enables_batched_data_plane():
@@ -163,7 +232,9 @@ def test_fast_config_enables_batched_data_plane():
 async def _write_workload(cluster, concurrent: bool):
     """The shared workload: full writes across two EC profiles (a
     mixed-profile tick when concurrent) + an RMW partial write + a
-    1-op-tick straggler.  Returns {pool_name: (pool_id, [oids])}."""
+    1-op-tick straggler + a replicated pool (full, partial, append,
+    truncate, delete — the round-12 pipelined verbs).  Returns
+    {pool_name: (pool_id, [oids])}."""
     client = await cluster.client()
     pool_a = await client.pool_create(
         "bxa", "erasure", pg_num=4,
@@ -173,8 +244,11 @@ async def _write_workload(cluster, concurrent: bool):
         "bxb", "erasure", pg_num=4,
         ec_profile={"plugin": "jerasure", "technique": "reed_sol_van",
                     "k": "3", "m": "2"})
+    pool_r = await client.pool_create("bxr", "replicated", pg_num=4,
+                                      size=3)
     io_a = client.ioctx(pool_a)
     io_b = client.ioctx(pool_b)
+    io_r = client.ioctx(pool_r)
     rng = np.random.default_rng(42)
     jobs = []
     oids_a, oids_b = [], []
@@ -198,10 +272,28 @@ async def _write_workload(cluster, concurrent: bool):
     # RMW partial overwrite crossing a stripe boundary (no batch crc)
     patch = rng.integers(0, 256, 10000, dtype=np.uint8).tobytes()
     await io_a.write("obj_a0", patch, offset=5000, timeout=120)
+    # EC append + truncate: round-12 pipelined compound verbs
+    await io_a.append("obj_a1", b"\x5a" * 4096)
+    await io_a.truncate("obj_a2", 30000)
     # 1-op tick: a lone write with nothing to coalesce against
     await io_a.write_full("obj_a_solo", b"\xa5" * 20480, timeout=120)
     oids_a.append("obj_a_solo")
-    return client, {"bxa": (pool_a, oids_a), "bxb": (pool_b, oids_b)}
+    # replicated verbs through the same frontier path
+    oids_r = []
+    for i in range(3):
+        oid = f"obj_r{i}"
+        oids_r.append(oid)
+        await io_r.write_full(
+            oid, rng.integers(0, 256, 16384, dtype=np.uint8).tobytes(),
+            timeout=120)
+    await io_r.write("obj_r0", b"\x0f" * 777, offset=100, timeout=120)
+    await io_r.append("obj_r1", b"\xf0" * 512)
+    await io_r.truncate("obj_r2", 5000)
+    await io_r.write_full("obj_r_gone", b"bye" * 100, timeout=120)
+    await io_r.remove("obj_r_gone")
+    oids_r.append("obj_r_gone")  # snapshot proves absence on BOTH paths
+    return client, {"bxa": (pool_a, oids_a), "bxb": (pool_b, oids_b),
+                    "bxr": (pool_r, oids_r)}
 
 
 def _shard_snapshot(cluster, client, pools):
@@ -234,8 +326,11 @@ def test_coalesced_writes_bit_exact_vs_per_op_path():
     async def run_path(coalesced: bool):
         cfg = _fast_config()
         if not coalesced:
+            # the full round-10 serial anchor: per-op dispatch/encode
+            # AND full-PG-lock commits (no pipelined frontier)
             cfg.osd_op_shards = 0
             cfg.osd_batch_tick_ops = 0
+            cfg.osd_pipeline_writes = 0
         cluster = await start_cluster(5, config=cfg)
         try:
             client, pools = await _write_workload(

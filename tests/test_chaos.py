@@ -365,9 +365,15 @@ def test_incomplete_recovery_retries_without_map_change():
 
             osd._recover_pg_locked = flaky
             await osd._recover_pg(st)
-            for _ in range(100):
+            # converge-poll (round 12 deflake): wait for a COMPLETE
+            # round to clear the backoff too — under suite load the
+            # real rounds can keep coming up incomplete (2s peering
+            # query timeouts) well past the old 5s window
+            deadline = asyncio.get_event_loop().time() + 20.0
+            while asyncio.get_event_loop().time() < deadline:
                 if len(calls) >= 3 and \
-                        st.pgid not in osd._recovery_retry_tasks:
+                        st.pgid not in osd._recovery_retry_tasks and \
+                        st.pgid not in osd._recovery_backoffs:
                     break
                 await asyncio.sleep(0.05)
             assert len(calls) >= 3, "incomplete recovery never retried"

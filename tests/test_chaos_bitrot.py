@@ -62,8 +62,15 @@ def test_ec_shard_bitrot_detected_and_repaired_by_scrub():
             report = await posd.scrub_pg(posd.pgs[pgid])
             assert report["inconsistent"] == ["victim"]
             assert report["repaired"] == ["victim"]
-            await asyncio.sleep(0.2)
+            # converge-poll (round 12 deflake): the repair push applies
+            # asynchronously on the shard holder — poll instead of
+            # hoping a fixed sleep outlasts a loaded host
+            deadline = asyncio.get_event_loop().time() + 10.0
             healed = bytes(store.read(coll, "victim"))
+            while healed != clean_shard and \
+                    asyncio.get_event_loop().time() < deadline:
+                await asyncio.sleep(0.05)
+                healed = bytes(store.read(coll, "victim"))
             assert healed == clean_shard
             assert crcmod.crc32c(0xFFFFFFFF, healed) == stored_crc
             # clients read the original bytes end-to-end

@@ -240,6 +240,40 @@ def test_task_spawn_scoped_to_cluster():
     assert findings == []
 
 
+# ------------------------------------- rule: swallowed-async-error
+
+
+def test_swallowed_async_error_good_clean():
+    from ceph_tpu.analysis import async_errors
+
+    findings, _ = lint_files(
+        async_errors, "swallowed_async_good.py",
+        relpath_as="ceph_tpu/cluster/swallowed_async_good.py")
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_swallowed_async_error_bad_all_shapes_fire():
+    from ceph_tpu.analysis import async_errors
+
+    findings, _ = lint_files(
+        async_errors, "swallowed_async_bad.py",
+        relpath_as="ceph_tpu/cluster/swallowed_async_bad.py")
+    assert len(findings) == 4, [f.render() for f in findings]
+    msgs = "\n".join(f.message for f in findings)
+    assert "bare 'except:'" in msgs
+    assert "'except Exception:'" in msgs
+    assert "result discarded" in msgs
+    assert "bound to 'results' but never read" in msgs
+    assert all(f.rule == "swallowed-async-error" for f in findings)
+
+
+def test_swallowed_async_error_scoped_to_cluster():
+    from ceph_tpu.analysis import async_errors
+
+    findings, _ = lint_files(async_errors, "swallowed_async_bad.py")
+    assert findings == []
+
+
 def test_rpc_timeout_good_clean():
     from ceph_tpu.analysis import rpc_timeout
 

@@ -127,10 +127,17 @@ def test_mclock_limit_sheds_expired_ops_at_dequeue():
                 return 1
 
             acked = sum(await asyncio.gather(*[put(i) for i in range(6)]))
-            # let the drain loop's dead-work purge sweep the expired
-            # tail (it wakes at most 0.25s after the deadlines pass)
-            await asyncio.sleep(0.8)
-            shed = _sum_counter(cluster, "osd_ops_shed_expired")
+            # converge-poll (round 12 deflake): wait for the drain
+            # loop's dead-work purge to sweep the expired tail instead
+            # of a fixed sleep — on a loaded host the purge wake can
+            # slip well past its nominal 0.25s cadence
+            deadline = loop.time() + 10.0
+            shed = 0
+            while loop.time() < deadline:
+                shed = _sum_counter(cluster, "osd_ops_shed_expired")
+                if shed > 0:
+                    break
+                await asyncio.sleep(0.05)
             return acked, shed, late_acks
         finally:
             await cluster.stop()

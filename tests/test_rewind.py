@@ -54,6 +54,12 @@ def test_ec_partial_write_rolls_back():
         # stay strict.
         cfg.osd_heartbeat_grace = 30.0
         cfg.mon_osd_beacon_grace = 30.0
+        # ... and pin BACKGROUND recovery out of the window too: an
+        # incomplete boot-time round arms a delayed retry that can
+        # fire mid-doomed-write and rewind the divergent entry before
+        # the intermediate asserts observe it (round 12 retries rounds
+        # more eagerly).  The test drives peering explicitly.
+        cfg.osd_recovery_delay_start = 300.0
         cluster = await start_cluster(3, config=cfg)
         try:
             client = await cluster.client()
