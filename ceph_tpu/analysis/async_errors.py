@@ -35,6 +35,10 @@ from ceph_tpu.analysis.engine import Finding, LintContext
 
 RULE = "swallowed-async-error"
 
+# round 13: graft-load's async driver joined the scope — a load window
+# that silently eats op failures reports a goodput it never served
+SCOPE = ("ceph_tpu/cluster/", "ceph_tpu/load/")
+
 _BROAD = ("Exception", "BaseException")
 
 
@@ -105,7 +109,7 @@ def _nearest_fn(node: ast.AST, parents) -> Optional[ast.AST]:
 def check(modules, ctx: LintContext) -> List[Finding]:
     findings: List[Finding] = []
     for m in modules:
-        if not m.relpath.startswith("ceph_tpu/cluster/"):
+        if not m.relpath.startswith(SCOPE):
             continue
         parents = _parents(m.tree)
         for sym, fn in walk_functions(m.tree):

@@ -29,6 +29,10 @@ RULE = "rpc-timeout"
 # call names (last dotted segment) that mint RPC futures in cluster code
 _FUT_MAKERS = frozenset({"create_future", "_make_waiter"})
 
+# round 13: graft-load's async driver joined the scope (a hung wait in
+# the driver wedges the whole offered-load window the same way)
+SCOPE = ("ceph_tpu/cluster/", "ceph_tpu/load/")
+
 
 def _future_names(fn: ast.AsyncFunctionDef) -> set:
     """Names assigned from a future-constructing call anywhere in the
@@ -68,7 +72,7 @@ def _future_names(fn: ast.AsyncFunctionDef) -> set:
 def check(modules, ctx: LintContext) -> List[Finding]:
     findings: List[Finding] = []
     for m in modules:
-        if not m.relpath.startswith("ceph_tpu/cluster/"):
+        if not m.relpath.startswith(SCOPE):
             continue
         for sym, fn in walk_functions(m.tree):
             if not isinstance(fn, ast.AsyncFunctionDef):

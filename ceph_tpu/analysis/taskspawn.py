@@ -24,6 +24,10 @@ It is flagged when the result is
 - fed straight into ``.append(...)`` / ``.add(...)`` (a grow-only
   registry with no discard path), or
 - assigned to a name the function never touches again.
+
+Round 13: the scope grew to ``ceph_tpu/load/`` — the open-loop driver
+spawns one task per planned op, exactly the per-op shape this rule
+polices.
 """
 
 from __future__ import annotations
@@ -35,6 +39,10 @@ from ceph_tpu.analysis.astutil import dotted, walk_functions
 from ceph_tpu.analysis.engine import Finding, LintContext
 
 RULE = "task-spawn"
+
+# async daemon/driver code the rule polices (tests and scripts are
+# callers, not long-lived event-loop residents)
+SCOPE = ("ceph_tpu/cluster/", "ceph_tpu/load/")
 
 FIX = ("route it through a self-discarding tracker (the messenger "
        "_track pattern: set.add + add_done_callback(discard)) or a "
@@ -110,7 +118,7 @@ def _nearest_fn(node: ast.AST,
 def check(modules, ctx: LintContext) -> List[Finding]:
     findings: List[Finding] = []
     for m in modules:
-        if not m.relpath.startswith("ceph_tpu/cluster/"):
+        if not m.relpath.startswith(SCOPE):
             continue
         parents = _parents(m.tree)
         for sym, fn in walk_functions(m.tree):

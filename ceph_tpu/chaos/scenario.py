@@ -233,24 +233,10 @@ def _payload(rng, oid: str, gen: int, repeat: int) -> bytes:
     return tag.encode() * repeat
 
 
-_ZIPF_CUM: Dict[Tuple[int, float], List[float]] = {}
-
-
-def _zipf_pick(rng, n: int, alpha: float = 1.2) -> int:
-    """Rank drawn from a zipfian over [0, n): a few hot objects take
-    most writes (the million-client hot-set shape, ROADMAP item 4).
-    Cumulative weights are precomputed per (n, alpha) — one rng draw
-    and a binary search per pick, same stream consumption as the
-    linear scan it replaces (seed replay unaffected)."""
-    import bisect
-    from itertools import accumulate
-
-    cum = _ZIPF_CUM.get((n, alpha))
-    if cum is None:
-        cum = _ZIPF_CUM[(n, alpha)] = list(accumulate(
-            1.0 / ((r + 1) ** alpha) for r in range(n)))
-    x = rng.random() * cum[-1]
-    return min(bisect.bisect_left(cum, x), n - 1)
+# the one seeded zipfian sampler lives in load/dist.py (round 13);
+# same stream consumption (one rng.random() per pick), so seeded
+# scenarios recorded before the move replay bit-identically
+from ceph_tpu.load.dist import zipf_pick as _zipf_pick  # noqa: E402
 
 
 def _store_factory(scenario: Scenario, tmpdir: Optional[str]):
@@ -268,6 +254,64 @@ def _store_factory(scenario: Scenario, tmpdir: Optional[str]):
         return BlueStore(path, size=64 << 20, checkpoint_every=64)
 
     return factory
+
+
+async def heal_cluster(cluster, dmn: DaemonInjector) -> None:
+    """Fault-free the cluster before judging: crash-point teardowns
+    still in flight must finish first (or the revive sweep races a
+    daemon mid-power-cut), every injector rate zeroes, and the dead
+    revive with whatever durable store survived them.  Shared with the
+    graft-load soak runner — one heal sequence, not two."""
+    await cluster.drain_chaos()
+    zero_rates(cluster)
+    for osd_id in sorted(set(cluster.osd_configs) - set(cluster.osds)):
+        await dmn.revive_osd(osd_id,
+                             with_store=osd_id in cluster.osd_stores)
+
+
+async def judge_invariants(cluster, dmn: DaemonInjector, io,
+                           invariants, acked: Dict[str, bytes],
+                           attempted: Optional[Dict[str, set]] = None,
+                           mode: str = "acked", timeout: float = 60.0,
+                           acked_crcs: Optional[Dict[str, int]] = None,
+                           snaps: Optional[Dict] = None,
+                           deadline_misses: Optional[List[str]] = None,
+                           ) -> List[str]:
+    """THE invariant dispatch table, shared by chaos scenarios and
+    graft-load soaks (an invariant added here is immediately nameable
+    from both; a soak naming one this table lacks fails loudly)."""
+    failures: List[str] = []
+    for name in invariants:
+        if name == "durability":
+            failures += await inv.check_durability(
+                io, acked, attempted=attempted, mode=mode,
+                acked_crcs=acked_crcs, timeout=timeout)
+        elif name == "health":
+            failures += await inv.check_health(cluster, timeout=timeout)
+        elif name == "acting":
+            failures += await inv.check_acting(cluster, timeout=timeout)
+        elif name == "snapshots":
+            failures += await inv.check_snapshots(io, snaps or {},
+                                                  timeout=timeout)
+        elif name == "scrub":
+            failures += await inv.check_scrub(cluster,
+                                              timeout=timeout * 1.5)
+        elif name == "lockdep":
+            failures += inv.check_lockdep()
+        elif name == "deadline":
+            # recorded inline by the workload driver: every ack past
+            # its client deadline is one failure line
+            failures += list(deadline_misses or ())
+        elif name == "shed":
+            failures += inv.check_shed(cluster)
+        elif name == "frontier":
+            failures += await inv.check_frontier(
+                cluster, marks=dmn.frontier_marks, timeout=timeout)
+        elif name == "batch":
+            failures += inv.check_batch(cluster)
+        else:
+            failures.append(f"unknown invariant {name!r}")
+    return failures
 
 
 async def run_scenario(scenario: Scenario, seed: int,
@@ -379,53 +423,14 @@ async def run_scenario(scenario: Scenario, seed: int,
                 sid = await io.snap_create(f"chaos_s{rnd}")
                 snaps[sid] = dict(acked)
 
-        # -- heal: scenarios must converge fault-free -------------------
-        # crash-point teardowns still in flight must finish first, or
-        # the revive sweep below races a daemon mid-power-cut
-        await cluster.drain_chaos()
-        zero_rates(cluster)
-        for osd_id in sorted(set(cluster.osd_configs) -
-                             set(cluster.osds)):
-            await dmn.revive_osd(osd_id,
-                                 with_store=osd_id in cluster.osd_stores)
+        # -- heal + converge + judge (shared with graft-load soak) ------
+        await heal_cluster(cluster, dmn)
         await _converge(cluster, scenario.converge_timeout)
-
-        # -- invariants -------------------------------------------------
-        for name in scenario.invariants:
-            if name == "durability":
-                failures += await inv.check_durability(
-                    io, acked, attempted=attempted,
-                    mode=scenario.durability_mode,
-                    acked_crcs=acked_crcs,
-                    timeout=scenario.converge_timeout)
-            elif name == "health":
-                failures += await inv.check_health(
-                    cluster, timeout=scenario.converge_timeout)
-            elif name == "acting":
-                failures += await inv.check_acting(
-                    cluster, timeout=scenario.converge_timeout)
-            elif name == "snapshots":
-                failures += await inv.check_snapshots(
-                    io, snaps, timeout=scenario.converge_timeout)
-            elif name == "scrub":
-                failures += await inv.check_scrub(
-                    cluster, timeout=scenario.converge_timeout * 1.5)
-            elif name == "lockdep":
-                failures += inv.check_lockdep()
-            elif name == "deadline":
-                # recorded inline by put(): every ack past its client
-                # deadline is one failure line
-                failures += deadline_misses
-            elif name == "shed":
-                failures += inv.check_shed(cluster)
-            elif name == "frontier":
-                failures += await inv.check_frontier(
-                    cluster, marks=dmn.frontier_marks,
-                    timeout=scenario.converge_timeout)
-            elif name == "batch":
-                failures += inv.check_batch(cluster)
-            else:
-                failures.append(f"unknown invariant {name!r}")
+        failures += await judge_invariants(
+            cluster, dmn, io, scenario.invariants, acked,
+            attempted=attempted, mode=scenario.durability_mode,
+            timeout=scenario.converge_timeout, acked_crcs=acked_crcs,
+            snaps=snaps, deadline_misses=deadline_misses)
     finally:
         await cluster.stop()
     counters1 = CHAOS.dump()["chaos"]
@@ -543,6 +548,14 @@ async def _converge(cluster, timeout: float) -> None:
                                          asyncio.get_event_loop().time()))
     except TimeoutError:
         pass
+
+
+# public seams for graft-load soak composition (round 13): the soak
+# runner applies the SAME resolved fault plans through the same
+# machinery, so "load + chaos" is composition, not reimplementation
+apply_event = _apply_event
+wait_converged = _converge
+store_factory_for = _store_factory
 
 
 # --------------------------------------------------------------- builtins

@@ -161,6 +161,9 @@ class ClientOpsMixin:
                 claim = self._admit_release_accounting(e_msg)
                 admitted = self._admit_op(msg)
                 self.perf.inc("osd_qos_preempted")
+                # the raw dmclock eviction stat rides the perf path
+                # (round 13): scrape-visible, not just dump_dmclock
+                self.perf.set("osd_qos_evicted", evq.evicted_total())
                 if claim is not None:
                     await claim[0].release(claim[1])
                 try:
@@ -376,6 +379,8 @@ class ClientOpsMixin:
                           self._opq.stats["served_reservation"])
             self.perf.set("osd_qos_served_spare",
                           self._opq.stats["served_spare"])
+            self.perf.set("osd_qos_evicted",
+                          self._opq.stats["evicted"])
             if time.monotonic() - stamp > self.config.osd_client_op_timeout:
                 # the client abandoned this attempt and resent: executing
                 # the stale copy would double-apply the op

@@ -158,6 +158,9 @@ class DmClockQueue:
         self.stats["evicted"] += 1
         return item
 
+    def evicted_total(self) -> int:
+        return self.stats["evicted"]
+
     def purge(self, predicate) -> List[object]:
         """Remove and return every queued item satisfying ``predicate``
         (dead-work shedding: an op whose deadline passed must not wait

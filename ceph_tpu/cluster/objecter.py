@@ -93,6 +93,42 @@ class Objecter(Dispatcher):
         self._cwnd_event = asyncio.Event()
         self._pushback_backoff = ExpBackoff(
             base=0.02, cap=1.0, rng=self._backoff_rng("pushback"))
+        self._ops_acked = 0
+
+    # -- client telemetry on the mgr Prometheus path (round 13) ------------
+
+    def flow_counters(self) -> Dict[str, int]:
+        """Client-side flow-control telemetry: the AIMD congestion
+        window state the graft-load SLO judge grades ("converged, not
+        collapsed") — exported through the mgr so it rides the SAME
+        Prometheus scrape as the daemon counters."""
+        return {
+            "client_cwnd": self.cwnd.limit,
+            "client_cwnd_pushbacks": self.cwnd.pushbacks,
+            "client_inflight_ops": self._cwnd_inflight,
+            "client_ops_acked": self._ops_acked,
+        }
+
+    async def mgr_report(self) -> bool:
+        """Push this client's counters to the active mgr (the client
+        half of MgrClient::send_report; daemons stream theirs from the
+        heartbeat loop).  Clients have no beacon loop, so consumers —
+        the load driver's telemetry loop, tests — call this at their
+        own cadence.  False when no mgr is published in the map."""
+        import time as _time
+
+        m = self.osdmap
+        addr = getattr(m, "mgr_addr", None) if m is not None else None
+        if not addr:
+            return False
+        try:
+            await self.messenger.send_message(M.MMgrReport(
+                daemon=f"client.{self.display_name}",
+                counters=self.flow_counters(),
+                stamp=_time.monotonic()), tuple(addr))
+            return True
+        except (ConnectionError, OSError, RuntimeError):
+            return False
 
     def _backoff_rng(self, tag: str):
         """Seeded jitter stream when the client carries a chaos seed
@@ -382,6 +418,7 @@ class Objecter(Dispatcher):
                         continue
                     if reply.result != -11:  # not misdirected
                         self.cwnd.on_ack()
+                        self._ops_acked += 1
                         self._pushback_backoff.reset()
                         self._record_reply_tail(reply)
                         return reply
@@ -618,19 +655,26 @@ class IoCtx:
             raise IOError(f"read({oid}) -> {reply.result}: {reply.data}")
         return reply.data
 
-    async def remove(self, oid: str) -> None:
+    async def remove(self, oid: str, timeout: float = None) -> None:
         reply = await self.objecter.op_submit(self.pool_id, oid,
                                               [("delete", {})],
+                                              timeout=timeout,
                                               snapc=self._write_snapc())
+        if reply.result == -2:
+            # -ENOENT maps like read/stat: callers that tolerate a
+            # missing object catch FileNotFoundError, not a generic
+            # IOError (rbd.remove's journal cleanup relies on this)
+            raise FileNotFoundError(oid)
         if reply.result != 0:
             raise IOError(f"remove({oid}) -> {reply.result}")
 
-    async def append(self, oid: str, data: bytes) -> int:
+    async def append(self, oid: str, data: bytes,
+                     timeout: float = None) -> int:
         """Atomic append; returns the offset the data landed at
         (reference rados_append)."""
         reply = await self.objecter.op_submit(
             self.pool_id, oid, [("append", {"data": bytes(data)})],
-            snapc=self._write_snapc())
+            timeout=timeout, snapc=self._write_snapc())
         if reply.result != 0:
             raise IOError(f"append({oid}) -> {reply.result}")
         return reply.data

@@ -749,6 +749,12 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         self.perf.add_u64("osd_qos_served_spare",
                           desc="dmclock dequeues served from spare "
                                "capacity by weight tag")
+        self.perf.add_u64("osd_qos_evicted",
+                          desc="queued requests shed by dmclock "
+                               "eviction (raw queue stat, round 13: "
+                               "mirrored to the perf/Prometheus path "
+                               "so the graft-load SLO judge sees it "
+                               "on the scrape)")
         self.perf.add_u64("osd_admit_ops_in_use",
                           desc="admission op budget currently in use")
         self.perf.add_u64("osd_admit_bytes_in_use",

@@ -109,6 +109,10 @@ class ShardedOpWQ:
                     return v
         return None
 
+    def evicted_total(self) -> int:
+        return sum(sh.opq.stats["evicted"] for sh in self.shards
+                   if sh.opq is not None)
+
     def set_client(self, client: str, spec) -> None:
         for sh in self.shards:
             if sh.opq is not None:
@@ -207,6 +211,7 @@ class ShardedOpWQ:
                     "osd_qos_served_spare",
                     sum(s.opq.stats["served_spare"]
                         for s in self.shards))
+                osd.perf.set("osd_qos_evicted", self.evicted_total())
             # tick boundary: let the dispatched ops run (and the next
             # arrivals land) before draining more
             await asyncio.sleep(0)

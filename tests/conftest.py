@@ -35,6 +35,17 @@ jax.config.update(
 import pytest  # noqa: E402
 
 
+def pytest_collection_modifyitems(items):
+    """``soak`` is slow-implied (pytest.ini): every soak-marked test
+    also gets ``slow``, so the tier-1 gate's ``-m 'not slow'`` always
+    deselects soaks without each test having to remember both marks —
+    a soak accidentally landing on the bench hot path would violate
+    the BENCH_NOTES round-13 contract."""
+    for item in items:
+        if "soak" in item.keywords and "slow" not in item.keywords:
+            item.add_marker(pytest.mark.slow)
+
+
 @pytest.fixture(autouse=True)
 def _lockdep_reset():
     """Reset the global lockdep state between tests: ordering edges are

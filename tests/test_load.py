@@ -1,0 +1,392 @@
+"""graft-load gates: driver determinism, SLO judge math, the tier-1
+load smoke (toy scale, every gate from scraped telemetry, bit-identical
+replay), CLI exit codes, and the slow soak scenarios.
+
+The replay test IS the acceptance criterion (round 13): the same seed
+must produce an identical per-client op plan (``plan_key``) across two
+independent runs, and the smoke window must pass every SLO gate.
+"""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ceph_tpu.load import slo
+from ceph_tpu.load.dist import (
+    arrival_offsets,
+    client_stream,
+    pick_weighted,
+    zipf_pick,
+)
+from ceph_tpu.load.driver import (
+    LoadResult,
+    LoadSpec,
+    build_plan,
+    builtin_specs,
+    plan_key,
+    run_load,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(REPO, "scripts", "load.py")
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+# ------------------------------------------------------------ dist unit
+
+
+def test_arrival_offsets_deterministic_and_bounded():
+    for process in ("poisson", "fixed"):
+        a = arrival_offsets(client_stream(7, 3), 5.0, 2.0, process)
+        b = arrival_offsets(client_stream(7, 3), 5.0, 2.0, process)
+        assert a == b
+        assert a == sorted(a)
+        assert all(0 <= t < 2.0 for t in a)
+    # fixed-rate is evenly spaced at 1/rate after the seeded phase
+    f = arrival_offsets(client_stream(7, 3), 5.0, 2.0, "fixed")
+    gaps = {round(y - x, 9) for x, y in zip(f, f[1:])}
+    assert gaps == {round(1 / 5.0, 9)}
+    assert arrival_offsets(client_stream(1, 1), 0.0, 2.0) == []
+    with pytest.raises(ValueError):
+        arrival_offsets(client_stream(1, 1), 1.0, 1.0, "bogus")
+
+
+def test_zipf_pick_single_draw_stream_contract():
+    """One rng.random() call per pick — the chaos seed-replay contract
+    the sampler carried when it lived in chaos/scenario.py."""
+    import random
+
+    a, b = random.Random(123), random.Random(123)
+    picks = [zipf_pick(a, 64) for _ in range(50)]
+    for _ in range(50):
+        b.random()
+    assert a.getstate() == b.getstate()
+    # hot-set shape: rank 0 dominates a long tail
+    many = [zipf_pick(random.Random(5), 64) for _ in range(1)]
+    r = random.Random(5)
+    many = [zipf_pick(r, 64) for _ in range(2000)]
+    assert many.count(0) > many.count(10) > 0
+
+
+def test_chaos_scenario_reuses_load_zipf():
+    """Exactly one seeded zipfian implementation in the repo."""
+    from ceph_tpu.chaos import scenario
+
+    assert scenario._zipf_pick is zipf_pick
+
+
+def test_pick_weighted_deterministic_and_skips_zero():
+    rng = client_stream(9, 0)
+    choices = (("a", 1.0), ("b", 0.0), ("c", 3.0))
+    picks = [pick_weighted(rng, choices) for _ in range(200)]
+    assert "b" not in picks
+    assert picks.count("c") > picks.count("a") > 0
+
+
+# ------------------------------------------------------- plan determinism
+
+
+def test_plan_replays_bit_identical_and_varies_with_seed():
+    spec = builtin_specs()["smoke"]
+    p1, p2 = build_plan(spec, 42), build_plan(spec, 42)
+    assert p1 == p2
+    assert plan_key(p1) == plan_key(p2)
+    keys = {plan_key(build_plan(spec, s)) for s in range(6)}
+    assert len(keys) == 6
+    # per-client streams: client k's ops are identical whether or not
+    # other clients exist (adding clients never shifts earlier ones)
+    import dataclasses
+
+    fewer = dataclasses.replace(spec, clients=8)
+    assert build_plan(fewer, 42) == build_plan(spec, 42)[:8]
+
+
+# ---------------------------------------------------------- slo judge math
+
+
+def test_parse_prometheus_and_counter_math():
+    text = (
+        "# TYPE ceph_osd_client_ops untyped\n"
+        'ceph_osd_client_ops{daemon="osd.0"} 10\n'
+        'ceph_osd_client_ops{daemon="osd.1"} 5\n'
+        'ceph_client_cwnd{daemon="client.load0"} 256\n')
+    prom = slo.parse_prometheus(text)
+    snap = slo.TelemetrySnapshot(prom=prom, health={}, dmclock={})
+    assert slo.counter_sum(snap, "ceph_osd_client_ops") == 15
+    assert slo.counter_sum(snap, "ceph_client_cwnd",
+                           daemon_prefix="client.") == 256
+
+
+def _hist_snap(buckets):
+    rows = []
+    for daemon, per_le in buckets.items():
+        for le, cum in per_le.items():
+            rows.append(({"daemon": daemon, "le": le}, cum))
+    return slo.TelemetrySnapshot(
+        prom={"ceph_osd_op_lat_hist_bucket": rows}, health={},
+        dmclock={})
+
+
+def test_hist_quantile_from_cumulative_bucket_deltas():
+    before = _hist_snap({"osd.0": {"0.002": 0, "0.004": 0, "+Inf": 0}})
+    after = _hist_snap({"osd.0": {"0.002": 90, "0.004": 100,
+                                  "+Inf": 100}})
+    # p50 lands in the first bucket, p99 in the second
+    assert slo.hist_quantile(before, after,
+                             "ceph_osd_op_lat_hist", 0.5) == 0.002
+    assert slo.hist_quantile(before, after,
+                             "ceph_osd_op_lat_hist", 0.99) == 0.004
+    # no samples in the window -> None (the gate fails honestly)
+    assert slo.hist_quantile(after, after,
+                             "ceph_osd_op_lat_hist", 0.99) is None
+    # quantile in the +Inf bucket -> inf, NEVER clamped to the top
+    # finite bound (an unbounded tail must fail a <= ceiling gate)
+    spill = _hist_snap({"osd.0": {"0.002": 90, "0.004": 95,
+                                  "+Inf": 100}})
+    assert slo.hist_quantile(before, spill,
+                             "ceph_osd_op_lat_hist",
+                             0.99) == float("inf")
+    from ceph_tpu.load.driver import builtin_specs
+
+    rep = slo.judge(builtin_specs()["smoke"], _mk_result(offered=100),
+                    before, spill)
+    p99 = {r["gate"]: r for r in rep.rows}["p99"]
+    assert not p99["passed"]
+    assert p99["value"] == "+Inf"
+
+
+def _mk_snap(ops=0, cwnd=256, pushbacks=0, hist=None, checks=None,
+             mclock=False, res=0, evicted=0):
+    prom = {
+        "ceph_osd_client_ops": [({"daemon": "osd.0"}, ops)],
+        "ceph_client_cwnd": [({"daemon": "client.load0"}, cwnd)],
+        "ceph_client_cwnd_pushbacks": [({"daemon": "client.load0"},
+                                        pushbacks)],
+        "ceph_osd_qos_served_reservation": [({"daemon": "osd.0"}, res)],
+        "ceph_osd_qos_evicted": [({"daemon": "osd.0"}, evicted)],
+    }
+    if hist:
+        prom["ceph_osd_op_lat_hist_bucket"] = [
+            ({"daemon": "osd.0", "le": le}, cum)
+            for le, cum in hist.items()]
+    return slo.TelemetrySnapshot(
+        prom=prom, health={"status": "HEALTH_OK",
+                           "checks": checks or {}},
+        dmclock={"osd.0": {"enabled": mclock}})
+
+
+def _mk_result(offered=100, late=0):
+    r = LoadResult(spec_name="x", seed=1, plan_key="k", offered=offered)
+    r.late_acks = ["late"] * late
+    return r
+
+
+def test_judge_all_gates_pass_and_fail_paths():
+    spec = builtin_specs()["smoke"]
+    before = _mk_snap(ops=0, hist={"0.002": 0, "+Inf": 0})
+    good = _mk_snap(ops=100, hist={"0.002": 100, "+Inf": 100})
+    rep = slo.judge(spec, _mk_result(), before, good)
+    assert rep.passed, rep.failures()
+    by = {r["gate"]: r for r in rep.rows}
+    assert by["goodput"]["value"] == 100
+    assert by["p99"]["value"] == 2.0       # 0.002s -> ms
+    assert by["qos"]["passed"]             # counters exported
+
+    # goodput below the floor fails
+    rep = slo.judge(spec, _mk_result(offered=1000), before, good)
+    assert not rep.passed
+    assert not {r["gate"]: r for r in rep.rows}["goodput"]["passed"]
+
+    # collapsed cwnd after pushbacks fails; wide-open passes
+    collapsed = _mk_snap(ops=100, cwnd=1, pushbacks=40,
+                         hist={"0.002": 100, "+Inf": 100})
+    rep = slo.judge(spec, _mk_result(), before, collapsed)
+    assert not {r["gate"]: r for r in rep.rows}["cwnd"]["passed"]
+
+    # SLOW_OPS raised at window end fails the health gate
+    slow = _mk_snap(ops=100, hist={"0.002": 100, "+Inf": 100},
+                    checks={"SLOW_OPS": "3 slow ops"})
+    rep = slo.judge(spec, _mk_result(), before, slow)
+    assert not {r["gate"]: r for r in rep.rows}["health"]["passed"]
+
+    # an ack past its deadline fails the client-observed gate
+    rep = slo.judge(spec, _mk_result(late=1), before, good)
+    assert not {r["gate"]: r for r in rep.rows}["deadline"]["passed"]
+
+    # declared qos contention requires reservation-driven dequeues
+    import dataclasses
+
+    qspec = dataclasses.replace(
+        spec, gates=spec.gates[:-1] + (("qos_reservation_min", 1.0),))
+    idle = _mk_snap(ops=100, hist={"0.002": 100, "+Inf": 100},
+                    mclock=True, res=0)
+    rep = slo.judge(qspec, _mk_result(), before, idle)
+    assert not {r["gate"]: r for r in rep.rows}["qos"]["passed"]
+
+
+# ------------------------------------------------------- tier-1 load smoke
+
+
+def test_load_smoke_all_gates_and_bit_identical_replay():
+    """The round-13 tier-1 gate: ~64 simulated clients over a 4-session
+    pool pass every SLO gate, judged from scraped telemetry, and the
+    run replays bit-identically from its seed."""
+    spec = builtin_specs()["smoke"]
+
+    async def one():
+        return await run_load(spec, 42)
+
+    r1, rep1 = run(one())
+    r2, rep2 = run(one())
+    assert rep1.passed, rep1.failures()
+    assert rep2.passed, rep2.failures()
+    # bit-identical replay: same seed -> same plan, same offered count
+    assert r1.plan_key == r2.plan_key
+    assert r1.offered == r2.offered == 180
+    gates = {r["gate"] for r in rep1.rows}
+    assert gates == {"goodput", "p99", "cwnd", "qos", "health",
+                     "deadline"}
+    # every scrape-side gate really had scrape data behind it
+    by = {r["gate"]: r for r in rep1.rows}
+    assert by["goodput"]["value"] >= r1.offered * 0.5
+    assert by["p99"]["value"] is not None
+    assert by["cwnd"]["value"] is not None    # client counters scraped
+
+
+def test_mgr_scrape_carries_client_and_qos_counters():
+    """Satellite proof: the client AIMD window and the dmclock eviction
+    stat are visible on the mgr Prometheus path (not only in per-daemon
+    dumps)."""
+    from ceph_tpu.load.driver import LoadContext, drive
+
+    spec = builtin_specs()["smoke-micro"]
+
+    async def scenario():
+        ctx = await LoadContext.create(spec, 5)
+        try:
+            await drive(ctx, spec, 5)
+            await asyncio.sleep(0.4)
+            text = await ctx.cluster.daemon_command(
+                "mgr", "prometheus metrics")
+        finally:
+            await ctx.close()
+        return text
+
+    text = run(scenario())
+    assert 'ceph_client_cwnd{daemon="client.load0"}' in text
+    assert "ceph_client_cwnd_pushbacks" in text
+    assert "ceph_osd_qos_evicted" in text
+    assert "ceph_osd_qos_served_reservation" in text
+
+
+# --------------------------------------------------------------- CLI gates
+
+
+def _cli(*args):
+    return subprocess.run([sys.executable, CLI, *args],
+                          capture_output=True, text=True, cwd=REPO)
+
+
+def test_cli_plan_deterministic_and_unknown_spec():
+    p1 = _cli("plan", "--spec", "smoke", "--seed", "42")
+    p2 = _cli("plan", "--spec", "smoke", "--seed", "42")
+    assert p1.returncode == 0, p1.stderr
+    assert p1.stdout == p2.stdout
+    doc = json.loads(p1.stdout)
+    assert doc["offered_ops"] == 180
+    assert len(doc["replay_key"]) == 64
+    bad = _cli("plan", "--spec", "nope", "--seed", "1")
+    assert bad.returncode == 2
+    badsoak = _cli("soak", "--scenario", "nope")
+    assert badsoak.returncode == 2
+
+
+def test_cli_run_exit_codes_gates_pass_and_fail():
+    """gates-pass=0, gate-fail!=0 — the chaos/trace CLI contract."""
+    ok = _cli("run", "--spec", "smoke-micro", "--seed", "3")
+    assert ok.returncode == 0, ok.stdout + ok.stderr
+    assert "ALL GATES PASS" in ok.stdout
+    fail = _cli("run", "--spec", "smoke-micro", "--seed", "3",
+                "--gate", "p99_ms=0.0001")
+    assert fail.returncode == 1, fail.stdout + fail.stderr
+    assert "FAIL p99" in fail.stdout
+    typo = _cli("run", "--spec", "smoke-micro", "--seed", "3",
+                "--gate", "goodput=1")
+    assert typo.returncode == 2, typo.stdout + typo.stderr
+    assert "unknown gate" in typo.stderr
+
+
+def test_cli_report_reads_artifact(tmp_path):
+    doc = {"kind": "graft-load ramp", "spec": "t", "seed": 1,
+           "mode": "cluster_vstart", "vs_baseline": None,
+           "session_only": True,
+           "steps": [{"scale": 1, "offered_ops_s": 10.0,
+                      "offered_ops": 10, "acked_ops_scraped": 10.0,
+                      "p99_ms": 2.0, "passed": True, "gates": []}],
+           "knee": {"scale": 1, "offered_ops_s": 10.0,
+                    "acked_ops_scraped": 10.0, "p99_ms": 2.0}}
+    path = tmp_path / "LOAD_r99.json"
+    path.write_text(json.dumps(doc))
+    out = _cli("report", str(path))
+    assert out.returncode == 0, out.stderr
+    assert "knee: 10.0 offered ops/s" in out.stdout
+    missing = _cli("report", str(tmp_path / "nope.json"))
+    assert missing.returncode == 2
+
+
+# ------------------------------------------------------------ slow / soak
+
+
+@pytest.mark.slow
+def test_ramp_finds_knee_with_trust_stamps(tmp_path):
+    """A short ramp emits an artifact whose every row carries the
+    trust-model stamps (NULL vs_baseline, session-only)."""
+    from ceph_tpu.load.ramp import format_table, ramp, write_artifact
+
+    spec = builtin_specs()["smoke-micro"]
+    doc = run(ramp(spec, 21, scales=(1, 2)))
+    assert doc["vs_baseline"] is None
+    assert doc["session_only"] and doc["load_sensitive_host"]
+    assert doc["mode"] == "cluster_vstart"
+    assert doc["knee"] is not None
+    assert all("gates" in s for s in doc["steps"])
+    path = write_artifact(doc, out=str(tmp_path / "LOAD_rt.json"))
+    assert os.path.exists(path)
+    assert "knee:" in format_table(doc)
+
+
+@pytest.mark.soak
+def test_soak_mixed_crash_invariants():
+    """The round-13 acceptance soak: sustained mixed-verb EC traffic on
+    FileStore racing tick/commit crash points; durability + frontier +
+    deadline invariants hold after convergence.  soak-marked =>
+    slow-implied (conftest), never on the tier-1/bench hot path."""
+    import tempfile
+
+    from ceph_tpu.load.soak import builtin_soaks, run_soak
+
+    sk = builtin_soaks()["soak-mixed-crash"]
+    with tempfile.TemporaryDirectory(prefix="graft_soak_") as tmpdir:
+        v = run(run_soak(sk, 17, tmpdir=tmpdir))
+    assert v.passed, v.failures
+    assert v.counters.get("crash_points_fired", 0) >= 1
+    assert v.acked_objects > 0
+    # the fault schedule replays from the seed (same resolver as chaos)
+    from ceph_tpu.chaos.scenario import build_schedule
+
+    assert build_schedule(sk.schedule_shell(), 17) == v.schedule
+
+
+@pytest.mark.soak
+def test_soak_marker_implies_slow(request):
+    """pytest.ini contract: soak tests are slow-implied, so the tier-1
+    '-m not slow' gate can never pick one up."""
+    marks = {m.name for m in request.node.iter_markers()}
+    assert "slow" in marks
