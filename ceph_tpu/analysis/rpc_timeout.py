@@ -31,7 +31,8 @@ _FUT_MAKERS = frozenset({"create_future", "_make_waiter"})
 
 # round 13: graft-load's async driver joined the scope (a hung wait in
 # the driver wedges the whole offered-load window the same way)
-SCOPE = ("ceph_tpu/cluster/", "ceph_tpu/load/")
+SCOPE = ("ceph_tpu/cluster/", "ceph_tpu/load/",
+         "ceph_tpu/osdmap/", "ceph_tpu/chaos/")
 
 
 def _future_names(fn: ast.AsyncFunctionDef) -> set:

@@ -42,7 +42,8 @@ RULE = "task-spawn"
 
 # async daemon/driver code the rule polices (tests and scripts are
 # callers, not long-lived event-loop residents)
-SCOPE = ("ceph_tpu/cluster/", "ceph_tpu/load/")
+SCOPE = ("ceph_tpu/cluster/", "ceph_tpu/load/",
+         "ceph_tpu/osdmap/", "ceph_tpu/chaos/")
 
 FIX = ("route it through a self-discarding tracker (the messenger "
        "_track pattern: set.add + add_done_callback(discard)) or a "

@@ -34,6 +34,10 @@ Event actions:
 ``partition``         split the OSDs into two halves (or explicit sides)
 ``heal_partition``    drop every partition edge
 ``bitrot``            flip one stored bit of one acked object replica
+``kill_mon``          hard-stop a monitor (default target: the current
+                      Paxos leader, resolved at apply time)
+``revive_mon``        restart a killed monitor rank (rejoins elections,
+                      catches up through collect + map subscription)
 ====================  ======================================================
 
 Targets: ``osd.N`` / ``mon.N`` pin a daemon; ``random_osd`` resolves
@@ -116,6 +120,16 @@ class Scenario:
     workload: str = "seq"                    # "seq" | "zipf"
     burst_concurrency: int = 0
     op_deadline: float = 0.0
+    # control-plane storm shape (round 14): a Paxos mon quorum, rounds
+    # driven by the graft-load open-loop driver instead of the put loop
+    # (``load`` is a LoadSpec; one drive() window per round, mid-round
+    # events race the in-flight traffic), and two judged gates —
+    # bounded time-to-HEALTH_OK after heal and a floor on map epochs/s
+    # generated while the storm ran (0 = gate off)
+    mons: int = 1
+    load: Optional[object] = None            # ceph_tpu.load LoadSpec
+    health_ok_bound: float = 0.0
+    epochs_floor: float = 0.0
 
 
 @dataclass
@@ -219,6 +233,15 @@ def build_schedule(scenario: Scenario, seed: int) -> List[Dict]:
             # victim object/osd resolve at apply time (needs the live
             # acked set); the pick still comes from the seeded stream
             target = target if target != "random_osd" else "runtime"
+        elif e.action == "kill_mon":
+            # the victim is WHOEVER leads at apply time (killing a
+            # follower proves nothing): symbolic target, runtime
+            # resolution — the plan itself stays bit-identical
+            if target == "random_osd":
+                target = "mon_leader"
+        elif e.action == "revive_mon":
+            if target == "random_osd":
+                target = "mon_down"
         entry["target"] = target
         entry["seq"] = i
         plan.append(entry)
@@ -259,11 +282,17 @@ def _store_factory(scenario: Scenario, tmpdir: Optional[str]):
 async def heal_cluster(cluster, dmn: DaemonInjector) -> None:
     """Fault-free the cluster before judging: crash-point teardowns
     still in flight must finish first (or the revive sweep races a
-    daemon mid-power-cut), every injector rate zeroes, and the dead
-    revive with whatever durable store survived them.  Shared with the
-    graft-load soak runner — one heal sequence, not two."""
+    daemon mid-power-cut), every injector rate zeroes, dead monitors
+    rejoin the quorum (OSDs must boot against a healthy mon), and the
+    dead OSDs revive with whatever durable store survived them.  Shared
+    with the graft-load soak runner — one heal sequence, not two."""
     await cluster.drain_chaos()
     zero_rates(cluster)
+    if len(cluster.mons) > 1:
+        for m_ in list(cluster.mons):
+            if m_.stopped:
+                await cluster.revive_mon(m_.rank)
+        await cluster.wait_for_leader()
     for osd_id in sorted(set(cluster.osd_configs) - set(cluster.osds)):
         await dmn.revive_osd(osd_id,
                              with_store=osd_id in cluster.osd_stores)
@@ -330,7 +359,8 @@ async def run_scenario(scenario: Scenario, seed: int,
         cfg.set(k, v)
     counters0 = dict(CHAOS.dump()["chaos"])
     cluster = await start_cluster(
-        scenario.osds, config=cfg,
+        scenario.osds, config=cfg, n_mons=scenario.mons,
+        with_mgr=scenario.load is not None,
         store_factory=_store_factory(scenario, tmpdir))
     dmn = DaemonInjector(cluster)
     acked: Dict[str, bytes] = {}
@@ -338,21 +368,38 @@ async def run_scenario(scenario: Scenario, seed: int,
     attempted: Dict[str, set] = {}
     snaps: Dict[int, Dict[str, bytes]] = {}
     failures: List[str] = []
+    gate_stats: Dict[str, int] = {}
+    ctx = None
     try:
-        client = await cluster.client()
-        if scenario.pool_kind == "erasure":
-            pool = await client.pool_create(
-                f"chaos_{scenario.name}"[:24], "erasure",
-                pg_num=scenario.pg_num,
-                ec_profile=dict(scenario.ec_profile or ()))
+        if scenario.load is not None:
+            # storm scenarios (round 14): traffic comes from the
+            # graft-load open-loop driver — one drive() window per
+            # round, the soak composition inverted into the chaos
+            # runner so scripts/chaos.py owns the storm library
+            from ceph_tpu.load.driver import LoadContext
+
+            ctx = await LoadContext.create(scenario.load, seed,
+                                           cluster=cluster)
+            client = ctx.sessions[0]
+            pool = ctx.pool
+            io = ctx.io(0)
         else:
-            pool = await client.pool_create(
-                f"chaos_{scenario.name}"[:24], "replicated",
-                pg_num=scenario.pg_num, size=scenario.pool_size)
-        io = client.ioctx(pool)
+            client = await cluster.client()
+            if scenario.pool_kind == "erasure":
+                pool = await client.pool_create(
+                    f"chaos_{scenario.name}"[:24], "erasure",
+                    pg_num=scenario.pg_num,
+                    ec_profile=dict(scenario.ec_profile or ()))
+            else:
+                pool = await client.pool_create(
+                    f"chaos_{scenario.name}"[:24], "replicated",
+                    pg_num=scenario.pg_num, size=scenario.pool_size)
+            io = client.ioctx(pool)
 
         deadline_misses: List[str] = []
         loop = asyncio.get_event_loop()
+        storm_t0 = loop.time()
+        storm_epoch0 = cluster.mon.osdmap.epoch
 
         async def put(i: int, gen: int, timeout: float) -> None:
             if scenario.workload == "zipf":
@@ -388,7 +435,41 @@ async def run_scenario(scenario: Scenario, seed: int,
                 await _apply_event(cluster, dmn, client, io, e, rot,
                                    acked, pool)
             mid = [e for e in evs if e["during_writes"]]
-            if mid:
+            if scenario.load is not None:
+                from ceph_tpu.load.driver import build_plan, drive
+
+                plan = build_plan(scenario.load, seed + rnd * 1000003)
+                window = loop.create_task(
+                    drive(ctx, scenario.load, seed, plan=plan,
+                          record_acked=True))
+                try:
+                    if mid:
+                        await asyncio.sleep(0.15 + wl.random() * 0.2)
+                        for e in mid:
+                            await _apply_event(cluster, dmn, client, io,
+                                               e, rot, acked, pool)
+                            # staggered AND overlapping: a seeded beat
+                            # between storm events so each bounce races
+                            # the previous one's re-peering, all under
+                            # the in-flight load window
+                            await asyncio.sleep(wl.random() * 0.25)
+                    result = await window
+                except BaseException:
+                    # a failed mid-round injection must not orphan the
+                    # in-flight load window (the soak rule)
+                    window.cancel()
+                    try:
+                        await window
+                    except (asyncio.CancelledError, Exception):
+                        pass
+                    raise
+                deadline_misses += result.late_acks
+                for oid, data in result.acked.items():
+                    acked[oid] = data
+                    acked_crcs[oid] = crcmod.crc32c(0xFFFFFFFF, data)
+                for oid, tries in result.attempted.items():
+                    attempted.setdefault(oid, set()).update(tries)
+            elif mid:
                 burst = asyncio.gather(
                     *[put(i, rnd,
                           timeout=scenario.op_deadline or 20.0)
@@ -398,7 +479,13 @@ async def run_scenario(scenario: Scenario, seed: int,
                 for e in mid:
                     await _apply_event(cluster, dmn, client, io, e, rot,
                                        acked, pool)
-                await burst
+                for r in await burst:
+                    # put() absorbs expected I/O failures itself —
+                    # anything else escaping a racing write is a
+                    # runner bug and must surface, not vanish
+                    if isinstance(r, BaseException) and \
+                            not isinstance(r, asyncio.CancelledError):
+                        raise r
             elif scenario.burst_concurrency:
                 # offered-load burst bounded at burst_concurrency
                 # in-flight writes — the overload regime the admission
@@ -409,10 +496,16 @@ async def run_scenario(scenario: Scenario, seed: int,
                     async with gate:
                         await put(i, gen, timeout=put_timeout)
 
-                await asyncio.gather(
+                burst_res = await asyncio.gather(
                     *[bounded_put(i, rnd)
                       for i in range(scenario.objects_per_round)],
                     return_exceptions=True)
+                for r in burst_res:
+                    # put() absorbs expected I/O failures itself —
+                    # anything else is a runner bug and must surface
+                    if isinstance(r, BaseException) and \
+                            not isinstance(r, asyncio.CancelledError):
+                        raise r
             else:
                 for i in range(scenario.objects_per_round):
                     await put(i, rnd, timeout=put_timeout)
@@ -423,19 +516,62 @@ async def run_scenario(scenario: Scenario, seed: int,
                 sid = await io.snap_create(f"chaos_s{rnd}")
                 snaps[sid] = dict(acked)
 
+        # -- storm gates (round 14): epochs/s generated while the fault
+        #    schedule ran — a churn burst the control plane cannot keep
+        #    up with shows as a collapsed rate (coalescing keeps the
+        #    COUNT low by design, so the floor judges rate, not count)
+        storm_wall = max(1e-6, loop.time() - storm_t0)
+        epochs_generated = cluster.mon.osdmap.epoch - storm_epoch0
+        gate_stats["epochs_generated"] = epochs_generated
+        gate_stats["storm_wall_ms"] = int(storm_wall * 1000)
+        if scenario.epochs_floor > 0:
+            rate = epochs_generated / storm_wall
+            if rate < scenario.epochs_floor:
+                failures.append(
+                    f"epochs: {epochs_generated} epochs in "
+                    f"{storm_wall:.1f}s = {rate:.2f}/s < floor "
+                    f"{scenario.epochs_floor}/s")
+
         # -- heal + converge + judge (shared with graft-load soak) ------
         await heal_cluster(cluster, dmn)
+        heal_t0 = loop.time()
         await _converge(cluster, scenario.converge_timeout)
+        if scenario.health_ok_bound > 0:
+            # bounded time-to-HEALTH_OK measured from the heal point:
+            # the cluster must not merely converge eventually, it must
+            # converge in bounded time after the storm stops
+            ok_deadline = heal_t0 + max(scenario.converge_timeout,
+                                        scenario.health_ok_bound)
+            health_ok_s = None
+            while loop.time() < ok_deadline:
+                if cluster.mon._health_data()["status"] == "HEALTH_OK":
+                    health_ok_s = loop.time() - heal_t0
+                    break
+                await asyncio.sleep(0.2)
+            if health_ok_s is None:
+                failures.append(
+                    f"health_time: no HEALTH_OK within "
+                    f"{ok_deadline - heal_t0:.0f}s of heal")
+            else:
+                gate_stats["health_ok_ms"] = int(health_ok_s * 1000)
+                if health_ok_s > scenario.health_ok_bound:
+                    failures.append(
+                        f"health_time: HEALTH_OK took "
+                        f"{health_ok_s:.1f}s > bound "
+                        f"{scenario.health_ok_bound}s")
         failures += await judge_invariants(
             cluster, dmn, io, scenario.invariants, acked,
             attempted=attempted, mode=scenario.durability_mode,
             timeout=scenario.converge_timeout, acked_crcs=acked_crcs,
             snaps=snaps, deadline_misses=deadline_misses)
     finally:
+        if ctx is not None:
+            await ctx.close()  # no-op: the scenario owns the cluster
         await cluster.stop()
     counters1 = CHAOS.dump()["chaos"]
     delta = {k: counters1[k] - counters0.get(k, 0) for k in counters1
              if counters1[k] - counters0.get(k, 0)}
+    delta.update(gate_stats)
     return Verdict(name=scenario.name, seed=seed, schedule=schedule,
                    passed=not failures, failures=failures,
                    acked_objects=len(acked), counters=delta)
@@ -477,6 +613,23 @@ async def _apply_event(cluster, dmn: DaemonInjector, client, io,
     elif action == "clock_skew":
         for cfg in _target_configs(cluster, target):
             cfg.injectargs({"chaos_clock_skew": args["skew"]})
+    elif action == "kill_mon":
+        rank = None
+        if target == "mon_leader":
+            rank = next((m_.rank for m_ in cluster.mons
+                         if m_.is_leader), None)
+        else:
+            rank = int(target.split(".")[1])
+        if rank is not None and not cluster.mons[rank].stopped:
+            await dmn.kill_mon(rank)
+    elif action == "revive_mon":
+        if target == "mon_down":
+            rank = next((m_.rank for m_ in cluster.mons
+                         if m_.stopped), None)
+        else:
+            rank = int(target.split(".")[1])
+        if rank is not None and cluster.mons[rank].stopped:
+            await cluster.revive_mon(rank)
     elif action == "partition":
         partition(cluster, list(args["a"]), list(args["b"]),
                   symmetric=bool(args.get("symmetric", True)))
@@ -561,8 +714,94 @@ store_factory_for = _store_factory
 # --------------------------------------------------------------- builtins
 
 
+def storm_scenarios(scale: float = 1.0) -> Dict[str, Scenario]:
+    """The round-14 control-plane storm library, sized by ``scale``.
+
+    1.0 is the full acceptance shape (slow: hundreds of OSD bounces /
+    a Paxos leader killed mid-epoch-burst, both under sustained
+    graft-load traffic); ``scripts/chaos.py --scale`` and the tier-1
+    smoke tests run a small fraction of it on the same code paths.
+    Bounces are staggered (seeded beats between events) AND overlapping
+    (mid-window, racing the load driver's in-flight traffic and each
+    other's re-peering).  The gates: bounded time-to-HEALTH_OK after
+    heal, and an epochs/s floor while the storm ran — the full-size
+    floor is real; scaled runs keep a token floor (wall time on the
+    load-sensitive bench host would make a tight scaled floor flappy,
+    BENCH_NOTES round 14)."""
+    from ceph_tpu.load.driver import LoadSpec
+
+    s = max(0.03, min(1.0, scale))
+    full = s >= 1.0
+    bounces = max(4, int(round(100 * s)))
+    osds = max(5, min(12, int(round(12 * s))))
+    rounds = max(2, min(10, (bounces + 11) // 12))
+    per = [bounces // rounds + (1 if r < bounces % rounds else 0)
+           for r in range(rounds)]
+    rr_events = tuple(
+        ev(r, "restart_osd", during_writes=bool(i % 2))
+        for r, n in enumerate(per) for i in range(n))
+    rr_load = LoadSpec(
+        name="rr100", clients=max(8, int(48 * s)), sessions=4,
+        rate=1.0, duration=2.0, objects=24, payload=1024,
+        op_deadline=20.0, osds=osds, pg_num=16, store="file",
+        verbs=(("write", 4.0), ("read", 3.0), ("append", 1.0)))
+    mb_rounds = 4 if full else 3
+    mb_osds = max(5, min(8, int(round(8 * s))))
+    mb_events = tuple(
+        ev(r, "restart_osd", during_writes=True)
+        for r in range(mb_rounds)
+        for _ in range(max(1, int(round(3 * s))))
+    ) + (
+        # the leader dies MID-epoch-burst (during_writes, while the
+        # round's restarts are churning map epochs through Paxos)
+        ev(1, "kill_mon", target="mon_leader", during_writes=True),
+        ev(min(2, mb_rounds - 1), "revive_mon", target="mon_down"),
+    )
+    mb_load = LoadSpec(
+        name="monbounce", clients=max(8, int(32 * s)), sessions=4,
+        rate=1.0, duration=2.0, objects=24, payload=1024,
+        op_deadline=20.0, osds=mb_osds, pg_num=16, store="file",
+        verbs=(("write", 4.0), ("read", 3.0), ("append", 1.0)))
+    common = dict(
+        pool_size=3, pg_num=16, store="file",
+        durability_mode="attempted",
+        invariants=("durability", "frontier", "acting", "health",
+                    "lockdep"),
+        # storms outlive the default 120s down-out window; a bounced
+        # OSD must never be auto-outed before its own revive
+        config=(("mon_osd_down_out_interval", 600.0),),
+        # the full-size bound sits ABOVE the worst client-budget tail:
+        # an op admitted just before heal may legitimately retry to the
+        # 90s rados budget, holding SLOW_OPS (and so HEALTH_WARN) that
+        # long — 180s = budget tail + markdown/boot margin on the
+        # load-sensitive host (measured 112s; BENCH_NOTES round 14)
+        health_ok_bound=180.0 if full else 60.0,
+        epochs_floor=0.3 if full else 0.02,
+        write_timeout=60.0,
+        converge_timeout=180.0 if full else 90.0)
+    return {
+        # hundreds of staggered+overlapping OSD bounces under sustained
+        # load-driver traffic (ROADMAP item 4's acceptance shape)
+        "rolling-restart-100": Scenario(
+            name="rolling-restart-100", osds=osds, rounds=rounds,
+            load=rr_load, events=rr_events, **common),
+        # Paxos leader killed mid-epoch-burst while OSD churn keeps the
+        # map service hot; the quorum must fail over, keep marking
+        # downs/ups, and converge in bounded time
+        "mon-bounce-under-churn": Scenario(
+            name="mon-bounce-under-churn", osds=mb_osds, mons=3,
+            rounds=mb_rounds, load=mb_load, events=mb_events, **common),
+    }
+
+
 def builtin_scenarios() -> Dict[str, Scenario]:
     """The shipped scenario library (scripts/chaos.py `list`)."""
+    out = storm_scenarios(1.0)
+    out.update(_base_scenarios())
+    return out
+
+
+def _base_scenarios() -> Dict[str, Scenario]:
     return {
         # tier-1 smoke: one OSD killed and revived under 10% drop
         "smoke": Scenario(

@@ -122,6 +122,10 @@ class MMonElection(Message):
     epoch: int = 0
     rank: int = -1
     quorum: List[int] = field(default_factory=list)
+    # the candidate's paxos last_committed (round 14): peers holding
+    # newer committed state refuse to defer, so a revived blank monitor
+    # cannot win leadership (and fork map epochs) before catching up
+    last_committed: int = 0
 
 
 @dataclass

@@ -69,7 +69,18 @@ class Monitor(Dispatcher):
         self.subscribers: Set[Addr] = set()
         # subscriber bind-addr -> the connection its subscribe rode in on
         self._sub_conns: Dict[Tuple, Connection] = {}
+        # per-subscriber map-push state (round 14 backpressure): pushes
+        # are serialized per subscriber by ONE pusher task each, and a
+        # churn burst coalesces into "send (last, current]" instead of
+        # queuing one delta message per epoch behind a slow peer
+        self._push_state: Dict[Tuple, Dict] = {}
+        # self-discarding background tasks (map pushers, failure flush)
+        self._mon_tasks: Set[asyncio.Task] = set()
         self.failure_reports: Dict[int, Set[int]] = {}
+        # markdowns past the reporter threshold awaiting the coalesce
+        # window (round 14): N simultaneous failures -> ONE epoch
+        self._pending_failed: Set[int] = set()
+        self._failure_flush_task: Optional[asyncio.Task] = None
         self.down_since: Dict[int, float] = {}
         # last beacon per osd (reference MOSDBeacon/last_osd_report): lets
         # the tick mark OSDs down even when no reporters remain (e.g. the
@@ -148,7 +159,9 @@ class Monitor(Dispatcher):
         self.leader_rank = None
         self.elector = Elector(
             self.rank, self.n_mons, self._send_mon, self._on_elected,
-            timeout=self.config.mon_election_timeout)
+            timeout=self.config.mon_election_timeout,
+            state_version=lambda: self.paxos.last_committed
+            if self.paxos else 0)
         self.paxos = Paxos(
             self.rank, self.n_mons, self._send_mon, self._apply_committed,
             timeout=self.config.mon_paxos_timeout)
@@ -164,9 +177,12 @@ class Monitor(Dispatcher):
             self.elector.stop()
         if self.paxos:
             self.paxos.step_down()
-        for t in (self._tick_task, self._lease_task):
+        for t in (self._tick_task, self._lease_task,
+                  self._failure_flush_task):
             if t:
                 t.cancel()
+        for t in list(self._mon_tasks):
+            t.cancel()
         await self.messenger.shutdown()
         # umount LAST: an in-flight commit draining above must still be
         # able to persist its delta
@@ -651,7 +667,13 @@ class Monitor(Dispatcher):
             # exactly the reference model, where clients never accept
             # inbound connections
             self._sub_conns[tuple(msg.addr)] = conn
-            await self._send_map(tuple(msg.addr), since=msg.since)
+            covered = await self._send_map(tuple(msg.addr),
+                                           since=msg.since)
+            # the direct subscribe reply counts as a push: the pusher
+            # must not re-send epochs the refresh just covered
+            ps = self._push_state.setdefault(tuple(msg.addr), {})
+            ps["last"] = max(ps.get("last", 0), covered)
+            ps.setdefault("target", covered)
             return True
         if isinstance(msg, M.MCommand):
             # daemon-directed admin command ('ceph daemon mon.X ...'):
@@ -720,8 +742,13 @@ class Monitor(Dispatcher):
         reporters = self.failure_reports.setdefault(osd, set())
         reporters.add(msg.reporter)
         # can_mark_down analog: enough distinct reporters
-        if len(reporters) >= self.config.mon_osd_min_down_reporters:
-            self._propose("down", osd)
+        if len(reporters) < self.config.mon_osd_min_down_reporters:
+            return
+        self._propose("down", osd)
+        window = self.config.mon_osd_failure_coalesce
+        if window <= 0:
+            # immediate per-failure commit (the pre-round-14 anchor:
+            # one Paxos round per markdown)
             async with self._map_mutex:
                 if not self.osdmap.osd_up[osd]:
                     return
@@ -733,6 +760,54 @@ class Monitor(Dispatcher):
                 self.clog("ERR", f"osd.{osd} failed "
                                  f"({nrep} reporters) -> marked down")
                 await self._commit_inc(inc)
+            return
+        # round 14: failure-report aggregation — every markdown that
+        # crosses the threshold inside one coalesce window rides ONE
+        # incremental, so a mass outage costs a handful of epochs (and
+        # Paxos rounds), not one per OSD
+        self._pending_failed.add(osd)
+        t = self._failure_flush_task
+        if t is None or t.done():
+            from ceph_tpu.utils.tasks import track_task
+
+            self._failure_flush_task = track_task(
+                self._mon_tasks, asyncio.get_event_loop().create_task(
+                    self._flush_failures(window)))
+
+    async def _flush_failures(self, window: float) -> None:
+        """Commit every pending markdown as one map epoch per coalesce
+        window, LOOPING until the pending set drains: a report that
+        crosses the threshold while a commit is in flight lands in
+        _pending_failed with this task still alive (so no new flush
+        spawns), and OSD reporters send each failure only once
+        (osd._reported) — without the re-check that markdown would
+        strand until the beacon-grace backstop."""
+        while not self.stopped:
+            await asyncio.sleep(window)
+            async with self._map_mutex:
+                batch = sorted(o for o in self._pending_failed
+                               if self.osdmap.osd_up[o])
+                self._pending_failed.clear()
+                if not batch:
+                    return
+                inc = self._new_inc()
+                now = self.clock.monotonic()
+                for osd in batch:
+                    inc.new_down.append(osd)
+                    self.down_since[osd] = now
+                    nrep = len(self.failure_reports.pop(osd, ()))
+                    self.perf.inc("mon_osd_marked_down")
+                    self.clog("ERR", f"osd.{osd} failed "
+                                     f"({nrep} reporters) -> marked down")
+                if len(batch) > 1:
+                    self.perf.inc("mon_failures_coalesced",
+                                  len(batch) - 1)
+                if not await self._commit_inc(inc):
+                    # quorum lost mid-markdown: drop the batch — the
+                    # beacon-grace tick (ours or the next leader's)
+                    # redoes the detection from live state
+                    for osd in batch:
+                        self.down_since.pop(osd, None)
 
     # commands that mutate cluster state need mon "rw" caps (MonCap)
     _MUTATING_PREFIXES = frozenset({
@@ -1115,12 +1190,53 @@ class Monitor(Dispatcher):
     # -- map distribution --------------------------------------------------
 
     async def _broadcast_map(self) -> None:
-        """Push the newest delta to subscribers (O(delta), not O(map))."""
+        """Mark every subscriber dirty; their pusher tasks deliver.
+
+        Round 14 backpressure: one serialized pusher per subscriber —
+        while a push awaits a slow peer's socket, further commits only
+        advance that subscriber's target epoch, so a churn burst
+        coalesces into one (last, current] chain per subscriber instead
+        of queueing a delta message per epoch (unbounded on a slow OSD),
+        and a slow subscriber no longer head-of-line blocks the commit
+        path for everyone else."""
         for addr in list(self.subscribers):
+            self._kick_map_pusher(addr)
+
+    def _kick_map_pusher(self, addr: Addr) -> None:
+        key = tuple(addr)
+        st = self._push_state.get(key)
+        if st is None:
+            st = self._push_state[key] = {"last": self.osdmap.epoch - 1}
+        st["target"] = self.osdmap.epoch
+        task = st.get("task")
+        if task is None or task.done():
+            from ceph_tpu.utils.tasks import track_task
+
+            st["task"] = track_task(
+                self._mon_tasks, asyncio.get_event_loop().create_task(
+                    self._push_maps(key, st)))
+
+    async def _push_maps(self, key: Tuple, st: Dict) -> None:
+        while not self.stopped:
+            target = st["target"]
+            since = st["last"]
+            if since >= target:
+                return
+            if target - since > 1:
+                # epochs delivered in one chain that the per-commit
+                # broadcast would have sent as separate messages
+                self.perf.inc("mon_map_pushes_coalesced",
+                              target - since - 1)
             try:
-                await self._send_map(addr, since=self.osdmap.epoch - 1)
+                covered = await self._send_map(key, since=since)
             except (ConnectionError, OSError):
-                self.subscribers.discard(addr)
+                self.subscribers.discard(key)
+                self._push_state.pop(key, None)
+                return
+            # against the LIVE watermark, not the loop-local `since`: a
+            # subscribe-refresh reply racing this push may have already
+            # advanced it past what this chain covered
+            st["last"] = max(st["last"], covered)
 
     async def _map_push(self, msg, addr: Addr) -> None:
         """Deliver a map message: over the subscriber's own connection
@@ -1135,14 +1251,19 @@ class Monitor(Dispatcher):
                 self._sub_conns.pop(tuple(addr), None)
         await self.messenger.send_message(msg, addr)
 
-    async def _send_map(self, addr: Addr, since: int = 0) -> None:
-        """Send incrementals covering (since, current] when the window has
-        them, else the full map (reference OSDMonitor send_incremental)."""
+    async def _send_map(self, addr: Addr, since: int = 0) -> int:
+        """Send incrementals covering (since, current] when the window
+        has them AND the chain stays under mon_osd_map_max_incs, else
+        the full map (reference OSDMonitor send_incremental; skipping
+        to a full map bounds both ends of a churn burst).  Returns the
+        epoch the message covered."""
         epoch = self.osdmap.epoch
         if 0 < since <= epoch:
             chain = []
             e = since + 1
-            while e <= epoch and e in self._inc_log:
+            limit = self.config.mon_osd_map_max_incs
+            while e <= epoch and e in self._inc_log and \
+                    len(chain) < limit:
                 chain.append(pickle.dumps(self._inc_log[e]))
                 e += 1
             if e > epoch:
@@ -1152,11 +1273,16 @@ class Monitor(Dispatcher):
                 await self._map_push(
                     M.MOSDIncMapMsg(prev_epoch=since, epoch=epoch,
                                     inc_blobs=chain), addr)
-                return
+                return epoch
+            if len(chain) >= limit:
+                # the subscriber fell outside the bounded delta window
+                # under churn: skip to the full map
+                self.perf.inc("mon_skip_to_full_sends")
         self.perf.inc("mon_full_maps_sent")
         blob = pickle.dumps(self.osdmap)
         await self._map_push(
             M.MOSDMapMsg(epoch=epoch, osdmap_blob=blob), addr)
+        return epoch
 
     async def _tick(self) -> None:
         """Down-out + beacon-staleness tick (reference OSDMonitor tick:
@@ -1166,17 +1292,19 @@ class Monitor(Dispatcher):
             now = self.clock.monotonic()
             async with self._map_mutex:
                 inc = self._new_inc()
+                out_restore: Dict[int, float] = {}
                 for osd, since in list(self.down_since.items()):
                     if now - since > self.config.mon_osd_down_out_interval \
                             and self.osdmap.osd_weight[osd] > 0:
                         inc.new_weights[osd] = 0
-                        self.down_since.pop(osd)
+                        out_restore[osd] = self.down_since.pop(osd)
+                down_restore: Dict[int, float] = {}
                 for osd, last in list(self.last_beacon.items()):
                     if self.osdmap.osd_up[osd] and \
                             now - last > self.config.mon_osd_beacon_grace:
                         inc.new_down.append(osd)
                         self.down_since[osd] = now
-                        self.last_beacon.pop(osd)
+                        down_restore[osd] = self.last_beacon.pop(osd)
                         self.perf.inc("mon_osd_marked_down")
                 for osd in inc.new_down:
                     self.clog("WRN", f"osd.{osd} marked down "
@@ -1190,4 +1318,15 @@ class Monitor(Dispatcher):
                     inc.new_log_entries = tuple(self._pending_clog)
                     self._pending_clog = []
                 if inc.new_weights or inc.new_down or inc.new_log_entries:
-                    await self._commit_inc(inc)
+                    if not await self._commit_inc(inc):
+                        # quorum lost mid-tick (leader killed under
+                        # churn): the detection state must survive the
+                        # failed commit, or an up-but-dead OSD whose
+                        # beacon entry was already popped would never
+                        # be marked down by anyone
+                        self.down_since.update(out_restore)
+                        for osd, last in down_restore.items():
+                            self.last_beacon[osd] = last
+                            self.down_since.pop(osd, None)
+                        self._pending_clog = \
+                            list(inc.new_log_entries) + self._pending_clog

@@ -173,6 +173,23 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         # another map change to trigger peering
         self._recovery_backoffs: Dict[PGid, object] = {}
         self._recovery_retry_tasks: Dict[PGid, asyncio.Task] = {}
+        # control plane at scale (round 14): per-pool resolved-placement
+        # snapshots diffed across epochs (osdmap.placement_delta), the
+        # pending-peering queue those diffs feed, ONE collapsing drain
+        # task, a per-OSD concurrency throttle on simultaneous peering
+        # rounds, and the seeded stream big waves stagger from
+        self._placement_cache: Dict[int, object] = {}
+        self._peering_pending: Set[PGid] = set()
+        self._peering_task: Optional[asyncio.Task] = None
+        # a COUNTED throttle, not a mutual-exclusion lock: DepLock has
+        # no semaphore mode, and ordering is safe by construction — the
+        # semaphore is only ever acquired BEFORE (never while holding)
+        # a PG lock (recovery._recover_pg)
+        self._peering_sem = asyncio.Semaphore(  # graftlint: ignore[asyncio-blocking]
+            max(1, self.config.osd_peering_max_concurrent))
+        self._peering_rng = _chaos_stream(
+            self.config.chaos_seed, f"peering:osd.{osd_id}") \
+            if self.config.chaos_seed else None
         self._hb_last: Dict[int, float] = {}
         self._reported: Set[int] = set()
         # dmClock op scheduling (reference mClockClientQueue plugged into
@@ -810,6 +827,34 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                                "the live acting set was below the "
                                "pool's min_size floor (acked-but-"
                                "unreconstructable guard)")
+        # control plane at scale (round 14): vectorized epoch deltas +
+        # peering storm control, all on the perf/Prometheus path so the
+        # graft-load SLO judge can gate on them from the mgr scrape
+        self.perf.add_u64("osd_map_epochs_applied",
+                          prio=perfmod.PRIO_INTERESTING,
+                          desc="osdmap epochs applied (incremental and "
+                               "full) — the churn keep-up signal")
+        self.perf.add_u64("osd_map_affected_pgs",
+                          desc="PGs the vectorized epoch delta selected "
+                               "(placement actually moved this epoch)")
+        self.perf.add_u64("osd_pgs_repeered",
+                          prio=perfmod.PRIO_INTERESTING,
+                          desc="primary PGs queued for peering by map "
+                               "advances (per-epoch re-peer fan-out)")
+        self.perf.add_u64("osd_map_skip_to_full",
+                          desc="incremental chains abandoned for a "
+                               "full-map request (chain longer than "
+                               "osd_map_max_inc_chain under churn)")
+        self.perf.add_u64("osd_peering_rounds",
+                          desc="peering rounds started")
+        self.perf.add_u64("osd_peering_throttled",
+                          desc="peering rounds that waited on the "
+                               "per-OSD concurrency throttle "
+                               "(osd_peering_max_concurrent)")
+        self.perf.add_histogram(
+            "osd_peering_lat_hist", scale=1e6, unit=perfmod.UNIT_SECONDS,
+            prio=perfmod.PRIO_INTERESTING,
+            desc="peering round duration, log2 microsecond buckets")
 
     def _build_admin_socket(self):
         """Register this daemon's command table (reference OSD::asok_
@@ -991,7 +1036,9 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
 
     async def _handle_inc_map(self, msg: M.MOSDIncMapMsg) -> None:
         """Apply a delta chain (reference handle_osd_map incremental path).
-        On an epoch gap, re-subscribe from our epoch to resync."""
+        On an epoch gap, re-subscribe from our epoch to resync; a chain
+        past osd_map_max_inc_chain skips to a full-map request instead
+        of unpickling an unbounded churn burst on the dispatch loop."""
         m = self.osdmap
         if m is None or msg.prev_epoch != m.epoch:
             if m is not None and msg.epoch <= m.epoch:
@@ -1000,8 +1047,16 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                 M.MMonSubscribe(what="osdmap", addr=self.messenger.my_addr,
                                 since=m.epoch if m else 0))
             return
+        if len(msg.inc_blobs) > self.config.osd_map_max_inc_chain:
+            self.perf.inc("osd_map_skip_to_full")
+            await self._mon_send(
+                M.MMonSubscribe(what="osdmap",
+                                addr=self.messenger.my_addr, since=0))
+            return
         for blob in msg.inc_blobs:
             m.apply_incremental(pickle.loads(blob))
+        if msg.inc_blobs:
+            self.perf.inc("osd_map_epochs_applied", len(msg.inc_blobs))
         self.perf.set("osd_map_epoch", m.epoch)
         await self._post_map_update()
 
@@ -1011,6 +1066,9 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         if old is not None and newmap.epoch < old.epoch:
             return  # stale full map
         self.osdmap = newmap
+        self.perf.inc("osd_map_epochs_applied",
+                      max(1, newmap.epoch - old.epoch) if old is not None
+                      else 1)
         self.perf.set("osd_map_epoch", newmap.epoch)
         await self._post_map_update()
 
@@ -1027,8 +1085,7 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                                             instance=self.boot_instance))
         changed = self._advance_pgs()
         if changed and not self._stopped:
-            self._track(asyncio.get_event_loop().create_task(
-                self._recover_all()))
+            self._kick_peering()
         if not self._stopped and any(
                 set(newmap.pools[st.pgid.pool].removed_snaps)
                 - self._purged_snaps.get(st.pgid, set())
@@ -1077,29 +1134,65 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                 self._purged_snaps.setdefault(pgid, set()).update(snaps)
 
     def _advance_pgs(self) -> bool:
-        """Recompute PG membership for this OSD; returns True if the set of
-        primary PGs changed (triggering recovery).  PG log/last_update are
-        preserved across map changes (and reloaded from the pgmeta object
-        when the collection already exists on store — the load_pgs resume
-        path, reference OSD.cc:2572)."""
+        """Recompute PG membership and queue peering for the PGs an
+        epoch actually moved; returns True when peering has work.
+
+        Round 14: with osd_map_vectorized_delta (default) each pool's
+        resolved placement is snapshotted after every advance and
+        DIFFED against the previous one (osdmap.placement_delta) — one
+        batched dispatch plus whole-pool array compares per epoch, zero
+        per-PG Python for unaffected PGs, and only primaries whose
+        up/acting moved re-peer.  With it off, every PG rescans and any
+        change re-peers every primary PG — the per-PG-scan bit-exactness
+        anchor (the pre-round-14 behavior).  PG log/last_update are
+        preserved across map changes (and reloaded from the pgmeta
+        object when the collection already exists on store — the
+        load_pgs resume path, reference OSD.cc:2572)."""
+        from ceph_tpu.osdmap.osdmap import placement_delta, \
+            placement_snapshot
+
         m = self.osdmap
+        use_vec = bool(self.config.osd_map_vectorized_delta)
+        if not use_vec:
+            # a stale cache from a past vectorized phase must not feed
+            # diffs after the option is toggled back on
+            self._placement_cache.clear()
         changed = False
+        to_peer: Set[PGid] = set()
+        batch_min = self.config.osd_map_batch_min_pgs
         # pg_num growth: split local PGs whose persisted split watermark
         # trails the pool's pg_num, BEFORE recomputing membership, so
         # child PGStates load the split-out meta/objects (reference
         # PG::split_colls on map advance).  The watermark rides the
         # PGMETA object, so an OSD that was down across the bump splits
-        # on resume.
+        # on resume.  Skipped per pool when the cached snapshot proves
+        # pg_num did not move.
         for pool_id, pool in m.pools.items():
             if pool.is_erasure():
+                continue
+            cached = self._placement_cache.get(pool_id)
+            if cached is not None and cached.pg_num == pool.pg_num:
                 continue
             for pgid, st in list(self.pgs.items()):
                 if pgid.pool == pool_id and self._maybe_split(pool, st):
                     changed = True
         for pool_id, pool in m.pools.items():
-            for pgid, up, upp, acting, actp in self._pool_memberships(
-                    m, pool_id, pool):
-                mine = self.osd_id in [o for o in acting if o != CRUSH_ITEM_NONE]
+            old_snap = self._placement_cache.get(pool_id)
+            snap = placement_snapshot(m, pool_id, batch_min)
+            if use_vec:
+                self._placement_cache[pool_id] = snap
+            seeds = None
+            if old_snap is not None:
+                seeds = placement_delta(old_snap, snap)
+                if seeds is not None:
+                    self.perf.inc("osd_map_affected_pgs", len(seeds))
+            it = range(pool.pg_num) if seeds is None else sorted(seeds)
+            for seed in it:
+                pgid = PGid(pool_id, seed)
+                up, upp, acting, actp = snap.resolve(seed)
+                up, acting = list(up), list(acting)
+                mine = self.osd_id in [o for o in acting
+                                       if o != CRUSH_ITEM_NONE]
                 old = self.pgs.get(pgid)
                 if mine:
                     if old is None:
@@ -1120,9 +1213,15 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                         # peering rules on each (roll forward / rewind)
                         self._frontier_rebuild(st)
                         self.pgs[pgid] = st
+                        if actp == self.osd_id:
+                            to_peer.add(pgid)
                     else:
-                        if old.acting != acting:
+                        if old.acting != acting or (
+                                old.primary != actp
+                                and actp == self.osd_id):
                             changed = True
+                            if actp == self.osd_id:
+                                to_peer.add(pgid)
                         old.up, old.acting, old.primary = up, acting, actp
                 elif old is not None:
                     del self.pgs[pgid]
@@ -1134,6 +1233,9 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         for pgid in [p for p in self.pgs if p.pool not in m.pools]:
             del self.pgs[pgid]
             changed = True
+        for pool_id in [p for p in self._placement_cache
+                        if p not in m.pools]:
+            del self._placement_cache[pool_id]
         for coll in self.store.list_collections():
             if not coll.startswith("pg_"):
                 continue
@@ -1145,43 +1247,22 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                 self.store.queue_transaction(
                     Transaction().remove_collection(coll))
                 self.perf.inc("osd_pgs_removed")
-        if not changed and any(st.frontier_recovering
-                               and st.primary == self.osd_id
-                               for st in self.pgs.values()):
-            # round 12: a crash-restarted primary whose acting set came
-            # back IDENTICAL still owes peering a round — its
-            # reconstructed open frontier entries resolve only by
-            # verified presence/rewind, and nothing else would ever
-            # trigger it (recovery otherwise runs on membership change)
-            changed = True
-        return changed
-
-    def _pool_memberships(self, m: OSDMap, pool_id: int, pool: PGPool):
-        """Yield (pgid, up, upp, acting, actp) for every PG of a pool.
-
-        Large pools go through the batched whole-pool placement (one TPU
-        dispatch via OSDMap.pool_mapping, which falls back to the scalar
-        mapper for map shapes the TensorMapper rejects); sparse pg_temp /
-        primary_temp overrides re-run the scalar chain per affected PG.
-        Small pools stay scalar — a per-epoch device dispatch costs more
-        than it saves below a few hundred PGs."""
-        batch_min = self.config.osd_map_batch_min_pgs
-        if pool.pg_num < batch_min:
-            for seed in range(pool.pg_num):
-                pgid = PGid(pool_id, seed)
-                yield (pgid, *m.pg_to_up_acting_osds(pgid))
-            return
-        up_arr, upp_arr = m.pool_mapping(pool_id)
-        for seed in range(pool.pg_num):
-            pgid = PGid(pool_id, seed)
-            if pgid in m.pg_temp or pgid in m.primary_temp:
-                yield (pgid, *m.pg_to_up_acting_osds(pgid))
-                continue
-            row = up_arr[seed]
-            up = [int(o) for o in row if o != CRUSH_ITEM_NONE] \
-                if pool.can_shift_osds() else [int(o) for o in row]
-            upp = int(upp_arr[seed])
-            yield pgid, up, upp, up, upp
+        # round 12: a crash-restarted primary whose acting set came back
+        # IDENTICAL still owes peering a round — its reconstructed open
+        # frontier entries resolve only by verified presence/rewind, and
+        # nothing else would ever trigger it
+        for st in self.pgs.values():
+            if st.frontier_recovering and st.primary == self.osd_id:
+                to_peer.add(st.pgid)
+        if not use_vec and (changed or to_peer):
+            # anchor mode: any change re-peers every primary PG (the
+            # pre-round-14 stampede, kept for bisection)
+            to_peer.update(pgid for pgid, st in self.pgs.items()
+                           if st.primary == self.osd_id)
+        if to_peer:
+            self.perf.inc("osd_pgs_repeered", len(to_peer))
+            self._peering_pending.update(to_peer)
+        return bool(to_peer)
 
     # ------------------------------------------------------------ heartbeat
 

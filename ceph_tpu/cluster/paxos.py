@@ -33,12 +33,19 @@ from ceph_tpu.utils.lockdep import DepLock
 
 class Elector:
     def __init__(self, rank: int, n_mons: int, send, on_elected,
-                 timeout: float = 0.3):
+                 timeout: float = 0.3, state_version=None):
         self.rank = rank
         self.n = n_mons
         self.send = send                  # async (peer_rank, msg)
         self.on_elected = on_elected      # async (leader, quorum, epoch)
         self.timeout = timeout
+        # the candidate-preference input (round 14): paxos
+        # last_committed.  A peer holding NEWER committed state never
+        # defers to a stale candidate — the reference's "deferred to
+        # whoever has the freshest store" rule, which keeps a revived
+        # blank monitor from winning (and forking epochs) before the
+        # collect/catch-up path has healed it.
+        self.state_version = state_version or (lambda: 0)
         self.epoch = 1
         self.electing = False
         self.stopped = False
@@ -46,7 +53,13 @@ class Elector:
         self.quorum: List[int] = []
         self._acked: set = set()
         self._deferred_to: Optional[int] = None
+        self._deferred_key: Optional[Tuple[int, int]] = None
         self._victory_task: Optional[asyncio.Task] = None
+
+    def _cand_key(self, rank: int, last_committed: int) -> Tuple[int, int]:
+        """Election preference: freshest committed state first, lowest
+        rank as the tiebreak (smaller key wins)."""
+        return (-last_committed, rank)
 
     @property
     def majority(self) -> int:
@@ -67,6 +80,7 @@ class Elector:
         self.electing = True
         self.leader = None
         self._deferred_to = None
+        self._deferred_key = None
         if self.epoch % 2 == 0:
             self.epoch += 1
         else:
@@ -76,7 +90,8 @@ class Elector:
             if r != self.rank:
                 try:
                     await self.send(r, M.MMonElection(
-                        op="propose", epoch=self.epoch, rank=self.rank))
+                        op="propose", epoch=self.epoch, rank=self.rank,
+                        last_committed=self.state_version()))
                 except (ConnectionError, OSError):
                     pass
         if self._victory_task:
@@ -93,6 +108,7 @@ class Elector:
             await asyncio.sleep(self.timeout * 4)
             if self.electing:
                 self._deferred_to = None
+                self._deferred_key = None
                 self.electing = False
                 await self.start_election()
             return
@@ -122,15 +138,21 @@ class Elector:
             if msg.epoch > self.epoch:
                 self.epoch = msg.epoch
                 self._deferred_to = None
-            if msg.rank < self.rank:
-                # defer to the lower rank (reference Elector::defer) — but
-                # ack at most ONE candidate per epoch unless a strictly
-                # lower rank appears, or two mutually-unreachable
-                # candidates could both collect a majority
-                if self._deferred_to is not None and \
-                        msg.rank >= self._deferred_to:
+                self._deferred_key = None
+            key = self._cand_key(msg.rank,
+                                 getattr(msg, "last_committed", 0))
+            if key < self._cand_key(self.rank, self.state_version()):
+                # defer to the preferred candidate (reference
+                # Elector::defer + the catch-up guard: freshest
+                # committed state beats rank) — but ack at most ONE
+                # candidate per epoch unless a strictly better one
+                # appears, or two mutually-unreachable candidates could
+                # both collect a majority
+                if self._deferred_key is not None and \
+                        key >= self._deferred_key:
                     return
                 self._deferred_to = msg.rank
+                self._deferred_key = key
                 if not self.electing:
                     self.electing = True
                     self._acked = set()
@@ -144,7 +166,8 @@ class Elector:
                 except (ConnectionError, OSError):
                     pass
             else:
-                # a higher rank is campaigning: counter with our own
+                # a worse candidate (higher rank, or staler committed
+                # state) is campaigning: counter with our own
                 if not self.electing or self._deferred_to is None:
                     self.electing = False
                     await self.start_election()
@@ -287,6 +310,13 @@ class Paxos:
         if version != self.last_committed + 1:
             if version > self.last_committed + 1:
                 self._pending_commits[version] = value
+                # a rejoiner behind a TRIMMED log can never drain this
+                # gap from commits alone (the map itself resyncs via
+                # the mon's osdmap subscription; the log via the next
+                # election's collect) — bound the buffer so a long-dead
+                # revived peon does not grow it for the quorum's life
+                while len(self._pending_commits) > self.max_log:
+                    del self._pending_commits[min(self._pending_commits)]
             return
         self.values[version] = value
         self.last_committed = version

@@ -21,23 +21,74 @@ class RecoveryMixin:
 
     # ------------------------------------------------------------- recovery
 
+    def _kick_peering(self) -> None:
+        """Start (or let run) the single peering drain task: concurrent
+        map changes collapse into the live pass instead of stacking one
+        _recover_all per epoch — under a churn burst the pending set
+        absorbs every epoch's re-peer fan-out (round 14 storm control)."""
+        t = self._peering_task
+        if t is not None and not t.done():
+            return  # the running pass re-checks the pending set
+        self._peering_task = self._track(
+            asyncio.get_event_loop().create_task(self._recover_all()))
+
     async def _recover_all(self) -> None:
+        """Drain the pending-peering queue in bounded waves: each PG's
+        round runs as its own task behind the per-OSD concurrency
+        throttle (_recover_pg's semaphore); waves larger than
+        osd_peering_stagger_after desynchronize their starts with
+        capped seeded jitter so hundreds of simultaneously-bouncing
+        OSDs do not stampede each other with peer queries."""
         await asyncio.sleep(self.config.osd_recovery_delay_start)
-        for pgid, st in list(self.pgs.items()):
-            if st.primary == self.osd_id:
-                try:
-                    # background class yields to client admission
-                    # pressure (mclock demotion analog): recovery pulls
-                    # wait for the op budget to drain below 3/4
-                    await self._yield_under_pressure()
-                    await self._recover_pg(st)
-                except Exception:
-                    # count AND surface: a silently-failing recovery loop
-                    # means a pool that never re-protects itself
-                    self.perf.inc("osd_recovery_errors")
-                    import logging
-                    logging.getLogger("ceph_tpu.osd").exception(
-                        "osd.%d: recovery of pg %s failed", self.osd_id, pgid)
+        while not self._stopped:
+            # snapshot-and-clear is atomic (no await between): a map
+            # change landing mid-wave re-adds to the live set and the
+            # next while pass picks it up
+            pending = sorted(self._peering_pending)
+            self._peering_pending.difference_update(pending)
+            if not pending:
+                return
+            stagger_after = self.config.osd_peering_stagger_after
+            stagger = bool(stagger_after) and len(pending) > stagger_after
+            from ceph_tpu.utils.tasks import track_task
+
+            waves: set = set()
+            for pgid in pending:
+                st = self.pgs.get(pgid)
+                if st is None or st.primary != self.osd_id:
+                    continue
+                track_task(waves, asyncio.get_event_loop().create_task(
+                    self._peer_one(st, stagger)))
+            if waves:
+                # _peer_one contains its own error accounting; the
+                # gather only orders the wave against the next pass
+                await asyncio.gather(*list(waves))
+
+    async def _peer_one(self, st: PGState, stagger: bool) -> None:
+        try:
+            if stagger:
+                cap = self.config.osd_peering_stagger_max
+                if cap > 0:
+                    import random as _random
+
+                    r = self._peering_rng.random() \
+                        if self._peering_rng is not None \
+                        else _random.random()
+                    await asyncio.sleep(r * cap)
+            # background class yields to client admission pressure
+            # (mclock demotion analog): recovery pulls wait for the op
+            # budget to drain below 3/4
+            await self._yield_under_pressure()
+            await self._recover_pg(st)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # count AND surface: a silently-failing recovery loop
+            # means a pool that never re-protects itself
+            self.perf.inc("osd_recovery_errors")
+            import logging
+            logging.getLogger("ceph_tpu.osd").exception(
+                "osd.%d: recovery of pg %s failed", self.osd_id, st.pgid)
 
     async def _query_pg(self, osd: int, pgid: PGid):
         """GetInfo/GetLog exchange with one member (reference peering
@@ -75,24 +126,40 @@ class RecoveryMixin:
         map changes, but a pull that fails AFTER the last map change of an
         outage would otherwise never retry — the primary stays stale
         forever, serving old-generation state (surfaced by graft-chaos as
-        persistent torn EC reads)."""
-        try:
-            async with st.lock:
-                complete = await self._recover_pg_locked(st)
-        except asyncio.CancelledError:
-            raise
-        except Exception:
-            # a round that RAISES must still re-arm (round 12): infos
-            # racing in-flight commits can be transiently inconsistent,
-            # and a wedged retry chain leaves reconstructed frontier
-            # entries unresolved forever
-            self.perf.inc("osd_recovery_errors")
-            import logging
+        persistent torn EC reads).
 
-            logging.getLogger("ceph_tpu.osd").exception(
-                "osd.%d: peering round for pg %s errored",
-                self.osd_id, st.pgid)
-            complete = False
+        Rounds run behind the per-OSD concurrency throttle
+        (osd_peering_max_concurrent, round 14): a mass bounce produces a
+        bounded wave of simultaneous rounds, and every entry path — map
+        advance, incomplete-round retry, frontier reconstruction —
+        shares the one gate.  Round duration rides the
+        osd_peering_lat_hist histogram on the perf/Prometheus path."""
+        sem = self._peering_sem
+        if sem.locked():
+            self.perf.inc("osd_peering_throttled")
+        async with sem:
+            self.perf.inc("osd_peering_rounds")
+            t0 = self.clock.monotonic()
+            try:
+                async with st.lock:
+                    complete = await self._recover_pg_locked(st)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # a round that RAISES must still re-arm (round 12): infos
+                # racing in-flight commits can be transiently inconsistent,
+                # and a wedged retry chain leaves reconstructed frontier
+                # entries unresolved forever
+                self.perf.inc("osd_recovery_errors")
+                import logging
+
+                logging.getLogger("ceph_tpu.osd").exception(
+                    "osd.%d: peering round for pg %s errored",
+                    self.osd_id, st.pgid)
+                complete = False
+            finally:
+                self.perf.hinc("osd_peering_lat_hist",
+                               self.clock.monotonic() - t0)
         if complete:
             self._recovery_backoffs.pop(st.pgid, None)
         else:
@@ -181,6 +248,15 @@ class RecoveryMixin:
         # ack was lost (bounce mid-commit) leaves last_complete behind
         # forever: no rewind fires (nothing is divergent) and no later
         # ack arrives (surfaced by graft-chaos as a stuck-incomplete PG)
+        # the sync/push phase above may have advanced OUR OWN log past
+        # the info snapshotted at round start (_sync_self_from pulls,
+        # racing pipelined commits): the floor must rest on the CURRENT
+        # self state, or a stale self-info pins the watermark below
+        # entries every member verifiably holds — the round then ends
+        # complete=True with last_complete wedged behind last_update
+        # and nothing ever re-arms it (round 14: the re-peer-all
+        # stampede that used to paper over this is gone by design)
+        infos[self.osd_id] = st.info()
         live = [o for o in st.acting if o != CRUSH_ITEM_NONE]
         # EC undersized guard (round 12): with fewer than min_size live
         # members, "every member holds it" is vacuous — rolling the

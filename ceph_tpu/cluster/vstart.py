@@ -149,9 +149,41 @@ class Cluster:
         return await self.clients[0].objecter.daemon_command(
             self.daemon_addr(name), cmd, timeout=timeout)
 
+    # serialized pickle of the cluster's INITIAL blank osdmap: the seed
+    # a revived in-memory monitor reboots from (committed state comes
+    # back from the quorum, like a reference mon resyncing from peers)
+    _initial_map_blob: bytes = b""
+
     async def kill_mon(self, rank: int) -> None:
         """Hard-stop a monitor (mon_thrash analog)."""
         await self.mons[rank].stop()
+
+    async def revive_mon(self, rank: int) -> Monitor:
+        """Start a fresh monitor for a killed rank (mon_thrash revive):
+        binds the ORIGINAL monmap address, rejoins elections, and
+        catches up — paxos state through the collect/catch-up path
+        (the election's last_committed guard keeps the blank rejoiner
+        from winning before it has), the osdmap through an explicit
+        subscription to the leader (paxos catch-up alone can be trimmed
+        past a long-dead rejoiner's horizon)."""
+        import pickle as _pickle
+
+        mon = Monitor(_pickle.loads(self._initial_map_blob),
+                      config=self.config, rank=rank,
+                      n_mons=len(self.mons))
+        host, port = self.mon_addrs[rank]
+        await mon.start(host, port)
+        self.mons[rank] = mon
+        if len(self.mons) > 1:
+            mon.set_monmap(self.mon_addrs)
+            await mon.begin_elections()
+            for _ in range(100):
+                if mon.leader_rank is not None and \
+                        mon.leader_rank != rank:
+                    await mon._request_map_sync()
+                    break
+                await asyncio.sleep(0.05)
+        return mon
 
     async def wait_for_leader(self, timeout: float = 10.0,
                               exclude: int = -1) -> Monitor:
@@ -309,6 +341,7 @@ async def start_cluster(n_osds: int = 3, osds_per_host: int = 1,
         mons.append(mon)
     cluster = Cluster(mons=mons, osds={}, config=config,
                       mon_addrs=mon_addrs)
+    cluster._initial_map_blob = map_blob
     if n_mons > 1:
         for mon in mons:
             mon.set_monmap(mon_addrs)

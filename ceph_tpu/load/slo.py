@@ -245,6 +245,24 @@ def judge(spec, result, before: TelemetrySnapshot,
          not bad, "mon:health",
          "; ".join(str(checks[k]) for k in bad))
 
+    # control plane (round 14): the vectorized-churn counters must be
+    # ON the scrape (epochs applied, PGs re-peered, the peering
+    # duration histogram, skip-to-full events), and an optional
+    # map_epochs_min floor gates churn keep-up — storm soaks set it,
+    # steady-state specs leave it 0 (counters-present only)
+    epochs_min = spec.gate("map_epochs_min", 0.0)
+    applied = counter_delta(before, after, "ceph_osd_map_epochs_applied")
+    cp_present = all(
+        name in after.prom for name in (
+            "ceph_osd_map_epochs_applied", "ceph_osd_pgs_repeered",
+            "ceph_osd_map_skip_to_full",
+            "ceph_osd_peering_lat_hist_bucket"))
+    _row(report, "map_churn", round(applied, 1), epochs_min,
+         cp_present and applied >= epochs_min,
+         "scrape:ceph_osd_map_epochs_applied",
+         "" if cp_present
+         else "control-plane counters MISSING from scrape")
+
     # deadline: zero acks past the client budget (client-observed —
     # the one gate that cannot come from a scrape by definition)
     _row(report, "deadline", len(result.late_acks), 0,

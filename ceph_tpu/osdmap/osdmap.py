@@ -441,13 +441,28 @@ class OSDMap:
 
     # -- whole-pool batched placement --------------------------------------
 
+    def _pool_mapping_row(self, pool: PGPool, pool_id: int, seed: int,
+                          pps_s: int, raw: List[int]):
+        """One seed's host post-pass: the scalar chain after the raw
+        CRUSH placement (nonexistent removal, upmap, up filtering,
+        primary affinity)."""
+        raw = self._remove_nonexistent(pool, raw)
+        pgid = PGid(pool_id, seed)
+        raw = self._apply_upmap(pool, pgid, raw)
+        u = self._raw_to_up(pool, raw)
+        p = self._pick_primary(u)
+        return self._apply_primary_affinity(pps_s, pool, u, p)
+
     def pool_mapping(self, pool_id: int):
         """Map every PG of a pool in one batched TPU dispatch.
 
-        Returns (up (pg_num, size) int32 with CRUSH_ITEM_NONE holes/padding,
-        up_primary (pg_num,) int32).  Sparse overrides (upmap, temp,
-        affinity) are applied as host post-passes; semantics match the
-        scalar pipeline exactly (cross-checked in tests).
+        Returns (up (pg_num, size) int64 with CRUSH_ITEM_NONE holes/padding,
+        up_primary (pg_num,) int64).  The host post-passes (nonexistent
+        removal, up filtering, primary pick) run VECTORIZED in numpy —
+        zero per-PG Python on the common path (round 14); sparse
+        overrides (upmap entries, non-default primary affinity) re-run
+        the scalar chain for just the affected seeds.  Semantics match
+        the per-PG scalar pipeline exactly (cross-checked in tests).
         """
         pool = self.pools[pool_id]
         seeds = np.arange(pool.pg_num, dtype=np.uint32)
@@ -483,21 +498,65 @@ class OSDMap:
                 pool.crush_rule, pps, pool.size, weights)
             res = np.asarray(res)
             rlen = np.asarray(rlen)
-        up = np.full((pool.pg_num, pool.size), CRUSH_ITEM_NONE, dtype=np.int64)
-        upp = np.full(pool.pg_num, -1, dtype=np.int64)
-        # post-passes per PG on the host (vectorize later if they show up
-        # in profiles; the dict overrides are sparse by design)
-        exists = np.zeros(self.max_osd + 1, dtype=bool)
-        exists[: self.max_osd] = self.osd_exists
-        for s in range(pool.pg_num):
-            raw = [int(v) for v in res[s, : rlen[s]]]
-            raw = self._remove_nonexistent(pool, raw)
-            pgid = PGid(pool_id, int(s))
-            raw = self._apply_upmap(pool, pgid, raw)
-            u = self._raw_to_up(pool, raw)
-            p = self._pick_primary(u)
-            u, p = self._apply_primary_affinity(int(pps[s]), pool, u, p)
-            up[s, : len(u)] = u
+        size = pool.size
+        aff = self.osd_primary_affinity
+        if aff is not None and any(
+                a != CEPH_OSD_DEFAULT_PRIMARY_AFFINITY for a in aff):
+            # non-default primary affinity reorders/re-picks primaries
+            # per (pps, osd) hash: keep the per-seed scalar post-pass
+            # for the whole pool (affinity maps are rare and sparse)
+            up = np.full((pool.pg_num, size), CRUSH_ITEM_NONE,
+                         dtype=np.int64)
+            upp = np.full(pool.pg_num, -1, dtype=np.int64)
+            for s in range(pool.pg_num):
+                u, p = self._pool_mapping_row(
+                    pool, pool_id, int(s), int(pps[s]),
+                    [int(v) for v in res[s, : rlen[s]]])
+                up[s, : len(u)] = u
+                upp[s] = p
+            return up, upp
+        # vectorized post-pass: exists/up masking and first-non-NONE
+        # primary pick as whole-pool array ops
+        res64 = np.asarray(res, dtype=np.int64)[:, :size]
+        rlen64 = np.asarray(rlen, dtype=np.int64)
+        cols = np.arange(size, dtype=np.int64)
+        raw = np.where(cols[None, :] < rlen64[:, None], res64,
+                       CRUSH_ITEM_NONE)
+        valid = (raw != CRUSH_ITEM_NONE) & (raw >= 0) & \
+            (raw < self.max_osd)
+        alive = np.asarray(self.osd_exists, dtype=bool) & \
+            np.asarray(self.osd_up, dtype=bool)
+        keep = valid & alive[np.where(valid, raw, 0)]
+        if pool.can_shift_osds():
+            # replicated: dead/nonexistent entries compact out,
+            # preserving the order of the survivors (stable sort on the
+            # drop mask == the scalar chain's filtered list)
+            order = np.argsort(~keep, axis=1, kind="stable")
+            vals = np.take_along_axis(raw, order, axis=1)
+            kept = np.take_along_axis(keep, order, axis=1)
+            up = np.where(kept, vals, CRUSH_ITEM_NONE)
+        else:
+            # erasure: positions are shard slots — dead entries become
+            # NONE holes in place
+            up = np.where(keep, raw, CRUSH_ITEM_NONE)
+        has = up != CRUSH_ITEM_NONE
+        first = has.argmax(axis=1)
+        upp = np.where(has.any(axis=1),
+                       up[np.arange(pool.pg_num), first],
+                       -1).astype(np.int64)
+        # sparse upmap overrides re-run the scalar chain per seed (the
+        # folded pg id of seed s < pg_num is s itself)
+        special = {pg.seed for pg in self.pg_upmap
+                   if pg.pool == pool_id and pg.seed < pool.pg_num}
+        special |= {pg.seed for pg in self.pg_upmap_items
+                    if pg.pool == pool_id and pg.seed < pool.pg_num}
+        for s in sorted(special):
+            u, p = self._pool_mapping_row(
+                pool, pool_id, s, int(pps[s]),
+                [int(v) for v in res[s, : rlen[s]]])
+            row = np.full(size, CRUSH_ITEM_NONE, dtype=np.int64)
+            row[: len(u)] = u
+            up[s] = row
             upp[s] = p
         return up, upp
 
@@ -507,6 +566,165 @@ class OSDMap:
         b, bp = other.pool_mapping(pool_id)
         moved = np.nonzero((a != b).any(axis=1))[0]
         return moved, len(moved) / max(a.shape[0], 1)
+
+
+# -- vectorized epoch deltas (round 14) -------------------------------------
+#
+# "Which PGs did this epoch change?" as whole-pool array diffs instead of a
+# per-PG Python rescan: an OSD snapshots each pool's resolved placement
+# after every map advance and diffs the arrays on the next one, so epoch
+# application peers only PGs whose up/acting actually moved.  The per-PG
+# scan (affected_pgs_scalar) stays as the bit-exactness anchor.
+
+
+@dataclass
+class PoolPlacement:
+    """One pool's resolved placement at an epoch — the diffable unit."""
+
+    pool_id: int
+    pg_num: int
+    size: int
+    shift: bool                       # pool.can_shift_osds()
+    mode: str                         # "batched" | "scalar"
+    up: Optional[np.ndarray] = None   # (pg_num, size), batched mode
+    upp: Optional[np.ndarray] = None  # (pg_num,), batched mode
+    # per-seed (up, up_primary, acting, acting_primary) normalized
+    # tuples: EVERY seed in scalar mode; only pg_temp/primary_temp
+    # overridden seeds in batched mode (acting != up only there)
+    resolved: Dict[int, Tuple] = field(default_factory=dict)
+
+    def resolve(self, seed: int) -> Tuple:
+        got = self.resolved.get(seed)
+        if got is not None:
+            return got
+        row = self.up[seed]
+        if self.shift:
+            u = tuple(int(o) for o in row if o != CRUSH_ITEM_NONE)
+        else:
+            u = tuple(int(o) for o in row)
+        p = int(self.upp[seed])
+        return (u, p, u, p)
+
+
+def _norm_placement(size: int, shift: bool, up, upp, acting, actp) -> Tuple:
+    """Normalize a pg_to_up_acting_osds 4-tuple so scalar- and
+    array-derived resolutions compare equal: replicated sets drop NONE
+    holes, erasure sets pad to the pool size (trailing padding is not a
+    placement change)."""
+    if shift:
+        u = tuple(o for o in up if o != CRUSH_ITEM_NONE)
+        a = tuple(o for o in acting if o != CRUSH_ITEM_NONE)
+    else:
+        u = tuple(up) + (CRUSH_ITEM_NONE,) * (size - len(up))
+        a = tuple(acting) + (CRUSH_ITEM_NONE,) * (size - len(acting))
+    return (u, upp, a, actp)
+
+
+def placement_snapshot(m: OSDMap, pool_id: int,
+                       batch_min: int = 0) -> PoolPlacement:
+    """Resolve a pool's full placement: one batched dispatch + sparse
+    temp-override scalar re-runs (pools below ``batch_min`` PGs stay on
+    the scalar chain — a device dispatch costs more than it saves)."""
+    pool = m.pools[pool_id]
+    shift = pool.can_shift_osds()
+    if pool.pg_num < batch_min:
+        snap = PoolPlacement(pool_id, pool.pg_num, pool.size, shift,
+                             "scalar")
+        for seed in range(pool.pg_num):
+            snap.resolved[seed] = _norm_placement(
+                pool.size, shift,
+                *m.pg_to_up_acting_osds(PGid(pool_id, seed)))
+        return snap
+    up, upp = m.pool_mapping(pool_id)
+    snap = PoolPlacement(pool_id, pool.pg_num, pool.size, shift,
+                         "batched", up=up, upp=upp)
+    temp = {pg.seed for pg in m.pg_temp
+            if pg.pool == pool_id and pg.seed < pool.pg_num}
+    temp |= {pg.seed for pg in m.primary_temp
+             if pg.pool == pool_id and pg.seed < pool.pg_num}
+    for seed in sorted(temp):
+        snap.resolved[seed] = _norm_placement(
+            pool.size, shift,
+            *m.pg_to_up_acting_osds(PGid(pool_id, seed)))
+    return snap
+
+
+def placement_delta(old: Optional[PoolPlacement],
+                    new: PoolPlacement) -> Optional[set]:
+    """Seeds whose (up, up_primary, acting, acting_primary) changed
+    between two snapshots.  ``None`` = treat everything as changed (no
+    old snapshot, or an incomparable shape change)."""
+    if old is None or old.size != new.size or old.shift != new.shift:
+        return None
+    if old.pg_num > new.pg_num:
+        return None  # shrink is unsupported upstream; stay safe
+    changed: set = set(range(old.pg_num, new.pg_num))  # pg_num growth
+    overlap = old.pg_num
+    if old.mode == "batched" and new.mode == "batched":
+        diff = np.nonzero(
+            (old.up[:overlap] != new.up[:overlap]).any(axis=1)
+            | (old.upp[:overlap] != new.upp[:overlap]))[0]
+        changed.update(int(s) for s in diff)
+        # temp-overridden seeds (either side) decide by the resolved
+        # 4-tuple: the raw arrays ignore pg_temp/primary_temp
+        for s in set(old.resolved) | set(new.resolved):
+            if s >= overlap:
+                continue
+            if old.resolve(s) != new.resolve(s):
+                changed.add(s)
+            else:
+                changed.discard(s)
+        return changed
+    # scalar snapshots (small pools, or a pool that crossed the batch
+    # threshold): per-seed tuple compare over the overlap
+    for s in range(overlap):
+        if old.resolve(s) != new.resolve(s):
+            changed.add(s)
+    return changed
+
+
+def affected_pgs(old: OSDMap, new: OSDMap, pool_id: int,
+                 batch_min: int = 0) -> set:
+    """Vectorized epoch delta: the set of seeds in ``pool_id`` whose
+    placement changed from ``old`` to ``new`` — whole-pool batched
+    placements diffed as arrays, sparse overrides re-checked scalar.
+    Bit-identical to :func:`affected_pgs_scalar` (tier-1 gate)."""
+    have_old = pool_id in old.pools
+    have_new = pool_id in new.pools
+    if not have_new:
+        return set(range(old.pools[pool_id].pg_num)) if have_old else set()
+    if not have_old:
+        return set(range(new.pools[pool_id].pg_num))
+    delta = placement_delta(placement_snapshot(old, pool_id, batch_min),
+                            placement_snapshot(new, pool_id, batch_min))
+    if delta is None:
+        return set(range(new.pools[pool_id].pg_num))
+    return delta
+
+
+def affected_pgs_scalar(old: OSDMap, new: OSDMap, pool_id: int) -> set:
+    """The per-PG-scan anchor: compare the full scalar placement chain
+    seed by seed.  O(pg_num) Python per epoch — exactly the cost the
+    vectorized path exists to avoid; kept as the bit-exactness oracle."""
+    have_old = pool_id in old.pools
+    have_new = pool_id in new.pools
+    if not have_new:
+        return set(range(old.pools[pool_id].pg_num)) if have_old else set()
+    if not have_old:
+        return set(range(new.pools[pool_id].pg_num))
+    pool = new.pools[pool_id]
+    if old.pools[pool_id].size != pool.size:
+        return set(range(pool.pg_num))  # width change: everything re-peers
+    changed = set()
+    for seed in range(pool.pg_num):
+        pgid = PGid(pool_id, seed)
+        a = _norm_placement(pool.size, pool.can_shift_osds(),
+                            *old.pg_to_up_acting_osds(pgid))
+        b = _norm_placement(pool.size, pool.can_shift_osds(),
+                            *new.pg_to_up_acting_osds(pgid))
+        if a != b:
+            changed.add(seed)
+    return changed
 
 
 def build_simple_osdmap(n_osds: int = 16, osds_per_host: int = 4,

@@ -73,6 +73,31 @@ OPTIONS: List[Option] = [
     Option("osd_map_cache_size", int, 50),
     Option("osd_map_batch_min_pgs", int, 256,
            "pools with at least this many PGs use batched placement"),
+    # control plane at scale (round 14): vectorized epoch deltas,
+    # bounded delta chains, and peering storm control.  The vectorized
+    # path defaults ON; 0 restores the per-PG rescan + full re-peer —
+    # the bit-exactness/bisection anchor.
+    Option("osd_map_vectorized_delta", int, 1,
+           "compute per-epoch affected-PG sets by diffing whole-pool "
+           "batched placements (osdmap.placement_delta) so epoch "
+           "application peers only PGs whose up/acting moved.  0 = "
+           "per-PG rescan and full re-peer on any change (the anchor)",
+           min=0, max=1),
+    Option("osd_map_max_inc_chain", int, 64,
+           "longest incremental chain an OSD applies from one map "
+           "message; beyond it the daemon requests a full map instead "
+           "of unpickling the chain on the dispatch loop", min=1),
+    Option("osd_peering_max_concurrent", int, 4,
+           "simultaneous peering rounds per OSD (reservation-style "
+           "throttle: a mass bounce produces a bounded wave, not a "
+           "stampede)", min=1),
+    Option("osd_peering_stagger_after", int, 8,
+           "peering waves larger than this stagger their round starts "
+           "with capped seeded jitter so hundreds of OSDs bouncing at "
+           "once desynchronize their peer queries (0 = never stagger)",
+           min=0),
+    Option("osd_peering_stagger_max", float, 0.25,
+           "cap on the per-round seeded stagger delay (s)", min=0),
     Option("osd_scrub_interval", float, 0.0,
            "background scrub period per primary PG (0 disables)"),
     Option("osd_op_queue", str, "fifo",
@@ -135,6 +160,15 @@ OPTIONS: List[Option] = [
     Option("mon_osd_down_out_interval", float, 30.0,
            "auto-out after down this long"),
     Option("mon_osd_min_down_reporters", int, 1),
+    Option("mon_osd_failure_coalesce", float, 0.05,
+           "window (s) to aggregate concurrent failure reports into "
+           "ONE map epoch — N simultaneous markdowns coalesce into one "
+           "incremental instead of N Paxos rounds (0 = commit each "
+           "markdown immediately, the pre-round-14 behavior)", min=0),
+    Option("mon_osd_map_max_incs", int, 32,
+           "longest incremental chain the mon sends one subscriber; "
+           "beyond it the mon skips to a full map (cheaper than a long "
+           "per-epoch pickle chain on both ends)", min=1),
     Option("mon_osd_beacon_grace", float, 6.0,
            "mark an osd down when its beacons go stale this long "
            "(reference osd_beacon_report_interval + mon grace)"),

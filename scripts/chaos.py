@@ -34,15 +34,23 @@ def main() -> int:
         p.add_argument("--scenario", required=True)
         p.add_argument("--seed", type=int, default=1)
         p.add_argument("--json", action="store_true")
+        p.add_argument("--scale", type=float, default=1.0,
+                       help="storm-scenario size factor: 1.0 = the "
+                            "full acceptance shape (slow), small "
+                            "fractions run the same code paths at "
+                            "tier-1 size (e.g. --scale 0.06)")
     args = ap.parse_args()
 
     from ceph_tpu.chaos.scenario import (
         build_schedule,
         builtin_scenarios,
         run_scenario,
+        storm_scenarios,
     )
 
     scenarios = builtin_scenarios()
+    if getattr(args, "scale", 1.0) != 1.0:
+        scenarios.update(storm_scenarios(args.scale))
     if args.cmd == "list":
         for name, sc in sorted(scenarios.items()):
             print(f"{name:24s} osds={sc.osds} rounds={sc.rounds} "

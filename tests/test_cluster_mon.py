@@ -67,8 +67,18 @@ def test_leader_failover_mid_pool_create():
                 return await client.pool_create("during", "replicated",
                                                 pg_num=4, size=3)
 
+            before = leader.perf.get("mon_proposals")
             task = asyncio.get_event_loop().create_task(create())
-            await asyncio.sleep(0.05)   # let the command take off
+            # converge-poll (round-14 deflake): wait until the create
+            # actually REACHED the leader's proposal path, then kill —
+            # a fixed sleep raced the command under load (too early:
+            # nothing in flight; too late: already committed)
+            deadline = asyncio.get_event_loop().time() + 5
+            while asyncio.get_event_loop().time() < deadline:
+                if leader.perf.get("mon_proposals") > before or \
+                        task.done():
+                    break
+                await asyncio.sleep(0.005)
             await cluster.kill_mon(dead_rank)
 
             p2 = await asyncio.wait_for(task, timeout=30)

@@ -171,6 +171,13 @@ def _mk_snap(ops=0, cwnd=256, pushbacks=0, hist=None, checks=None,
                                         pushbacks)],
         "ceph_osd_qos_served_reservation": [({"daemon": "osd.0"}, res)],
         "ceph_osd_qos_evicted": [({"daemon": "osd.0"}, evicted)],
+        # round-14 control-plane counters (the map_churn gate requires
+        # presence on the scrape)
+        "ceph_osd_map_epochs_applied": [({"daemon": "osd.0"}, 5)],
+        "ceph_osd_pgs_repeered": [({"daemon": "osd.0"}, 2)],
+        "ceph_osd_map_skip_to_full": [({"daemon": "osd.0"}, 0)],
+        "ceph_osd_peering_lat_hist_bucket": [
+            ({"daemon": "osd.0", "le": "+Inf"}, 2)],
     }
     if hist:
         prom["ceph_osd_op_lat_hist_bucket"] = [
@@ -252,12 +259,21 @@ def test_load_smoke_all_gates_and_bit_identical_replay():
     assert r1.offered == r2.offered == 180
     gates = {r["gate"] for r in rep1.rows}
     assert gates == {"goodput", "p99", "cwnd", "qos", "health",
-                     "deadline"}
+                     "map_churn", "deadline"}
     # every scrape-side gate really had scrape data behind it
     by = {r["gate"]: r for r in rep1.rows}
     assert by["goodput"]["value"] >= r1.offered * 0.5
     assert by["p99"]["value"] is not None
     assert by["cwnd"]["value"] is not None    # client counters scraped
+    # round-14 satellite: the control-plane counters (epochs applied,
+    # PGs re-peered, peering histogram, skip-to-full) are ON the
+    # scrape — the gate fails with "MISSING" when any drop off it.
+    # The smoke drives no map churn, so the epochs-applied DELTA is
+    # not asserted (whether a late pool-create epoch lands inside the
+    # judged window is a race); the counter-moves property is gated
+    # under real churn by test_control_plane's storm epochs floor.
+    assert by["map_churn"]["passed"], by["map_churn"]
+    assert by["map_churn"]["note"] == "", by["map_churn"]
 
 
 def test_mgr_scrape_carries_client_and_qos_counters():
