@@ -42,6 +42,8 @@ RULE = "task-spawn"
 
 # async daemon/driver code the rule polices (tests and scripts are
 # callers, not long-lived event-loop residents)
+# round 15: the cluster/ prefix covers the front-door libraries
+# (rbd/rgw*/mds/fs/snaps) — pinned by tests/test_frontdoor.py.
 SCOPE = ("ceph_tpu/cluster/", "ceph_tpu/load/",
          "ceph_tpu/osdmap/", "ceph_tpu/chaos/")
 

@@ -24,6 +24,10 @@ class ChaosCrash(_asyncio.CancelledError):
 
 
 from ceph_tpu.chaos.clock import ChaosClock  # noqa: F401
+from ceph_tpu.chaos.points import (  # noqa: F401
+    ChaosInterrupt,
+    maybe_interrupt,
+)
 from ceph_tpu.chaos.counters import (  # noqa: F401
     CHAOS,
     chaos_report,
@@ -46,4 +50,10 @@ from ceph_tpu.chaos.scenario import (  # noqa: F401
     builtin_scenarios,
     ev,
     run_scenario,
+)
+from ceph_tpu.chaos.frontdoor import (  # noqa: F401
+    FrontdoorScenario,
+    FrontdoorState,
+    frontdoor_scenarios,
+    run_frontdoor,
 )

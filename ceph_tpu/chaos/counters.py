@@ -40,6 +40,12 @@ for _name, _desc in (
     ("net_batch_ack_reorders", "batched-ack result lists shuffled"),
     ("crash_points_fired",
      "daemons power-cut at an armed tick/commit crash seam"),
+    ("interrupt_points_fired",
+     "client-library front-door ops cut at an armed interrupt seam"),
+    ("interrupt_retries",
+     "front-door transactions retried by a 'restarted' client"),
+    ("mds_crash_points_fired",
+     "MDS daemons crashed at an armed journal/replay seam"),
 ):
     CHAOS.add_u64(_name, desc=_desc)
 

@@ -168,6 +168,8 @@ def zero_rates(cluster) -> None:
     # resume it on revive — scrub those too, or the heal phase's own
     # revives would resurrect the injected rates mid-invariant-check
     configs += list(cluster.osd_configs.values())
+    # crashed MDS ranks resume their remembered config the same way
+    configs += list(getattr(cluster, "mds_configs", {}).values())
     if cluster.mgr is not None:
         configs.append(cluster.mgr.config)
     for d in (cluster.mdss or {}).values():

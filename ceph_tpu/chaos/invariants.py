@@ -278,6 +278,185 @@ def check_batch(cluster) -> List[str]:
     return []
 
 
+# -- front-door invariants (round 15) ---------------------------------------
+#
+# Application-LEVEL post-conditions for the L8 services, judged against
+# the workload's own bookkeeping (a FrontdoorState, chaos/frontdoor.py —
+# or any duck-typed stand-in: the synthetic-history unit tests drive
+# these checks with hand-built fakes).  Each check takes the surfaces it
+# needs as attributes of ``fd`` so the verdict logic is testable without
+# a cluster.
+
+
+async def _read_retry(fn, deadline, *args, **kwargs):
+    """Retry transient I/O errors until ``deadline``; returns
+    (value, error) — recovery may still be rewriting what we judge."""
+    while True:
+        try:
+            return await fn(*args, **kwargs), None
+        except FileNotFoundError as e:
+            # meaningful outcome for the caller, never retried away
+            return None, e
+        except (IOError, OSError, TimeoutError) as e:
+            if asyncio.get_event_loop().time() > deadline:
+                return None, e
+            await asyncio.sleep(0.5)
+
+
+async def check_snapshot(fd, timeout: float = 60.0) -> List[str]:
+    """RBD snapshot/clone consistency:
+
+    - every snapshot read is POINT-IN-TIME: each judged region holds one
+      whole generation that had been attempted before the snap acked —
+      never post-snap bytes (a COW miss), never a torn mix;
+    - clone parents are immutable: the parent snap's bytes pinned at
+      clone time read back identical after all child copy-up churn;
+    - the clone itself resolves correctly: regions the child acked hold
+      the child's bytes, untouched regions fall through to the pinned
+      parent snap (copy-up preserved, not clobbered).
+    """
+    failures: List[str] = []
+    deadline = asyncio.get_event_loop().time() + timeout
+    rs = fd.region_size
+    for snap in sorted(fd.snaps):
+        img = await fd.open_image(fd.image_name)
+        for region, allowed in sorted(fd.snaps[snap].items()):
+            got, err = await _read_retry(img.read, deadline,
+                                         region * rs, rs, snap_name=snap)
+            if err is not None:
+                failures.append(f"snapshot: {fd.image_name}@{snap} "
+                                f"region {region} unreadable: {err!r}")
+            elif bytes(got) not in allowed:
+                failures.append(
+                    f"snapshot: {fd.image_name}@{snap} region {region} "
+                    f"holds torn or post-snap bytes "
+                    f"{bytes(got)[:24]!r}...")
+    if fd.parent_pin:
+        img = await fd.open_image(fd.image_name)
+        for region, pinned in sorted(fd.parent_pin.items()):
+            got, err = await _read_retry(
+                img.read, deadline, region * rs, rs,
+                snap_name=fd.parent_snap)
+            if err is not None or bytes(got) != pinned:
+                failures.append(
+                    f"snapshot: clone parent {fd.image_name}"
+                    f"@{fd.parent_snap} region {region} MUTATED under "
+                    f"child churn (err={err!r})")
+    if fd.clone_expect:
+        clone = await fd.open_image(fd.clone_name)
+        for region, allowed in sorted(fd.clone_expect.items()):
+            got, err = await _read_retry(clone.read, deadline,
+                                         region * rs, rs)
+            if err is not None:
+                failures.append(f"snapshot: clone {fd.clone_name} "
+                                f"region {region} unreadable: {err!r}")
+            elif bytes(got) not in allowed:
+                failures.append(
+                    f"snapshot: clone {fd.clone_name} region {region} "
+                    f"lost copy-up bytes ({bytes(got)[:24]!r}...)")
+    return failures
+
+
+async def check_multipart(fd, timeout: float = 60.0) -> List[str]:
+    """RGW multipart consistency (judged AFTER the reclaim pass):
+
+    - an ACKED complete is fully visible: listed in the bucket index
+      and readable with exactly the manifest's bytes;
+    - an interrupted (never-acked) complete is ALL-OR-NOTHING: either
+      fully visible with exact bytes (reclaim rolled it forward) or
+      fully absent (listing and head agree on 404) — never partial;
+    - no orphaned part objects survive the reclaim pass;
+    - the bucket-index listing matches readable objects: every listed
+      key serves its payload.
+    """
+    failures: List[str] = []
+    deadline = asyncio.get_event_loop().time() + timeout
+    listing, err = await _read_retry(fd.rgw.list_objects, deadline,
+                                     fd.bucket, "", "", 100000)
+    if err is not None:
+        return [f"multipart: bucket {fd.bucket} unlistable: {err!r}"]
+    listed = {m.key for m in listing.keys}
+    for key, payload in sorted(fd.mp_completed.items()):
+        got, err = await _read_retry(fd.rgw.get_object, deadline,
+                                     fd.bucket, key)
+        if err is not None:
+            failures.append(f"multipart: acked complete {key} "
+                            f"unreadable: {err!r}")
+        elif got[1] != payload:
+            failures.append(f"multipart: acked complete {key} holds "
+                            f"wrong bytes ({len(got[1])} != "
+                            f"{len(payload)})")
+        if key not in listed:
+            failures.append(f"multipart: acked complete {key} missing "
+                            f"from the bucket listing")
+    for key, payload in sorted(fd.mp_pending.items()):
+        if key in listed:
+            got, err = await _read_retry(fd.rgw.get_object, deadline,
+                                         fd.bucket, key)
+            if err is not None or got[1] != payload:
+                failures.append(
+                    f"multipart: interrupted complete {key} is "
+                    f"PARTIALLY visible (listed but wrong/unreadable "
+                    f"bytes, err={err!r})")
+        else:
+            _, err = await _read_retry(fd.rgw.head_object, deadline,
+                                       fd.bucket, key)
+            if not isinstance(err, FileNotFoundError):
+                failures.append(
+                    f"multipart: interrupted complete {key} not listed "
+                    f"but head disagrees (err={err!r})")
+    orphans = await fd.part_oids()
+    if orphans:
+        failures.append(f"multipart: {len(orphans)} orphaned part "
+                        f"object(s) survive the reclaim pass: "
+                        f"{sorted(orphans)[:4]}")
+    for key in sorted(listed):
+        _, err = await _read_retry(fd.rgw.get_object, deadline,
+                                   fd.bucket, key)
+        if err is not None:
+            failures.append(f"multipart: listed key {key} is not "
+                            f"readable ({err!r}) — index diverged from "
+                            f"objects")
+    return failures
+
+
+async def check_namespace(fd, timeout: float = 60.0) -> List[str]:
+    """MDS namespace consistency after crash + journal replay:
+
+    - every ACKED metadata op's effect is present post-replay (an acked
+      mkdir/create resolves, an acked rename's destination exists) —
+      journal trim never ate an unreplayed segment;
+    - paths acked as REMOVED (rename source, unlink) stay gone — replay
+      never resurrects superseded state;
+    - unacked ops may have landed or not (at-least-once journalling),
+      but the tree itself must be walkable: every model directory
+      lists cleanly.
+    """
+    failures: List[str] = []
+    deadline = asyncio.get_event_loop().time() + timeout
+    for path, kind in sorted(fd.ns_model.items()):
+        ino, err = await _read_retry(fd.fs_stat, deadline, path)
+        if err is not None:
+            failures.append(f"namespace: acked {kind} {path} lost "
+                            f"post-replay ({err!r})")
+        elif getattr(ino, "mode", kind) != kind:
+            failures.append(f"namespace: {path} is {ino.mode}, acked "
+                            f"as {kind}")
+    for path in sorted(fd.ns_gone):
+        _, err = await _read_retry(fd.fs_stat, deadline, path)
+        if not isinstance(err, FileNotFoundError):
+            failures.append(f"namespace: removed path {path} "
+                            f"resurrected post-replay (err={err!r})")
+    for path, kind in sorted(fd.ns_model.items()):
+        if kind != "dir":
+            continue
+        _, err = await _read_retry(fd.fs_listdir, deadline, path)
+        if err is not None:
+            failures.append(f"namespace: dir {path} unlistable "
+                            f"post-replay ({err!r})")
+    return failures
+
+
 def check_lockdep() -> List[str]:
     """The observed runtime lock graph must be acyclic (the same graph
     `lockdep dump` serves and graftlint merges)."""

@@ -182,6 +182,20 @@ def build_schedule(scenario: Scenario, seed: int) -> List[Dict]:
             # point.  Planned as a probable kill (floor bookkeeping);
             # the skip count resolves from the seeded stream so WHICH
             # traversal dies replays bit-identically.
+            #
+            # Round 15: "client" targets arm a LIBRARY interrupt seam
+            # (no daemon dies — the front-door op unwinds and the
+            # workload's retry models a restarted application) and
+            # "mds.N" targets crash that MDS rank (restarted by the
+            # front-door babysitter, never an OSD) — neither touches
+            # the OSD alive/dead bookkeeping.
+            if target == "client" or target.startswith("mds"):
+                if entry["args"].get("at") is None:
+                    entry["args"]["at"] = rng.randrange(0, 3)
+                entry["target"] = target
+                entry["seq"] = i
+                plan.append(entry)
+                continue
             if target == "random_osd":
                 pool = sorted(alive)
                 if len(pool) <= scenario.pool_size:
@@ -305,10 +319,14 @@ async def judge_invariants(cluster, dmn: DaemonInjector, io,
                            acked_crcs: Optional[Dict[str, int]] = None,
                            snaps: Optional[Dict] = None,
                            deadline_misses: Optional[List[str]] = None,
+                           frontdoor=None,
                            ) -> List[str]:
-    """THE invariant dispatch table, shared by chaos scenarios and
-    graft-load soaks (an invariant added here is immediately nameable
-    from both; a soak naming one this table lacks fails loudly)."""
+    """THE invariant dispatch table, shared by chaos scenarios,
+    graft-load soaks, and front-door scenarios (an invariant added here
+    is immediately nameable from all three; a run naming one this table
+    lacks fails loudly).  ``frontdoor`` is the application-level
+    bookkeeping a FrontdoorState carries (chaos/frontdoor.py) — the
+    snapshot/multipart/namespace invariants judge against it."""
     failures: List[str] = []
     for name in invariants:
         if name == "durability":
@@ -338,6 +356,19 @@ async def judge_invariants(cluster, dmn: DaemonInjector, io,
                 cluster, marks=dmn.frontier_marks, timeout=timeout)
         elif name == "batch":
             failures += inv.check_batch(cluster)
+        elif name in ("snapshot", "multipart", "namespace"):
+            if frontdoor is None:
+                failures.append(f"{name}: invariant requires a "
+                                f"front-door workload context")
+            elif name == "snapshot":
+                failures += await inv.check_snapshot(frontdoor,
+                                                     timeout=timeout)
+            elif name == "multipart":
+                failures += await inv.check_multipart(frontdoor,
+                                                      timeout=timeout)
+            else:
+                failures += await inv.check_namespace(frontdoor,
+                                                      timeout=timeout)
         else:
             failures.append(f"unknown invariant {name!r}")
     return failures
@@ -613,6 +644,19 @@ async def _apply_event(cluster, dmn: DaemonInjector, client, io,
     elif action == "clock_skew":
         for cfg in _target_configs(cluster, target):
             cfg.injectargs({"chaos_clock_skew": args["skew"]})
+    elif action == "crash_mds":
+        # power-cut an MDS rank (round 15): its journal + dirfrags live
+        # in RADOS; the restarted rank's boot replay is the recovery
+        rank = int(target.split(".")[1]) if "." in target else 0
+        if (cluster.mdss or {}).get(rank) is not None:
+            await cluster.crash_mds(rank)
+            CHAOS.inc("daemon_kills")
+    elif action == "revive_mds":
+        rank = int(target.split(".")[1]) if "." in target else 0
+        pools = cluster.mds_pools.get(rank)
+        if pools is not None and (cluster.mdss or {}).get(rank) is None:
+            await cluster.start_mds(pools[0], pools[1], rank=rank)
+            CHAOS.inc("daemon_revives")
     elif action == "kill_mon":
         rank = None
         if target == "mon_leader":
@@ -652,6 +696,11 @@ def _target_configs(cluster, target: str):
         osd = cluster.osds.get(int(target.split(".")[1]))
         if osd is not None:
             yield osd.config
+    elif target.startswith("mds"):
+        _, _, num = target.partition(".")
+        daemon = (cluster.mdss or {}).get(int(num) if num else 0)
+        if daemon is not None:
+            yield daemon.config
     elif target.startswith("mon"):
         _, _, num = target.partition(".")
         rank = int(num) if num else 0

@@ -138,6 +138,45 @@ class MDSDaemon(Dispatcher):
         from collections import OrderedDict as _OD
 
         self._completed: "_OD[Tuple[str, int], MClientReply]" = _OD()
+        # chaos crash points (round 15): the MDS is a daemon, so an
+        # armed seam crashes it through the launcher's callback like an
+        # OSD.  The MDS has no local store (all state lives in RADOS),
+        # so "power cut" = stop serving at this instant; the restarted
+        # rank replays its journal.
+        self._chaos_crash_cb = None
+
+    def _chaos_point(self, name: str) -> None:
+        """Named crash seam (the OSD._chaos_point twin for MDS ranks):
+        when the armed ``chaos_crash_point`` matches, this rank dies AT
+        THIS INSTANT — ``_stopped`` flips before anything else runs,
+        teardown is handed to the launcher's callback, and ChaosCrash
+        unwinds the current request like a task dying mid-await.  One
+        falsy test when unarmed (no-op contract).
+
+        The armed value may be a CHAIN ("mds_journal_mid,mds_replay_mid"):
+        firing pops the head and arms the remainder in this rank's
+        config — and since a restarted rank RESUMES its per-rank config,
+        the chain spans incarnations (crash mid-append, then crash the
+        next boot's replay of that very event).  An empty remainder
+        disarms, so a replay-seam point can never crash-loop the rank.
+        """
+        if not self.config.chaos_crash_point or self._stopped:
+            return
+        from ceph_tpu.chaos import ChaosCrash
+        from ceph_tpu.chaos.counters import CHAOS
+        from ceph_tpu.chaos.points import resolve_fire
+
+        if not resolve_fire(self.config, name):
+            return
+        self._stopped = True
+        CHAOS.inc("crash_points_fired")
+        CHAOS.inc("mds_crash_points_fired")
+        cb = self._chaos_crash_cb
+        if cb is not None:
+            # the callback task is OWNED BY THE LAUNCHER (it outlives
+            # this daemon's stop())
+            cb(name)
+        raise ChaosCrash(f"mds chaos crash point {name!r} fired")
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -373,7 +412,18 @@ class MDSDaemon(Dispatcher):
     async def _replay_journal(self) -> None:
         """Apply journal events beyond the applied watermark (MDSRank
         replay): a crash between append and apply re-runs the event;
-        the dirfrag ops tolerate replays (EEXIST/ENOENT are fine)."""
+        the dirfrag ops tolerate replays (EEXIST/ENOENT mean the
+        event's effect is already present).
+
+        Round-15 hardening (found by the mds-journal-replay scenario):
+        a TRANSIENT apply failure (meta-pool op timeout while the
+        cluster is still converging) used to be swallowed alongside the
+        idempotent-replay errors — the watermark then advanced past the
+        never-applied event and the trim ATE IT, silently losing an
+        acked metadata op.  Now transient failures retry, and if they
+        persist the replay commits only the contiguous applied prefix
+        and fails the boot loudly: trim can never pass an unreplayed
+        segment, and the next boot replays it again."""
         applied, events = await self._journal_state()
         top = applied
         for key in sorted(events):
@@ -381,11 +431,21 @@ class MDSDaemon(Dispatcher):
             if seq <= applied:
                 continue
             event = pickle.loads(events[key])
-            try:
-                await self._apply(event)
-                self.perf.inc("mds_journal_replays")
-            except (FileExistsError, FileNotFoundError, IOError):
-                pass  # replayed event already (partially) applied
+            self._chaos_point("mds_replay_mid")
+            for attempt in range(3):
+                try:
+                    await self._apply(event)
+                    self.perf.inc("mds_journal_replays")
+                    break
+                except (FileExistsError, FileNotFoundError):
+                    break  # replayed event already (partially) applied
+                except (IOError, OSError, TimeoutError,
+                        ConnectionError):
+                    if attempt == 2:
+                        if top > applied:
+                            await self._journal_commit(top)
+                        raise
+                    await asyncio.sleep(0.2 * (attempt + 1))
             top = max(top, seq)
         if top > applied:
             await self._journal_commit(top)
@@ -410,6 +470,10 @@ class MDSDaemon(Dispatcher):
     async def ms_dispatch(self, conn: Connection, msg) -> bool:
         from ceph_tpu.cluster import messages as _M
 
+        if self._stopped:
+            # a crashed rank serves nothing (the power-cut contract);
+            # the client's retry loop re-resolves the restarted rank
+            return True
         if isinstance(msg, _M.MCommand):
             # 'ceph daemon mds.N ...' admin surface
             result, data = await self.asok.dispatch(msg.cmd)
@@ -477,6 +541,9 @@ class MDSDaemon(Dispatcher):
                     self._seq += 1
                     seq = self._seq
                     await self._journal_append(seq, (msg.op,) + msg.args)
+                    # journalled but not yet applied: a crash here is
+                    # the canonical replay case (append -> apply gap)
+                    self._chaos_point("mds_journal_mid")
                     data = await self._apply((msg.op,) + msg.args)
                     await self._journal_commit(seq)
                 reply = MClientReply(tid=msg.tid, result=0, data=data)

@@ -206,6 +206,18 @@ def _rbd_journal_trim(ctx: MethodContext, indata: bytes) -> bytes:
 
 
 
+@register("rgw_mp", "alloc")
+def _mp_alloc(ctx: MethodContext, indata: bytes) -> bytes:
+    """Atomic multipart upload-id allocation (reference cls_rgw keeps
+    multipart meta under the bucket index the same way): the counter
+    read-increment-write runs under PG serialization, so two racing
+    InitMultipart calls can never mint the same id.  The counter key is
+    underscore-prefixed so registry listings can filter it."""
+    seq = int(ctx.omap_get().get("_next", b"1"))
+    ctx.omap_set({"_next": str(seq + 1).encode()})
+    return str(seq).encode()
+
+
 @register("rgw_bilog", "append")
 def _bilog_append(ctx: MethodContext, indata: bytes) -> bytes:
     """Atomic bucket-index-log append (reference cls_rgw bilog ops):

@@ -268,15 +268,25 @@ class Objecter(Dispatcher):
         return out
 
     async def _refresh_map(self) -> None:
-        self._map_event.clear()
-        await self._mon_send(
-            M.MMonSubscribe(what="osdmap", addr=self.messenger.my_addr,
-                            since=self.osdmap.epoch if self.osdmap else 0))
-        try:
-            await asyncio.wait_for(self._map_event.wait(), timeout=10)
-        except asyncio.TimeoutError:
-            self._hunt()
-            raise
+        # A subscribe that lands in a DYING mon's socket gets no push
+        # back — the send itself "succeeds" into a half-dead session.
+        # One silent window must not fail the caller (a pool_create
+        # racing a leader failover saw exactly this): hunt to the next
+        # mon and re-subscribe before giving up.
+        for attempt in range(3):
+            self._map_event.clear()
+            await self._mon_send(
+                M.MMonSubscribe(what="osdmap",
+                                addr=self.messenger.my_addr,
+                                since=self.osdmap.epoch
+                                if self.osdmap else 0))
+            try:
+                await asyncio.wait_for(self._map_event.wait(), timeout=4)
+                return
+            except asyncio.TimeoutError:
+                self._hunt()
+                if attempt == 2:
+                    raise
 
     # -- op submission with resend-on-map-change ---------------------------
 
@@ -746,9 +756,10 @@ class IoCtx:
             raise IOError(f"cmpxattr({oid}) -> {reply.result}")
         return True
 
-    async def stat(self, oid: str, snapid: int = None) -> int:
+    async def stat(self, oid: str, snapid: int = None,
+                   timeout: float = None) -> int:
         reply = await self.objecter.op_submit(
-            self.pool_id, oid, [("stat", {})],
+            self.pool_id, oid, [("stat", {})], timeout=timeout,
             snapid=snapid if snapid is not None else self._snap_read)
         if reply.result != 0:
             raise FileNotFoundError(oid)
@@ -806,37 +817,41 @@ class IoCtx:
 
     # -- omap ---------------------------------------------------------------
 
-    async def omap_set(self, oid: str, kv: Dict[str, bytes]) -> None:
+    async def omap_set(self, oid: str, kv: Dict[str, bytes],
+                       timeout: float = None) -> None:
         reply = await self.objecter.op_submit(
             self.pool_id, oid, [("omap_set", {"kv": dict(kv)})],
-            snapc=self._write_snapc())
+            timeout=timeout, snapc=self._write_snapc())
         if reply.result != 0:
             raise IOError(f"omap_set({oid}) -> {reply.result}")
 
     async def omap_get(self, oid: str,
-                       snapid: Optional[int] = None) -> Dict[str, bytes]:
+                       snapid: Optional[int] = None,
+                       timeout: float = None) -> Dict[str, bytes]:
         reply = await self.objecter.op_submit(
-            self.pool_id, oid, [("omap_get", {})],
+            self.pool_id, oid, [("omap_get", {})], timeout=timeout,
             snapid=snapid if snapid is not None else self._snap_read)
         if reply.result != 0:
             raise IOError(f"omap_get({oid}) -> {reply.result}")
         return reply.data
 
-    async def omap_rmkeys(self, oid: str, keys) -> None:
+    async def omap_rmkeys(self, oid: str, keys,
+                          timeout: float = None) -> None:
         reply = await self.objecter.op_submit(
             self.pool_id, oid, [("omap_rmkeys", {"keys": list(keys)})],
-            snapc=self._write_snapc())
+            timeout=timeout, snapc=self._write_snapc())
         if reply.result != 0:
             raise IOError(f"omap_rmkeys({oid}) -> {reply.result}")
 
     # -- object classes (rados_exec) ----------------------------------------
 
     async def execute(self, oid: str, cls: str, method: str,
-                      indata: bytes = b"") -> bytes:
+                      indata: bytes = b"",
+                      timeout: float = None) -> bytes:
         reply = await self.objecter.op_submit(
             self.pool_id, oid, [("exec", {"cls": cls, "method": method,
                                           "indata": bytes(indata)})],
-            snapc=self._write_snapc())
+            timeout=timeout, snapc=self._write_snapc())
         if reply.result != 0:
             raise IOError(
                 f"exec({oid}, {cls}.{method}) -> {reply.result}: "

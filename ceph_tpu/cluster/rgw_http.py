@@ -20,7 +20,6 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import hmac
-import secrets as _secrets
 import time
 import urllib.parse
 from typing import Dict, List, Optional, Tuple
@@ -53,8 +52,6 @@ class RGWFrontend:
         self._server = None
         self.addr: Optional[Tuple[str, int]] = None
         self._conns: List = []
-        # upload_id -> (bucket, key, {part_no: (etag, size)})
-        self._uploads: Dict[str, Tuple[str, str, Dict[int, Tuple[str, int]]]] = {}
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
         self._server = await asyncio.start_server(self._serve, host, port)
@@ -430,10 +427,13 @@ class RGWFrontend:
         return "405 Method Not Allowed", {}, b""
 
     async def _object_op(self, req: S3Request, bucket: str, key: str):
-        # -- multipart sub-protocol (rgw_op.cc multipart ops) --
+        # -- multipart sub-protocol (rgw_op.cc multipart ops), served
+        #    by the DURABLE core (round 15): the upload registry lives
+        #    in RADOS, so a frontend restart mid-upload loses nothing
+        #    and reclaim_multipart can always finish an interrupted
+        #    complete/abort --
         if "uploads" in req.query and req.method == "POST":
-            upload_id = _secrets.token_hex(8)
-            self._uploads[upload_id] = (bucket, key, {})
+            upload_id = await self.rgw.create_multipart(bucket, key)
             body = (f"<?xml version='1.0'?><InitiateMultipartUploadResult>"
                     f"<Bucket>{_xml_escape(bucket)}</Bucket>"
                     f"<Key>{_xml_escape(key)}</Key>"
@@ -450,49 +450,28 @@ class RGWFrontend:
 
     # -- multipart ---------------------------------------------------------
 
-    def _part_oid(self, upload_id: str, n: int) -> str:
-        return f".multipart.{upload_id}.{n:05d}"
-
     async def _multipart_op(self, req: S3Request, bucket: str, key: str,
                             upload_id: str):
-        entry = self._uploads.get(upload_id)
-        if entry is None or entry[0] != bucket or entry[1] != key:
+        try:
+            if req.method == "PUT":
+                n = int(req.query["partNumber"])
+                etag = await self.rgw.upload_part(bucket, key,
+                                                  upload_id, n, req.body)
+                return "200 OK", {"ETag": f'"{etag}"'}, b""
+            if req.method == "POST":
+                etag = await self.rgw.complete_multipart(bucket, key,
+                                                         upload_id)
+                body = (f"<?xml version='1.0'?>"
+                        f"<CompleteMultipartUploadResult>"
+                        f"<Key>{_xml_escape(key)}</Key>"
+                        f"<ETag>&quot;{etag}&quot;</ETag>"
+                        f"</CompleteMultipartUploadResult>").encode()
+                return ("200 OK", {"Content-Type": "application/xml"},
+                        body)
+            if req.method == "DELETE":   # abort
+                await self.rgw.abort_multipart(bucket, key, upload_id)
+                return "204 No Content", {}, b""
+        except FileNotFoundError:
             return "404 Not Found", {}, self._error_xml(
                 "NoSuchUpload", upload_id)
-        _, _, parts = entry
-        if req.method == "PUT":
-            n = int(req.query["partNumber"])
-            await self.rgw.ioctx.write_full(
-                self._part_oid(upload_id, n), req.body)
-            etag = hashlib.md5(req.body).hexdigest()
-            parts[n] = (etag, len(req.body))
-            return "200 OK", {"ETag": f'"{etag}"'}, b""
-        if req.method == "POST":
-            # CompleteMultipartUpload: assemble parts IN part order
-            data = bytearray()
-            for n in sorted(parts):
-                data += await self.rgw.ioctx.read(
-                    self._part_oid(upload_id, n))
-            etag = await self.rgw.put_object(bucket, key, bytes(data))
-            for n in sorted(parts):
-                try:
-                    await self.rgw.ioctx.remove(
-                        self._part_oid(upload_id, n))
-                except FileNotFoundError:
-                    pass
-            del self._uploads[upload_id]
-            body = (f"<?xml version='1.0'?><CompleteMultipartUploadResult>"
-                    f"<Key>{_xml_escape(key)}</Key>"
-                    f"<ETag>&quot;{etag}&quot;</ETag>"
-                    f"</CompleteMultipartUploadResult>").encode()
-            return "200 OK", {"Content-Type": "application/xml"}, body
-        if req.method == "DELETE":   # abort
-            for n in sorted(parts):
-                try:
-                    await self.rgw.ioctx.remove(
-                        self._part_oid(upload_id, n))
-                except FileNotFoundError:
-                    pass
-            del self._uploads[upload_id]
-            return "204 No Content", {}, b""
         return "405 Method Not Allowed", {}, b""

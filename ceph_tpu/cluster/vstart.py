@@ -51,6 +51,13 @@ class Cluster:
     # mid-flight).  Self-discarding; drain_chaos() awaits stragglers so
     # a scenario's heal phase never races a crash still in progress.
     _chaos_tasks: set = field(default_factory=set)
+    # per-rank config copies of crashed MDS ranks (round 15): like
+    # osd_configs, a restarted rank resumes its OWN config so injected
+    # fault options (e.g. an armed replay-seam crash point) survive the
+    # bounce; the rank's pools ride along so a babysitter can restart
+    # it without re-deriving them
+    mds_configs: Dict[int, Config] = field(default_factory=dict)
+    mds_pools: Dict[int, tuple] = field(default_factory=dict)
 
     def _arm_chaos_crash(self, osd: OSDDaemon) -> None:
         """Install the crash-point callback: when the daemon's write
@@ -80,11 +87,16 @@ class Cluster:
     async def start_mds(self, meta_pool: int, data_pool: int,
                         rank: int = 0):
         """Start (or restart) an active MDS rank over existing pools
-        (multiple ranks = multi-active, subtree-partitioned)."""
+        (multiple ranks = multi-active, subtree-partitioned).  A rank
+        crashed at a chaos seam resumes its own per-rank config copy
+        (mds_configs), like an OSD revive."""
         from ceph_tpu.cluster.mds import MDSDaemon
 
+        cfg = self.mds_configs.pop(rank, None) or self.config
         daemon = MDSDaemon(self.mon_addr, meta_pool, data_pool,
-                           config=self.config, rank=rank)
+                           config=cfg, rank=rank)
+        self._arm_chaos_crash_mds(daemon)
+        self.mds_pools[rank] = (meta_pool, data_pool)
         addr = await daemon.start()
         if self.mdss is None:
             self.mdss = {}
@@ -93,6 +105,43 @@ class Cluster:
             self.mds = daemon
             self.mds_addr = addr
         return daemon
+
+    def _arm_chaos_crash_mds(self, daemon) -> None:
+        """Install the MDS crash-point callback: when the rank's serve
+        or replay path trips an armed chaos_crash_point, the cluster
+        performs the same bookkeeping as crash_mds (per-rank config
+        remembered; the rank's durable state already lives in RADOS)."""
+        from ceph_tpu.utils.tasks import track_task
+
+        def fire(point: str) -> None:
+            async def _crash():
+                if (self.mdss or {}).get(daemon.rank) is daemon:
+                    await self.crash_mds(daemon.rank)
+                else:
+                    # crashed during boot, before registration: remember
+                    # the config and put the half-started daemon down
+                    self.mds_configs.setdefault(daemon.rank,
+                                                daemon.config)
+                    await daemon.stop()
+
+            track_task(self._chaos_tasks,
+                       asyncio.get_event_loop().create_task(_crash()))
+
+        daemon._chaos_crash_cb = fire
+
+    async def crash_mds(self, rank: int) -> None:
+        """Power-cut an MDS rank (round 15): stop it at this instant,
+        remembering its per-rank config for the restart.  The MDS holds
+        no local store — its journal and dirfrags live in RADOS — so
+        the restarted rank's boot replay is the recovery path."""
+        daemon = (self.mdss or {}).pop(rank, None)
+        if daemon is None:
+            return
+        self.mds_configs[rank] = daemon.config
+        if self.mds is daemon:
+            self.mds = next(iter((self.mdss or {}).values()), None)
+        daemon._stopped = True
+        await daemon.stop()
 
     @property
     def mon(self) -> Monitor:

@@ -5,7 +5,8 @@ multiplexed over a BOUNDED pool of objecter sessions (the reference's
 librados apps share a handful of RADOS connections the same way), each
 client an independent seeded arrival process (fixed-rate or Poisson)
 drawing verbs from a weighted mix (librados write/read/RMW/append/
-delete, RBD striped image I/O, RGW object puts) and object targets from
+delete, RBD striped image I/O + snapshot lifecycle + clone reads, RGW
+object puts + full multipart transactions) and object targets from
 a zipfian hot-set — all declared as a ``LoadSpec`` and resolved by
 ``build_plan(spec, seed)`` into a concrete per-client op schedule with
 the same replay-key determinism contract as chaos scenarios: the same
@@ -168,10 +169,12 @@ class LoadResult:
 
 class LoadContext:
     """A booted cluster + bounded session pool + workload surfaces
-    (librados pool, RBD image, RGW bucket), reusable across load
-    windows (the ramp sweeps many windows over one cluster)."""
+    (librados pool, RBD image + clone, RGW bucket), reusable across
+    load windows (the ramp sweeps many windows over one cluster)."""
 
     RBD_IMAGE = "load_img"
+    RBD_CLONE = "load_clone"
+    RBD_SNAP = "load_base"
     RBD_SIZE = 8 << 20
     RGW_BUCKET = "loadb"
 
@@ -181,6 +184,7 @@ class LoadContext:
         self.pool: Optional[int] = None
         self._owns_cluster = False
         self._images: Dict[int, object] = {}
+        self._clones: Dict[int, object] = {}
         self._rgws: Dict[int, object] = {}
         self._rbd_ready = False
         self._rgw_ready = False
@@ -220,9 +224,12 @@ class LoadContext:
         for j in range(spec.sessions):
             ctx.sessions.append(await cluster.client(name=f"load{j}"))
         verbs = {v for v, _w in spec.verbs}
-        if verbs & {"rbd_write", "rbd_read"}:
+        if verbs & {"rbd_write", "rbd_read", "rbd_snap",
+                    "rbd_clone_read"}:
             await ctx._setup_rbd()
-        if verbs & {"rgw_put", "rgw_get"}:
+        if "rbd_clone_read" in verbs:
+            await ctx._setup_rbd_clone()
+        if verbs & {"rgw_put", "rgw_get", "rgw_multipart"}:
             await ctx._setup_rgw()
         return ctx
 
@@ -242,6 +249,27 @@ class LoadContext:
         for j in range(len(self.sessions)):
             self._images[j] = await RBD(self.io(j)).open(self.RBD_IMAGE)
         self._rbd_ready = True
+
+    async def _setup_rbd_clone(self) -> None:
+        """Parent data + snapshot + COW clone for the rbd_clone_read
+        verb: clone reads exercise the copy-up fall-through path under
+        load (unwritten child extents resolve to the parent snap)."""
+        from ceph_tpu.cluster.rbd import RBD
+
+        img = self._images[0]
+        if self.RBD_SNAP not in img.snap_list():
+            await img.write(0, b"load-clone-parent-" * 512)
+            try:
+                await img.snap_create(self.RBD_SNAP)
+            except FileExistsError:
+                pass
+        try:
+            await RBD(self.io(0)).clone(self.RBD_IMAGE, self.RBD_SNAP,
+                                        self.RBD_CLONE)
+        except FileExistsError:
+            pass
+        for j in range(len(self.sessions)):
+            self._clones[j] = await RBD(self.io(j)).open(self.RBD_CLONE)
 
     async def _setup_rgw(self) -> None:
         from ceph_tpu.cluster.rgw import RGW
@@ -341,12 +369,11 @@ async def _one_op(ctx: LoadContext, spec: LoadSpec, cid: int, op: Dict,
     loop = asyncio.get_event_loop()
     start = loop.time()
     timeout = spec.op_deadline
-    # the librados verbs carry the client deadline end-to-end, so their
-    # acks are judged against it (the zero-acked-past-deadline
-    # criterion); RBD/RGW verbs fan into several internal RADOS ops on
-    # the library default budget — acks counted, deadline not judged
-    deadline_tracked = verb in ("write", "read", "rmw", "append",
-                                "delete")
+    # EVERY verb carries the client deadline end-to-end now (round 15:
+    # the RBD/RGW libraries thread ONE wall deadline through their
+    # internal fan-out via utils.deadline), so every ack is judged
+    # against the zero-acked-past-deadline criterion
+    deadline_tracked = True
     acked = False
     try:
         if verb == "write":
@@ -378,20 +405,59 @@ async def _one_op(ctx: LoadContext, spec: LoadSpec, cid: int, op: Dict,
         elif verb == "rbd_write":
             img = ctx._images[j]
             off = (nonce % (ctx.RBD_SIZE - (64 << 10))) & ~0xFFF
-            await img.write(off, _payload(spec, cid, "rbd", nonce)[:16384])
+            await img.write(off, _payload(spec, cid, "rbd", nonce)[:16384],
+                            timeout=timeout)
         elif verb == "rbd_read":
             img = ctx._images[j]
             off = (nonce % (ctx.RBD_SIZE - (64 << 10))) & ~0xFFF
-            await img.read(off, 16384)
+            await img.read(off, 16384, timeout=timeout)
+        elif verb == "rbd_snap":
+            # snapshot lifecycle under load: create + drop ONE snap on
+            # a unique name, both halves inside the one op budget
+            from ceph_tpu.utils.deadline import deadline_of, remaining
+
+            img = ctx._images[j]
+            name = f"ls-c{cid}-{nonce}"
+            dl = deadline_of(timeout)
+            await img.snap_create(name, timeout=remaining(dl))
+            try:
+                await img.snap_remove(name, timeout=remaining(dl))
+            except (KeyError, FileNotFoundError):
+                # a concurrent snap_create's header save won the race
+                # (load images share handles); the stray snap is
+                # harmless to the ack bookkeeping
+                pass
+        elif verb == "rbd_clone_read":
+            img = ctx._clones[j]
+            off = (nonce % (ctx.RBD_SIZE - (64 << 10))) & ~0xFFF
+            await img.read(off, 16384, timeout=timeout)
         elif verb == "rgw_put":
             await ctx._rgws[j].put_object(
                 ctx.RGW_BUCKET, f"k{rank}",
-                _payload(spec, cid, "rgw", nonce)[:4096])
+                _payload(spec, cid, "rgw", nonce)[:4096],
+                timeout=timeout)
         elif verb == "rgw_get":
             try:
-                await ctx._rgws[j].get_object(ctx.RGW_BUCKET, f"k{rank}")
+                await ctx._rgws[j].get_object(ctx.RGW_BUCKET, f"k{rank}",
+                                              timeout=timeout)
             except (FileNotFoundError, KeyError):
                 result.read_misses += 1
+        elif verb == "rgw_multipart":
+            # a full 2-part multipart transaction (initiate -> parts ->
+            # complete) through the durable registry, one op budget
+            from ceph_tpu.utils.deadline import deadline_of, remaining
+
+            rgw = ctx._rgws[j]
+            key = f"mpl{rank}"
+            dl = deadline_of(timeout)
+            uid = await rgw.create_multipart(ctx.RGW_BUCKET, key,
+                                             timeout=remaining(dl))
+            half = _payload(spec, cid, "mp", nonce)[:2048]
+            for n in (1, 2):
+                await rgw.upload_part(ctx.RGW_BUCKET, key, uid, n,
+                                      half, timeout=remaining(dl))
+            await rgw.complete_multipart(ctx.RGW_BUCKET, key, uid,
+                                         timeout=remaining(dl))
         else:
             raise ValueError(f"unknown load verb {verb!r}")
         acked = True
@@ -457,15 +523,17 @@ def builtin_specs() -> Dict[str, LoadSpec]:
         "smoke-micro": LoadSpec(
             name="smoke-micro", clients=16, sessions=2, rate=1.5,
             duration=1.2, objects=16, payload=1024, osds=3, pg_num=4),
-        # every front door at once: librados + RBD striped image I/O +
-        # RGW object puts through rgw.py
+        # every front door at once: librados + RBD striped image I/O,
+        # snapshots and clone reads + RGW object puts and multipart
+        # transactions through rgw.py (round 15 verbs included)
         "mixed": LoadSpec(
             name="mixed", clients=96, sessions=6, rate=1.0,
             duration=3.0, objects=48, payload=4096, osds=3, pg_num=8,
             verbs=(("write", 3.0), ("read", 2.0), ("rmw", 1.0),
                    ("append", 1.0), ("rbd_write", 1.5),
                    ("rbd_read", 1.0), ("rgw_put", 1.5),
-                   ("rgw_get", 1.0))),
+                   ("rgw_get", 1.0), ("rbd_snap", 0.5),
+                   ("rbd_clone_read", 0.8), ("rgw_multipart", 0.8))),
         # the ramp shape: EC pool behind a deliberate admission budget,
         # so stepping the offered rate eventually trips pushback and
         # the knee is a real saturation point (AIMD cwnd + goodput

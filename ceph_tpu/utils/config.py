@@ -260,7 +260,14 @@ OPTIONS: List[Option] = [
            "time its write path passes this point (tick_mid_encode, "
            "tick_post_encode, commit_pre_fanout, commit_mid_fanout, "
            "frontier_open, frontier_pre_done, batch_apply_mid); "
-           "one-shot, '' = off"),
+           "one-shot, '' = off.  Round 15 adds front-door seams: on "
+           "an MDS config, mds_journal_mid (journalled but unapplied) "
+           "and mds_replay_mid (boot replay cut between events) crash "
+           "the rank; on a CLIENT config, rbd_snap_pre_header, "
+           "rbd_copyup_mid, rbd_clone_mid, rgw_part_mid, "
+           "rgw_complete_mid, and rgw_abort_mid interrupt the library "
+           "op (ChaosInterrupt) — the 'application' dies "
+           "mid-transaction and a retry models its restart"),
     Option("chaos_crash_point_skip", int, 0,
            "traversals of the armed crash point to let pass before "
            "firing (seed-resolved by scenarios for deterministic "

@@ -41,6 +41,11 @@ def main() -> int:
                             "tier-1 size (e.g. --scale 0.06)")
     args = ap.parse_args()
 
+    from ceph_tpu.chaos.frontdoor import (
+        FrontdoorScenario,
+        frontdoor_scenarios,
+        run_frontdoor,
+    )
     from ceph_tpu.chaos.scenario import (
         build_schedule,
         builtin_scenarios,
@@ -49,8 +54,10 @@ def main() -> int:
     )
 
     scenarios = builtin_scenarios()
+    scenarios.update(frontdoor_scenarios(1.0))
     if getattr(args, "scale", 1.0) != 1.0:
         scenarios.update(storm_scenarios(args.scale))
+        scenarios.update(frontdoor_scenarios(args.scale))
     if args.cmd == "list":
         for name, sc in sorted(scenarios.items()):
             print(f"{name:24s} osds={sc.osds} rounds={sc.rounds} "
@@ -68,7 +75,12 @@ def main() -> int:
     try:
         if sc.store != "mem":
             tmpdir = tempfile.mkdtemp(prefix="graft_chaos_")
-        verdict = asyncio.run(run_scenario(sc, args.seed, tmpdir=tmpdir))
+        if isinstance(sc, FrontdoorScenario):
+            verdict = asyncio.run(run_frontdoor(sc, args.seed,
+                                                tmpdir=tmpdir))
+        else:
+            verdict = asyncio.run(run_scenario(sc, args.seed,
+                                               tmpdir=tmpdir))
     finally:
         if tmpdir is not None:
             import shutil

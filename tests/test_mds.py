@@ -26,12 +26,17 @@ async def _fs_cluster():
     meta = await admin.pool_create("fsmeta", "replicated", pg_num=8, size=2)
     data = await admin.pool_create("fsdata", "replicated", pg_num=8, size=2)
     await cluster.start_mds(meta, data)
-    # wait for the MDS registration to reach the map
-    for _ in range(100):
+    # converge-poll to a wall deadline for the MDS registration
+    # (round-11/12 pattern: iteration-bounded polls under host load
+    # are fixed sleeps in disguise)
+    deadline = asyncio.get_event_loop().time() + 20
+    while asyncio.get_event_loop().time() < deadline:
         await admin.objecter._refresh_map()
         if getattr(admin.objecter.osdmap, "mds_addr", None):
             break
         await asyncio.sleep(0.05)
+    assert getattr(admin.objecter.osdmap, "mds_addr", None), \
+        "MDS never registered in the map"
     return cluster, admin, meta, data
 
 
@@ -130,12 +135,16 @@ def test_mds_restart_replays_journal():
             await mds.stop()
 
             await cluster.start_mds(meta, data)
-            for _ in range(100):
+            deadline = asyncio.get_event_loop().time() + 20
+            while asyncio.get_event_loop().time() < deadline:
                 await admin.objecter._refresh_map()
                 a = getattr(admin.objecter.osdmap, "mds_addr", None)
                 if a and tuple(a) == tuple(cluster.mds_addr):
                     break
                 await asyncio.sleep(0.05)
+            else:
+                raise TimeoutError(
+                    "restarted MDS never re-registered in the map")
             fs2 = MDSClient(admin, data)
             names = set(await fs2.listdir("/jd"))
             assert "orphan" in names, "journal replay missed the event"

@@ -78,12 +78,16 @@ def test_mirror_daemon_background_catchup():
                 p = f"gen{i}-".encode() * 100
                 await img.write(i * 10_000, p)
                 payloads.append((i * 10_000, p))
-                await asyncio.sleep(0.02)
-            # the daemon catches up on its own
-            for _ in range(100):
-                if mirror.replayed >= 5:
-                    break
+            # converge-poll to a wall deadline (round-11/12 pattern):
+            # no fixed pacing sleeps — the journal preserves event
+            # order however the poller's wakeups land, and an
+            # iteration-bounded loop under host load is just a fixed
+            # sleep in disguise
+            deadline = asyncio.get_event_loop().time() + 20
+            while asyncio.get_event_loop().time() < deadline and \
+                    mirror.replayed < 5:
                 await asyncio.sleep(0.05)
+            assert mirror.replayed >= 5, "mirror never caught up"
             await mirror.stop()
             rbd_b = RBD(client.ioctx(b))
             mirrored = await rbd_b.open("live")
