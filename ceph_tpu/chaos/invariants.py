@@ -261,6 +261,55 @@ async def check_frontier(cluster, marks: Optional[Dict] = None,
     return failures
 
 
+async def check_repair(cluster, timeout: float = 30.0) -> List[str]:
+    """A corruption scenario must actually exercise the self-healing
+    machinery (round 16): at least one crc/EIO/stale detection AND at
+    least one completed repair (verifying read or scrub) across the
+    cluster, and zero objects left flagged inconsistent on any
+    primary.  Converge-polls to a wall deadline: the detections the
+    durability check's own reads just triggered arm ASYNC repairs
+    that may still be landing when the judge reaches this invariant.
+    Final bit-correctness of the served bytes is the durability
+    invariant's job; this one proves detection and healing FIRED and
+    CONVERGED."""
+    def _once() -> List[str]:
+        detected = repaired = 0
+        out: List[str] = []
+        for osd in cluster.osds.values():
+            # NOT osd_scrub_errors: the scrub loop's generic exception
+            # handler shares that counter, so a scrub that merely
+            # CRASHED would masquerade as a detection.  Scrub-side
+            # detections count through their repairs (a detected-but-
+            # unrepaired object shows up as a leftover below instead).
+            for c in ("osd_read_shard_crc_errors",
+                      "osd_read_shard_errors",
+                      "osd_scrub_errors_repaired"):
+                detected += osd.perf.get(c)
+            for c in ("osd_read_repairs", "osd_scrub_errors_repaired"):
+                repaired += osd.perf.get(c)
+            for pgid, st in osd.pgs.items():
+                if st.primary == osd.osd_id and st.inconsistent:
+                    out.append(
+                        f"repair: osd.{osd.osd_id} pg {pgid} still "
+                        f"holds inconsistent "
+                        f"{sorted(st.inconsistent)[:4]}")
+        if not detected:
+            out.append("repair: corruption run produced zero "
+                       "crc/EIO/stale detections — nothing verified "
+                       "the injected rot")
+        if not repaired:
+            out.append("repair: zero completed repairs — detections "
+                       "never healed")
+        return out
+
+    deadline = asyncio.get_event_loop().time() + timeout
+    failures = _once()
+    while failures and asyncio.get_event_loop().time() < deadline:
+        await asyncio.sleep(0.25)
+        failures = _once()
+    return failures
+
+
 def check_batch(cluster) -> List[str]:
     """A batch-chaos scenario must actually exercise the batched data
     plane: coalesced encode ticks ran (the deterministic signal — any

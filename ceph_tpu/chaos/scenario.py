@@ -284,10 +284,16 @@ def _store_factory(scenario: Scenario, tmpdir: Optional[str]):
     from ceph_tpu.cluster.bluestore import BlueStore
     from ceph_tpu.cluster.filestore import FileStore
 
+    # honor a scenario-configured capacity on file stores too, so the
+    # round-16 enforcement doesn't silently diverge by store backend
+    cap = int(dict(scenario.config).get("memstore_device_bytes",
+                                        1 << 30))
+
     def factory(osd_id: int):
         path = os.path.join(tmpdir, f"osd{osd_id}")
         if scenario.store == "file":
-            return FileStore(path, checkpoint_every=64)
+            return FileStore(path, checkpoint_every=64,
+                             device_bytes=cap)
         return BlueStore(path, size=64 << 20, checkpoint_every=64)
 
     return factory
@@ -351,6 +357,8 @@ async def judge_invariants(cluster, dmn: DaemonInjector, io,
             failures += list(deadline_misses or ())
         elif name == "shed":
             failures += inv.check_shed(cluster)
+        elif name == "repair":
+            failures += await inv.check_repair(cluster, timeout=timeout)
         elif name == "frontier":
             failures += await inv.check_frontier(
                 cluster, marks=dmn.frontier_marks, timeout=timeout)

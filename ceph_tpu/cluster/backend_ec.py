@@ -43,6 +43,73 @@ class ECSizeMismatch(Exception):
         self.size = size
 
 
+def choose_decode_group(got: Dict[int, Tuple[bytes, int, int]],
+                        need_k: int, committed,
+                        committed_before=None) -> Tuple[
+                            Dict[int, bytes], int, int, Set[int]]:
+    """Choose the shard group that decodes consistently: newest version
+    first, but versions ABOVE the commit watermark are skipped when an
+    older viable group exists — an un-acked write may still be rolled
+    back by peering, and serving bytes that later vanish would break
+    read-your-ack (the reference compares object_info versions in
+    handle_sub_read_reply and serves committed state).
+
+    Pure function (round 16) so the mixed-generation corruption-matrix
+    tests drive it without a cluster: ``got`` maps shard -> (bytes,
+    version, size), ``committed(v)`` answers "is generation v at/below
+    the commit watermark (or a resolved frontier entry)".  Returns
+    ``(shards, size, version, stale_shards)`` — ``stale_shards`` are
+    members whose shard belongs to an OLDER generation than a COMMITTED
+    chosen one: they missed an acked write (crash/rewind/interrupted
+    recovery) and are read-repair candidates.  ``committed_before``
+    (default: ``committed``) is the STRICTER predicate staleness is
+    judged by — the caller passes its start-of-gather watermark
+    snapshot, so a generation that commits WHILE the gather is in
+    flight never flags members whose replies merely predate their own
+    apply (a healthy write/read race, not damage).  Raises IOError when an
+    acked newer generation lacks k same-version shards: serving an
+    older group would be a silent stale read (ADVICE r4), so the read
+    fails and recovery repairs the object instead."""
+    shards: Dict[int, bytes] = {}
+    size = 0
+    version = 0
+    stale: Set[int] = set()
+    versions = sorted({ver for _, ver, _ in got.values()}, reverse=True)
+    viable = []
+    for v in versions:
+        group = {s: d for s, (d, ver, _) in got.items() if ver == v}
+        if len(group) >= min(need_k, len(got)):
+            viable.append((v, group))
+    chosen = None
+    for v, group in viable:
+        if committed(v):
+            chosen = (v, group)
+            break
+    if chosen is None and viable:
+        chosen = viable[0]  # only un-acked state exists (new object)
+    acked_newest = max((v for v in versions if committed(v)),
+                       default=None)
+    if (acked_newest is not None and chosen is not None
+            and chosen[0] < acked_newest):
+        have = sum(1 for _, ver, _ in got.values()
+                   if ver == acked_newest)
+        raise IOError(
+            f"acked version {acked_newest} has only {have} "
+            f"of {need_k} shards; refusing stale read")
+    if chosen is not None:
+        version, shards = chosen[0], chosen[1]
+        size = max(sz for _, ver, sz in got.values() if ver == version)
+        if (committed_before or committed)(version):
+            # a shard BELOW a generation committed BEFORE the gather
+            # began can only exist if its member missed an acked write
+            # (EC commits require every shard); in-flight newer writes
+            # sit above it, and a generation that committed mid-gather
+            # is excluded by the stricter predicate
+            stale = {s for s, (_d, ver, _sz) in got.items()
+                     if ver < version}
+    return shards, size, version, stale
+
+
 class ECBackendMixin:
 
     def _codec(self, pool: PGPool):
@@ -432,7 +499,6 @@ class ECBackendMixin:
         ``batch_encode`` (its amortized share of the coalesced
         dispatch).  At 0 this is exactly the round-10 per-op dispatch.
         """
-        from ceph_tpu.ec import stripe as stripemod
         from ceph_tpu.cluster.optracker import CURRENT_OP, mark_current
 
         if self.config.osd_batch_tick_ops > 0:
@@ -450,8 +516,10 @@ class ECBackendMixin:
                 op.mark_at("batch_encoded", t1)
             return shards, crcs
         mark_current("ec_encode")
-        shards = await self._compute(
-            stripemod.encode_stripes, codec, sinfo, data)
+        # round 16: even the per-op anchor dispatches through the
+        # sanctioned coalescer module (batcher.encode_once) — zero
+        # device entry points on cluster/ op paths outside that seam
+        shards = await self._ec_batcher.encode_once(codec, sinfo, data)
         mark_current("ec_encoded")
         return shards, None
 
@@ -587,36 +655,51 @@ class ECBackendMixin:
                               msg: M.MOSDECSubOpRead) -> None:
         if self._sub_op_expired(msg):
             return  # nobody awaits: shed instead of burning device time
+        coll = _coll(msg.pgid)
         try:
-            full = self.store.read(_coll(msg.pgid), msg.oid)
-            stored_crc = self.store.getattr(_coll(msg.pgid), msg.oid,
-                                            "hinfo_crc")
-            # scrub-on-read: verify the shard crc (ecbackend.rst:86-99)
-            if stored_crc is not None and \
-                    int(stored_crc) != crcmod.crc32c(0xFFFFFFFF, full):
-                raise IOError("chunk crc mismatch")
-            data = full[msg.off: msg.off + msg.length] \
-                if msg.length is not None else full[msg.off:]
-            shard_attr = self.store.getattr(_coll(msg.pgid), msg.oid, "shard")
-            shard = int(shard_attr) if shard_attr else msg.shard
-            size = self.store.getattr(_coll(msg.pgid), msg.oid, "size")
-            hinfo = {"size": int(size) if size else 0,
-                     # version on EVERY reply: the gatherer groups shards
-                     # by generation before decoding (stale-member guard)
-                     "version": self.store.get_version(
-                         _coll(msg.pgid), msg.oid)}
-            if msg.shard == -1:
-                # whole-object fetch (pull recovery): carry xattrs so the
-                # puller stores a faithful copy
-                hinfo["xattrs"] = dict(self.store.get_xattrs(
-                    _coll(msg.pgid), msg.oid))
-            await self._reply_osd(conn, msg, M.MOSDECSubOpReadReply(
-                reqid=msg.reqid, result=0, shard=shard, data=data,
-                hinfo=hinfo))
-            self.perf.inc("osd_ec_sub_reads")
-        except (FileNotFoundError, IOError):
+            full = self.store.read(coll, msg.oid)
+        except FileNotFoundError:
             await self._reply_osd(conn, msg, M.MOSDECSubOpReadReply(
                 reqid=msg.reqid, result=-2, shard=msg.shard))
+            return
+        except IOError:
+            # media EIO: DISTINCT from absent (-2) — the gatherer
+            # queues this shard for in-place read-repair
+            self.perf.inc("osd_read_shard_errors")
+            await self._reply_osd(conn, msg, M.MOSDECSubOpReadReply(
+                reqid=msg.reqid, result=-5, shard=msg.shard))
+            return
+        stored_crc = self.store.getattr(coll, msg.oid, "hinfo_crc")
+        # verify-on-read (round 16, default on): the shard crc checks
+        # against the stored hinfo before any byte leaves this holder
+        # (ecbackend.rst:86-99); concurrent sub-reads on this daemon
+        # share one crc32c batch through the read coalescer
+        if stored_crc is not None and self.config.osd_ec_verify_reads:
+            [ok] = await self._read_batcher.verify([full],
+                                                   [int(stored_crc)])
+            if not ok:
+                self.perf.inc("osd_read_shard_crc_errors")
+                await self._reply_osd(conn, msg, M.MOSDECSubOpReadReply(
+                    reqid=msg.reqid, result=-5, shard=msg.shard))
+                return
+        data = full[msg.off: msg.off + msg.length] \
+            if msg.length is not None else full[msg.off:]
+        shard_attr = self.store.getattr(coll, msg.oid, "shard")
+        shard = int(shard_attr) if shard_attr else msg.shard
+        size = self.store.getattr(coll, msg.oid, "size")
+        hinfo = {"size": int(size) if size else 0,
+                 # version on EVERY reply: the gatherer groups shards
+                 # by generation before decoding (stale-member guard)
+                 "version": self.store.get_version(coll, msg.oid)}
+        if msg.shard == -1:
+            # whole-object fetch (pull recovery): carry xattrs so the
+            # puller stores a faithful copy
+            hinfo["xattrs"] = dict(self.store.get_xattrs(
+                coll, msg.oid))
+        await self._reply_osd(conn, msg, M.MOSDECSubOpReadReply(
+            reqid=msg.reqid, result=0, shard=shard, data=data,
+            hinfo=hinfo))
+        self.perf.inc("osd_ec_sub_reads")
 
     def _hedge_delay(self) -> float:
         """Straggler-hedge delay for degraded k-of-n reads: the p90 of
@@ -722,31 +805,66 @@ class ECBackendMixin:
         FROM the corruption and bless it).  ``fast_k``: degraded-mode
         client reads — contact only the first k shard holders, resolve
         on the first k clean same-generation shards, and hedge/promote
-        stragglers instead of gathering the full group."""
+        stragglers instead of gathering the full group.
+
+        Round 16 (verified reads): the LOCAL shard's crc checks against
+        its stored hinfo before it may feed a decode (riding the read
+        coalescer's per-tick crc batch; peers verify their own shards
+        in _handle_ec_read), and any shard that fails crc, returns EIO,
+        or proves generation-stale queues an ASYNCHRONOUS in-place
+        read-repair — never on the client's critical path."""
         exclude_shards = exclude_shards or set()
+        coll = _coll(st.pgid)
+        # shard id -> why it needs repair ("crc" | "eio" | "stale")
+        repair: Dict[int, str] = {}
         # (shard -> (bytes, version, size)): versions gate which shards
         # may decode together — a stale rejoined member's shard from an
         # older generation mixed with current shards would decode to
         # garbage (the reference compares per-shard object_info versions
         # when gathering, ECBackend::handle_sub_read_reply)
         got: Dict[int, Tuple[bytes, int, int]] = {}
-        my = self.store.stat(_coll(st.pgid), oid)
+        my = self.store.stat(coll, oid)
         if my is not None:
+            shard_attr = self.store.getattr(coll, oid, "shard")
+            local_shard = int(shard_attr) if shard_attr is not None \
+                else None
+            data = full = None
             try:
-                data = self.store.read(_coll(st.pgid), oid, off, length)
+                if self.config.osd_ec_verify_reads:
+                    # the cumulative crc covers the WHOLE shard: read
+                    # it all, verify, then slice the requested range
+                    full = self.store.read(coll, oid)
+                else:
+                    data = self.store.read(coll, oid, off, length)
             except IOError:
                 # local-shard media error (chaos disk EIO): our own
-                # shard is simply absent from the gather — decode from
-                # peers, mirroring the peer-side missing-shard path in
-                # _handle_ec_read instead of failing the whole read
-                data = None
-            shard_attr = self.store.getattr(_coll(st.pgid), oid, "shard")
-            if data is not None and shard_attr is not None and \
-                    int(shard_attr) not in exclude_shards:
-                sa = self.store.getattr(_coll(st.pgid), oid, "size")
-                got[int(shard_attr)] = (
+                # shard is absent from the gather — decode from peers,
+                # mirroring the peer-side path — and queues repair
+                # (counted like the peer-side detection, so EIOs that
+                # only ever hit primaries still move the counter)
+                self.perf.inc("osd_read_shard_errors")
+                if local_shard is not None:
+                    repair[local_shard] = "eio"
+            if full is not None:
+                stored = self.store.getattr(coll, oid, "hinfo_crc")
+                ok = True
+                if stored is not None:
+                    [ok] = await self._read_batcher.verify(
+                        [full], [int(stored)])
+                if ok:
+                    data = full[off:] if length is None \
+                        else full[off: off + length]
+                else:
+                    self.perf.inc("osd_read_shard_crc_errors")
+                    if local_shard is not None:
+                        repair[local_shard] = "crc"
+            if data is not None and local_shard is not None and \
+                    local_shard not in exclude_shards and \
+                    local_shard not in repair:
+                sa = self.store.getattr(coll, oid, "size")
+                got[local_shard] = (
                     data,
-                    self.store.get_version(_coll(st.pgid), oid),
+                    self.store.get_version(coll, oid),
                     int(sa) if sa else 0)
         committed_seq = st.last_complete[1]
 
@@ -823,48 +941,78 @@ class ECBackendMixin:
                         reply.data,
                         reply.hinfo.get("version", 0),
                         reply.hinfo.get("size", 0))
-        # choose the shard group that decodes consistently: newest
-        # version first, but versions ABOVE the commit watermark are
-        # skipped when an older viable group exists — an un-acked write
-        # may still be rolled back by peering, and serving bytes that
-        # later vanish would break read-your-ack semantics (the reference
-        # compares object_info versions in handle_sub_read_reply and
-        # serves committed state)
-        shards: Dict[int, bytes] = {}
-        size = 0
-        versions = sorted({ver for _, ver, _ in got.values()}, reverse=True)
-        viable = []
-        for v in versions:
-            group = {s: d for s, (d, ver, _) in got.items() if ver == v}
-            if len(group) >= min(need_k, len(got)):
-                viable.append((v, group))
-        chosen = None
-        for v, group in viable:
-            if _committed(v):
-                chosen = (v, group)
-                break
-        if chosen is None and viable:
-            chosen = viable[0]  # only un-acked state exists (new object)
-        # ADVICE r4: if an ACKED version exists but lacks k same-version
-        # shards, serving an older group would be a silent stale read —
-        # fail the read (EIO/unfound) so recovery repairs the object
-        # instead (reference serves committed object_info state or
-        # returns unfound, never silently older bytes)
-        acked_newest = max((v for v in versions if _committed(v)),
-                           default=None)
-        if (acked_newest is not None and chosen is not None
-                and chosen[0] < acked_newest):
-            have = sum(1 for _, ver, _ in got.values()
-                       if ver == acked_newest)
-            raise IOError(
-                f"{oid}: acked version {acked_newest} has only {have} "
-                f"of {need_k} shards; refusing stale read")
-        version = 0
-        if chosen is not None:
-            v, shards = chosen
-            version = v
-            size = max(sz for _, ver, sz in got.values() if ver == v)
+                elif result == -5 and reply is not None and \
+                        reply.shard >= 0:
+                    # the holder found its shard corrupt (crc) or
+                    # unreadable (EIO): absent from the decode, queued
+                    # for in-place repair
+                    repair.setdefault(reply.shard, "crc")
+        try:
+            # staleness judged against the START-of-gather watermark
+            # snapshot: a write committing mid-gather must not flag
+            # members whose replies simply predate their own apply
+            shards, size, version, stale = choose_decode_group(
+                got, need_k, _committed,
+                committed_before=lambda v: v <= committed_seq)
+        except IOError as e:
+            raise IOError(f"{oid}: {e}") from None
+        for s in stale:
+            repair.setdefault(s, "stale")
+        if repair:
+            self._queue_read_repair(pool, st, oid, repair)
         return shards, size, version
+
+    def _queue_read_repair(self, pool: PGPool, st: PGState, oid: str,
+                           bad: Dict[int, str]) -> None:
+        """Arm ONE asynchronous in-place repair for shards a gather
+        found bad (crc mismatch, media EIO, generation-stale): the
+        object is reconstructed from the surviving shards — the bad
+        ones excluded as decode sources — and rewritten on the affected
+        members, OFF the client's critical path (the read that detected
+        the corruption already decoded from survivors and returned).
+        The PG rides the inconsistent -> clean health flow: the object
+        joins ``st.inconsistent`` (beacon-fed PG_INCONSISTENT /
+        OSD_SCRUB_ERRORS warnings) until the repair lands."""
+        if not self.config.osd_read_repair or self._stopped or \
+                st.primary != self.osd_id:
+            return
+        key = (st.pgid, oid)
+        if key in self._read_repairs_inflight:
+            return
+        self._read_repairs_inflight.add(key)
+        st.inconsistent.add(oid)
+        targets = sorted({st.acting[s] for s in bad
+                          if s < len(st.acting)
+                          and st.acting[s] != CRUSH_ITEM_NONE})
+        reasons = dict(bad)
+
+        async def _repair() -> None:
+            try:
+                # the object write lock excludes concurrent writes to
+                # THIS object while the rebuild is being stamped (the
+                # scrub path holds st.lock for the same reason); other
+                # objects of the PG proceed
+                async with self._obj_write_lock(st, oid):
+                    ok = await self._recover_ec_object(
+                        pool, st, oid, targets=targets,
+                        exclude_sources=set(reasons))
+                if ok:
+                    self.perf.inc("osd_read_repairs")
+                    st.inconsistent.discard(oid)
+                    self.clog(
+                        "WRN",
+                        f"pg {st.pgid} read-repair: {oid} shards "
+                        f"{reasons} rebuilt on osds {targets}")
+                # not ok: the object stays inconsistent — the scheduled
+                # scrub (or the next detecting read) retries the repair
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self.perf.inc("osd_read_repair_errors")
+            finally:
+                self._read_repairs_inflight.discard(key)
+
+        self._track(asyncio.get_event_loop().create_task(_repair()))
 
     async def _ec_read_stripes(self, pool: PGPool, st: PGState, oid: str,
                                chunk_off: int, logical_len: int,
@@ -875,7 +1023,6 @@ class ECBackendMixin:
         attr), pass ``expected_size``: a disagreeing decode group raises
         ECSizeMismatch BEFORE the under/over-fetch can fail or truncate,
         so the caller re-ranges against the group's size."""
-        from ceph_tpu.ec import stripe as stripemod
         import numpy as np
 
         codec = self._codec(pool)
@@ -896,8 +1043,10 @@ class ECBackendMixin:
         if len(avail) < k:
             raise IOError(
                 f"only {len(avail)} of {k} shard ranges for {oid}")
-        return await self._compute(
-            stripemod.decode_stripes, codec, sinfo, avail, logical_len)
+        # round 16: the decode rides the read coalescer — a tick's read
+        # gathers share one layout conversion + one fused decode batch
+        return await self._read_batcher.decode(
+            codec, sinfo, avail, logical_len)
 
     async def _ec_read(self, pool: PGPool, st: PGState, oid: str,
                        offset: int = 0, length: Optional[int] = None) -> bytes:
@@ -950,7 +1099,6 @@ class ECBackendMixin:
         every acting member's shard; exclude_sources keeps known-corrupt
         shard ids out of the decode.  Returns False when the object is
         currently unrecoverable (fewer than k shard sources)."""
-        from ceph_tpu.ec import stripe as stripemod
         import numpy as np
 
         codec = self._codec(pool)
@@ -964,13 +1112,13 @@ class ECBackendMixin:
         if len(avail) < k:
             self.perf.inc("osd_unrecoverable")
             return False
-        # decode + re-encode in ONE planar round trip: the stripe batch
-        # is converted to the bit-planar device layout once, missing data
-        # chunks are reconstructed and parity re-derived as planar
-        # matmuls, and the shards convert back once for the store/wire
-        # boundary (round-6 layout contract, ec/planar.py)
-        chunks = await self._compute(
-            stripemod.reencode_stripes, codec, sinfo, avail, size)
+        # decode + re-encode in ONE round trip through the read
+        # coalescer (round 16): concurrent recovery rebuilds of a tick
+        # share a layout conversion + fused decode/encode batch; on CPU
+        # jax backends the rebuild runs the table-driven host GF engine
+        # like the coalesced write path (engine-per-backend)
+        chunks = await self._read_batcher.reencode(
+            codec, sinfo, avail, size)
         # stamp the rebuilt shards with the DECODE GROUP's version, not
         # our local one: a primary whose own shard is newer (or staler)
         # than the group it decoded from would otherwise relabel old

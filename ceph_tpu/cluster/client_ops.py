@@ -412,11 +412,20 @@ class ClientOpsMixin:
             await self._dispatch_client_op(conn, msg, m, pool, st)
         except Exception as e:
             # mirror ms_dispatch's error contract: the client gets a
-            # prompt EIO instead of a timeout
-            self.perf.inc("osd_dispatch_errors")
+            # prompt error instead of a timeout.  A store-level ENOSPC
+            # (the capacity backstop beneath the mon's full flag, which
+            # can lag a beacon interval behind a fast filler) surfaces
+            # as the REAL -28, so the client sees "cluster full" either
+            # way, never a generic EIO.
+            if isinstance(e, OSError) and getattr(e, "errno", 0) == 28:
+                self.perf.inc("osd_full_rejects")
+                result = -28
+            else:
+                self.perf.inc("osd_dispatch_errors")
+                result = -5
             try:
                 await conn.send(M.MOSDOpReply(
-                    reqid=msg.reqid, result=-5, data=repr(e)))
+                    reqid=msg.reqid, result=result, data=repr(e)))
             except (ConnectionError, OSError, RuntimeError):
                 pass
 
@@ -436,6 +445,24 @@ class ClientOpsMixin:
     # a resend must return the cached original reply (reference pg_log
     # dup detection, PGLog dups / osd_pg_log_dups_tracked)
     _MUTATING_OPS = M.MUTATING_OPS
+    # mutations still admitted while the cluster carries the FULL flag:
+    # they can only free space, and refusing them would wedge a full
+    # cluster forever (the reference admits deletes under
+    # CEPH_OSDMAP_FULL for exactly this reason)
+    _FULL_ADMITTED_OPS = frozenset({"delete", "rmxattr", "omap_rmkeys"})
+
+    def _full_rejects(self, msg: M.MOSDOp) -> bool:
+        """Should this op vector be refused ENOSPC under the map's full
+        flag?  Only vectors that could GROW data; reads and the
+        space-freeing verbs always pass (round 16 cluster-full
+        protection — the flag is the mon's commitment, enforced here at
+        every primary from its own map copy)."""
+        m = self.osdmap
+        if m is None or "full" not in getattr(m, "flags", set()):
+            return False
+        return any(o[0] in self._MUTATING_OPS
+                   and o[0] not in self._FULL_ADMITTED_OPS
+                   for o in msg.ops)
     _REQID_DUPS_TRACKED = 3000
     # ops that gate the rest of their vector (CEPH_OSD_OP_CMPXATTR etc.)
     _GUARD_OPS = frozenset({"cmpxattr"})
@@ -564,6 +591,18 @@ class ClientOpsMixin:
             top.mark("dup_refused_from_log")
             await conn.send(M.MOSDOpReply(
                 reqid=msg.reqid, result=0, epoch=m.epoch))
+            return
+        # cluster-full reject AFTER the dup resolution above: a resend
+        # of an already-committed mutation must get its original ack
+        # even while the map carries the full flag — ENOSPC-ing a
+        # durably-applied write would be exactly the acked-then-lost
+        # confusion the full protection exists to prevent.  A genuinely
+        # NEW growing write still rejects promptly (never a timeout).
+        if self._full_rejects(msg):
+            self.perf.inc("osd_full_rejects")
+            top.mark("full_reject")
+            await conn.send(M.MOSDOpReply(
+                reqid=msg.reqid, result=-28, epoch=m.epoch))
             return
         fut: asyncio.Future = asyncio.get_event_loop().create_future()
         st.reqid_inflight[reqid] = fut

@@ -79,8 +79,8 @@ def _damage_journal(path: str, torn_tail: bool, lose_frames: int) -> None:
 
 class FileStore(MemStore):
     def __init__(self, path: str, checkpoint_every: int = 2048,
-                 fsync: bool = False):
-        super().__init__()
+                 fsync: bool = False, device_bytes: int = 1 << 30):
+        super().__init__(device_bytes)
         self.path = path
         self.checkpoint_every = checkpoint_every
         self.fsync = fsync
@@ -104,6 +104,10 @@ class FileStore(MemStore):
         if os.path.exists(self._ckpt_path):
             with open(self._ckpt_path, "rb") as f:
                 self._colls = pickle.load(f)
+            # the checkpoint restores the object map wholesale: rebuild
+            # the incremental used-bytes counter before journal replay
+            # (replayed ops then adjust it like live transactions)
+            self._recount_used()
         if os.path.exists(self._journal_path):
             with open(self._journal_path, "rb") as f:
                 while True:
@@ -143,6 +147,7 @@ class FileStore(MemStore):
         self._journal = None
         self._mounted = False
         self._colls = {}
+        self._used = 0
         self._since_checkpoint = 0
         _damage_journal(self._journal_path, torn_tail, lose_frames)
 
@@ -170,6 +175,9 @@ class FileStore(MemStore):
             # refuse BEFORE the journal write: an injected ENOSPC must
             # not leave a journaled-but-unapplied frame
             self.chaos.on_write(txn)
+        # the round-16 capacity backstop, likewise pre-journal: a
+        # refused txn must never persist a frame replay would re-apply
+        self._check_capacity(txn)
         blob = txn.encode()
         with self._lock:
             self._journal.write(_FRAME.pack(len(blob)) + blob)

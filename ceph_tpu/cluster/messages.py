@@ -43,6 +43,11 @@ class MOSDAlive(Message):
     # window_max_s) since the previous beacon; None when the sampler is
     # off.  Drives the mon's LOOP_LAG health check beside SLOW_OPS.
     loop_lag: Optional[Tuple[float, float]] = None
+    # integrity feed (round 16): (unrepaired inconsistent objects, PGs
+    # holding any) on this OSD's primary PGs — drives the mon's
+    # PG_INCONSISTENT / OSD_SCRUB_ERRORS health checks, raised while
+    # nonzero and cleared by the next clean beacon like SLOW_OPS.
+    scrub_stats: Optional[Tuple[int, int]] = None
 
 
 # throttle-full admission pushback result (EBUSY): distinct from the

@@ -94,6 +94,11 @@ class Monitor(Dispatcher):
         # per-osd event-loop lag from beacons (graft-trace loop
         # profiler): feeds the LOOP_LAG health warning the same way
         self.osd_loop_lag: Dict[int, Tuple[float, float]] = {}
+        # per-osd (unrepaired inconsistent objects, pgs) from beacons
+        # (round 16): feeds PG_INCONSISTENT / OSD_SCRUB_ERRORS, raised
+        # while any primary holds unrepaired damage, cleared by the
+        # next clean beacon — the SLOW_OPS raise/clear shape
+        self.osd_scrub_stats: Dict[int, Tuple[int, int]] = {}
         self.perf = PerfCounters("mon")
         # chaos-skewable per-daemon time source: lease staleness, beacon
         # grace, and the down-out tick all judge from THIS clock, so a
@@ -206,10 +211,36 @@ class Monitor(Dispatcher):
             checks["OSD_DOWN"] = f"{len(down)} osds down: {down}"
         if out:
             checks["OSD_OUT"] = f"{len(out)} osds out: {out}"
-        full = [o for o, (tot, used) in self.osd_statfs.items()
-                if tot and used / tot > 0.95]
+        # utilization tiers against the configured mon_osd_*full_ratio
+        # thresholds (round 16): nearfull warns, backfillfull blocks
+        # backfill, full rejects client writes (HEALTH_ERR).  ONE
+        # classifier serves this and the flag-commit tick, so health
+        # reporting can never desynchronize from flag enforcement.
+        tiers = self._full_tiers()
+        nearfull = tiers["nearfull"]
+        backfillfull = tiers["backfillfull"]
+        full = tiers["full"]
         if full:
-            checks["OSD_FULL"] = f"osds near full: {full}"
+            checks["OSD_FULL"] = (
+                f"{len(full)} osd(s) full: {full} — client writes "
+                f"rejected ENOSPC until space frees")
+        if backfillfull:
+            checks["OSD_BACKFILLFULL"] = (
+                f"{len(backfillfull)} osd(s) backfillfull: "
+                f"{backfillfull}")
+        if nearfull:
+            checks["OSD_NEARFULL"] = \
+                f"{len(nearfull)} osd(s) nearfull: {nearfull}"
+        inconsistent = {o: s for o, s in self.osd_scrub_stats.items()
+                        if o < m.max_osd and m.osd_up[o]}
+        if inconsistent:
+            objs = sum(n for n, _ in inconsistent.values())
+            pgs = sum(p for _, p in inconsistent.values())
+            checks["PG_INCONSISTENT"] = (
+                f"{pgs} pg(s) inconsistent, {objs} unrepaired "
+                f"object(s) (osds: {sorted(inconsistent)})")
+            checks["OSD_SCRUB_ERRORS"] = \
+                f"{objs} unrepaired scrub/read errors"
         slow = {o: s for o, s in self.osd_slow_ops.items()
                 if o < m.max_osd and m.osd_up[o]}
         if slow:
@@ -230,6 +261,27 @@ class Monitor(Dispatcher):
             "HEALTH_ERR" if full or len(down) >= m.max_osd
             else "HEALTH_WARN")
         return {"status": status, "checks": checks}
+
+    def _full_tiers(self) -> Dict[str, List[int]]:
+        """Classify every up OSD's beacon utilization into EXCLUSIVE
+        tiers against the mon_osd_*full_ratio thresholds — the single
+        source both the health checks and the flag-commit tick read
+        (round 16), so the warning an operator sees and the flag the
+        OSDs enforce can never drift apart."""
+        m = self.osdmap
+        out: Dict[str, List[int]] = {"nearfull": [], "backfillfull": [],
+                                     "full": []}
+        for o, (tot, used) in sorted(self.osd_statfs.items()):
+            if not tot or o >= m.max_osd or not m.osd_up[o]:
+                continue
+            frac = used / tot
+            if frac >= self.config.mon_osd_full_ratio > 0:
+                out["full"].append(o)
+            elif frac >= self.config.mon_osd_backfillfull_ratio > 0:
+                out["backfillfull"].append(o)
+            elif frac >= self.config.mon_osd_nearfull_ratio > 0:
+                out["nearfull"].append(o)
+        return out
 
     def _build_admin_socket(self):
         """The mon's 'ceph daemon mon.X' command table (reference
@@ -596,6 +648,13 @@ class Monitor(Dispatcher):
                         # drained: the health warning clears with the
                         # next 'health' evaluation
                         self.osd_slow_ops.pop(msg.osd_id, None)
+                ss = getattr(msg, "scrub_stats", None)
+                if ss is not None and ss[0]:
+                    self.osd_scrub_stats[msg.osd_id] = tuple(ss)
+                else:
+                    # repaired (or a restarted daemon with nothing
+                    # flagged): PG_INCONSISTENT clears like SLOW_OPS
+                    self.osd_scrub_stats.pop(msg.osd_id, None)
                 lag = getattr(msg, "loop_lag", None)
                 warn_at = self.config.loop_lag_warn
                 if lag is not None and warn_at > 0 and lag[1] >= warn_at:
@@ -1312,12 +1371,39 @@ class Monitor(Dispatcher):
                 for osd in inc.new_weights:
                     self.clog("WRN", f"osd.{osd} marked out "
                                      "(down past the out interval)")
+                # full-ratio protection (round 16): judge per-OSD
+                # utilization from beacon statfs against the configured
+                # ratios and commit flag transitions into the map —
+                # OSDs enforce from their own copy (ENOSPC on client
+                # writes under "full", backfill deferred under
+                # "backfillfull"); flags CLEAR here too as deletes
+                # drain space and beacons report it
+                tiers = self._full_tiers()   # shared with health
+                want = set()
+                if tiers["full"]:
+                    want |= {"full", "backfillfull", "nearfull"}
+                if tiers["backfillfull"]:
+                    want |= {"backfillfull", "nearfull"}
+                if tiers["nearfull"]:
+                    want.add("nearfull")
+                for flag in ("nearfull", "backfillfull", "full"):
+                    have = flag in self.osdmap.flags
+                    if (flag in want) == have:
+                        continue
+                    inc.new_flags[flag] = flag in want
+                    if flag in want:
+                        self.clog("ERR" if flag == "full" else "WRN",
+                                  f"cluster is {flag} "
+                                  f"(mon_osd_{flag}_ratio)")
+                    else:
+                        self.clog("INF", f"{flag} flag cleared")
                 # flush buffered cluster-log events through Paxos so the
                 # whole quorum (and the persisted store) agree on the log
                 if self._pending_clog:
                     inc.new_log_entries = tuple(self._pending_clog)
                     self._pending_clog = []
-                if inc.new_weights or inc.new_down or inc.new_log_entries:
+                if inc.new_weights or inc.new_down or \
+                        inc.new_log_entries or inc.new_flags:
                     if not await self._commit_inc(inc):
                         # quorum lost mid-tick (leader killed under
                         # churn): the detection state must survive the

@@ -630,13 +630,25 @@ class IoCtx:
 
     # -- data ops ----------------------------------------------------------
 
+    @staticmethod
+    def _raise_write_error(verb: str, oid: str, reply) -> None:
+        """Map a mutation's failed result to the exception the caller
+        can act on: -28 becomes a REAL OSError(ENOSPC) — the cluster is
+        full (round 16), not broken, and the remedy is deleting data,
+        not retrying or refreshing maps."""
+        if reply.result == -28:
+            raise OSError(
+                28, f"{verb}({oid}): cluster full (ENOSPC); deletes "
+                    f"still admitted")
+        raise IOError(f"{verb}({oid}) -> {reply.result}: {reply.data}")
+
     async def write_full(self, oid: str, data: bytes,
                          timeout: float = None) -> None:
         reply = await self.objecter.op_submit(
             self.pool_id, oid, [("write_full", {"data": data})],
             timeout=timeout, snapc=self._write_snapc())
         if reply.result != 0:
-            raise IOError(f"write_full({oid}) -> {reply.result}: {reply.data}")
+            self._raise_write_error("write_full", oid, reply)
 
     async def write(self, oid: str, data: bytes, offset: int = 0,
                     timeout: float = None) -> None:
@@ -646,7 +658,7 @@ class IoCtx:
             self.pool_id, oid, [("write", {"offset": offset, "data": data})],
             timeout=timeout, snapc=self._write_snapc())
         if reply.result != 0:
-            raise IOError(f"write({oid}) -> {reply.result}: {reply.data}")
+            self._raise_write_error("write", oid, reply)
 
     async def read(self, oid: str, offset: int = 0,
                    length: int = None, timeout: float = None,
@@ -686,7 +698,7 @@ class IoCtx:
             self.pool_id, oid, [("append", {"data": bytes(data)})],
             timeout=timeout, snapc=self._write_snapc())
         if reply.result != 0:
-            raise IOError(f"append({oid}) -> {reply.result}")
+            self._raise_write_error("append", oid, reply)
         return reply.data
 
     async def truncate(self, oid: str, size: int) -> None:

@@ -64,7 +64,10 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         # per-daemon config copy: injectargs on one daemon must never
         # leak into another (each reference daemon owns its md_config_t)
         self.config = Config(**config.show()) if config else Config()
-        self.store = store or MemStore()
+        # the default store advertises (and round 16: ENFORCES) the
+        # configured capacity — the memstore_device_bytes analog the
+        # cluster-full protection and the disk-fill scenarios size
+        self.store = store or MemStore(self.config.memstore_device_bytes)
         self.messenger = Messenger(
             EntityName("osd", osd_id),
             secret=self.config.auth_secret(),
@@ -227,10 +230,17 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         # batcher (cluster/batcher.py): EC writes ride both when
         # osd_batch_tick_ops > 0
         from ceph_tpu.cluster.batcher import (EncodeBatcher,
+                                              ReadBatcher,
                                               SubWriteBatcher)
 
         self._ec_batcher = EncodeBatcher(self)
         self._sub_batcher = SubWriteBatcher(self)
+        # read-side coalescer (round 16): per-tick decode / recovery
+        # reencode / shard-crc verification batches — the decode twin
+        self._read_batcher = ReadBatcher(self)
+        # (pgid, oid) pairs with an in-flight async read-repair, so a
+        # storm of reads against one corrupt object arms ONE rebuild
+        self._read_repairs_inflight: Set[Tuple] = set()
         # boot instance nonce: lets the mon fence a fast rebounce even if
         # the new daemon lands on the identical address
         import itertools as _it
@@ -554,10 +564,20 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         try:
             return await self._dispatch(conn, msg)
         except Exception as e:
-            self.perf.inc("osd_dispatch_errors")
+            # store-capacity ENOSPC on a CLIENT op surfaces as the
+            # real -28 (the backstop beneath the mon's full flag), not
+            # a bare EIO.  On sub-op paths (replica/shard applies) the
+            # exception propagates like any replica failure — no reply,
+            # the primary stays un-acked and peering owns the divergent
+            # entry — so only the delivered client reject counts as one
+            enospc = isinstance(msg, M.MOSDOp) and \
+                isinstance(e, OSError) and getattr(e, "errno", 0) == 28
+            self.perf.inc("osd_full_rejects" if enospc
+                          else "osd_dispatch_errors")
             if isinstance(msg, M.MOSDOp):
                 await conn.send(M.MOSDOpReply(
-                    reqid=msg.reqid, result=-5, data=repr(e)))
+                    reqid=msg.reqid, result=-28 if enospc else -5,
+                    data=repr(e)))
                 return True
             raise
 
@@ -673,6 +693,18 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                 await conn.send(M.MPing(stamp=msg.stamp, reply=True))
             return True
         return False
+
+    def _scrub_stats(self) -> Tuple[int, int]:
+        """(unrepaired inconsistent objects, PGs holding any) across
+        this OSD's primary PGs — the beacon feed for the mon's
+        PG_INCONSISTENT / OSD_SCRUB_ERRORS health checks (raised while
+        nonzero, cleared by the next clean beacon, like SLOW_OPS)."""
+        objs = pgs = 0
+        for st in self.pgs.values():
+            if st.primary == self.osd_id and st.inconsistent:
+                pgs += 1
+                objs += len(st.inconsistent)
+        return (objs, pgs)
 
     def _sub_op_expired(self, msg) -> bool:
         """Dead-work shedding on the replica/shard side: a sub-op whose
@@ -851,6 +883,50 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                           desc="peering rounds that waited on the "
                                "per-OSD concurrency throttle "
                                "(osd_peering_max_concurrent)")
+        # verified reads + self-healing + cluster-full (round 16): all
+        # on the perf/Prometheus path so the graft-load SLO judge can
+        # gate on their presence from the mgr scrape
+        self.perf.add_u64("osd_read_batch_ticks",
+                          prio=perfmod.PRIO_INTERESTING,
+                          desc="coalesced read-side ticks dispatched "
+                               "(decode / recovery reencode / crc "
+                               "verification batches)")
+        self.perf.add_u64("osd_read_batch_coalesced",
+                          desc="requests that rode a coalesced "
+                               "read-side tick")
+        self.perf.add_u64("osd_read_shard_crc_errors",
+                          prio=perfmod.PRIO_INTERESTING,
+                          desc="shard crc mismatches caught by "
+                               "verify-on-read before the bytes could "
+                               "feed a decode")
+        self.perf.add_u64("osd_read_shard_errors",
+                          desc="shard media errors (EIO) surfaced to a "
+                               "read gather")
+        self.perf.add_u64("osd_read_repairs",
+                          prio=perfmod.PRIO_INTERESTING,
+                          desc="objects rebuilt in place by automatic "
+                               "read-repair (crc/EIO/stale shard "
+                               "detected during a gather)")
+        self.perf.add_u64("osd_read_repair_errors",
+                          desc="read-repair attempts that failed "
+                               "(object stays inconsistent; scrub "
+                               "retries)")
+        self.perf.add_u64("osd_scrub_errors_repaired",
+                          prio=perfmod.PRIO_INTERESTING,
+                          desc="scrub-detected inconsistencies "
+                               "repaired (crc rot + stale "
+                               "generations)")
+        self.perf.add_u64("osd_scrubs_scheduled",
+                          desc="background scrubs started by the "
+                               "seeded per-PG jittered scheduler")
+        self.perf.add_u64("osd_full_rejects",
+                          prio=perfmod.PRIO_INTERESTING,
+                          desc="client writes rejected ENOSPC while "
+                               "the OSDMap carried the full flag "
+                               "(deletes stay admitted)")
+        self.perf.add_u64("osd_backfill_blocked_full",
+                          desc="backfill data movement deferred while "
+                               "the map carried the backfillfull flag")
         self.perf.add_histogram(
             "osd_peering_lat_hist", scale=1e6, unit=perfmod.UNIT_SECONDS,
             prio=perfmod.PRIO_INTERESTING,
@@ -926,6 +1002,41 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
             return reports
 
         asok.register("scrub", _scrub, "scrub every primary PG")
+
+        def _list_inconsistent(cmd):
+            # reference 'rados list-inconsistent-obj' analog: objects a
+            # scrub or verifying read flagged and repair has not healed
+            a = {**cmd, **cmd.get("args", {})}
+            want = a.get("pgid")
+            out = {}
+            for pgid, st in list(self.pgs.items()):
+                if st.primary != self.osd_id:
+                    continue
+                if want is not None and str(pgid) != str(want):
+                    continue
+                if st.inconsistent or want is not None:
+                    out[str(pgid)] = sorted(st.inconsistent)
+            return out
+
+        asok.register("list-inconsistent", _list_inconsistent,
+                      "unrepaired inconsistent objects per primary PG "
+                      "(args: pgid)")
+
+        async def _repair(cmd):
+            # 'ceph pg repair' analog: a scrub pass repairs as it goes
+            a = {**cmd, **cmd.get("args", {})}
+            want = a.get("pgid")
+            reports = {}
+            for pgid, st in list(self.pgs.items()):
+                if st.primary != self.osd_id:
+                    continue
+                if want is not None and str(pgid) != str(want):
+                    continue
+                reports[str(pgid)] = await self.scrub_pg(st)
+            return reports
+
+        asok.register("repair", _repair,
+                      "scrub-and-repair primary PGs (args: pgid)")
         return asok
 
     async def _handle_admin_command(self, conn: Connection,
@@ -1294,7 +1405,8 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                 await self._mon_send(M.MOSDAlive(
                     osd_id=self.osd_id, statfs=self.store.statfs(),
                     slow_ops=(slow_n, slow_oldest),
-                    loop_lag=self.loopmon.lag_report()))
+                    loop_lag=self.loopmon.lag_report(),
+                    scrub_stats=self._scrub_stats()))
                 # the beacon delivered this window's max: start the next
                 # window, so a drained stall clears LOOP_LAG like a
                 # drained op queue clears SLOW_OPS

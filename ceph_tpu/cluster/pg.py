@@ -103,6 +103,12 @@ class PGState:
     # demand and dropped when uncontended (see OSD._obj_write_lock).
     obj_locks: Dict[str, object] = field(default_factory=dict)
     obj_lock_refs: Dict[str, int] = field(default_factory=dict)
+    # objects currently known inconsistent (round 16: a scrub or a
+    # verifying read found a shard bad and the repair has not landed
+    # yet).  Feeds the beacon's scrub_stats and so the mon's
+    # PG_INCONSISTENT / OSD_SCRUB_ERRORS health flow: raise while
+    # non-empty, clear when the repairs land.
+    inconsistent: set = field(default_factory=set)
 
     def frontier_acked(self, seq: int) -> bool:
         """Is seq a RESOLVED (fully acked) frontier entry that the

@@ -206,6 +206,16 @@ class RecoveryMixin:
             complete &= await self._sync_self_from(
                 pool, st, auth, logs[auth], inventories.get(auth, {}))
 
+        # backfillfull gate (round 16): with the map flag set, FULL-
+        # INVENTORY backfill is deferred — bulk-copying a whole PG into
+        # stores past the backfillfull ratio would drive them straight
+        # to FULL.  The round stays incomplete, so the capped-backoff
+        # retry re-runs it after the flag clears.  Log-DELTA recovery
+        # still proceeds (reference semantics: backfillfull gates
+        # backfill, not recovery — the delta pushes mostly overwrite
+        # existing shards, and blocking them would pin reduced
+        # redundancy on every bounce while merely nearfull-ish).
+        backfill_gated = "backfillfull" in getattr(m, "flags", set())
         for osd in members:
             if osd not in infos:
                 continue
@@ -229,6 +239,10 @@ class RecoveryMixin:
                 continue
             to_sync = st.log.objects_to_sync(peer_lu)
             if to_sync is None:
+                if backfill_gated:
+                    self.perf.inc("osd_backfill_blocked_full")
+                    complete = False
+                    continue
                 complete &= await self._backfill_member(
                     pool, st, osd, inventories.get(osd, {}))
             else:

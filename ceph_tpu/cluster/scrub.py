@@ -59,6 +59,21 @@ class ScrubMixin:
         {"inconsistent": [...], "repaired": [...]}."""
         async with st.lock:
             report = await self._scrub_pg_locked(st)
+        # inconsistent -> clean health flow (round 16): a scrub pass
+        # scans EVERY object of the PG, so its verdict REPLACES the
+        # set — unrepaired findings stay flagged (beacon-fed
+        # PG_INCONSISTENT / OSD_SCRUB_ERRORS raise), repaired ones and
+        # stale entries (healed by recovery/read-repair out-of-band,
+        # or deleted since) clear, so a single transient repair
+        # failure can never pin the health warning forever.  (If a
+        # read detection races this pass and its repair then fails,
+        # the next detecting read or scrub pass re-flags the oid.)
+        repaired = set(report["repaired"])
+        bad = set(report["inconsistent"]) - repaired
+        st.inconsistent.intersection_update(bad)
+        st.inconsistent.update(bad)
+        if repaired:
+            self.perf.inc("osd_scrub_errors_repaired", len(repaired))
         if report["inconsistent"]:
             # cluster-log the scrub result (reference clog error stream)
             self.clog(
@@ -188,19 +203,62 @@ class ScrubMixin:
         return {"inconsistent": inconsistent, "repaired": repaired}
 
     async def _scrub_loop(self) -> None:
-        """Periodic background scrub of primary PGs (reference scrub
-        scheduling; interval 0 disables)."""
-        interval = self.config.osd_scrub_interval
-        if not interval:
-            return
+        """Scheduled deep scrub (round 16, reference OSD::sched_scrub):
+        each primary PG carries its own next-due deadline, seeded-
+        jittered inside ``osd_scrub_jitter * interval`` so a daemon's
+        PGs (and a cluster's daemons, via per-daemon streams) never
+        scrub in lockstep — the reference spreads deep scrubs across
+        the interval for the same reason.  Due PGs scrub one at a time,
+        yielding to client admission pressure (the round-10 QoS seam);
+        the interval is re-read every pass so injectargs can enable or
+        retune a running daemon.  Interval 0 parks the loop."""
+        from ceph_tpu.chaos.rng import stream as _stream
+
+        rng = _stream(self.config.chaos_seed,
+                      f"scrub:osd.{self.osd_id}") \
+            if self.config.chaos_seed else None
+        if rng is None:
+            import random as _random
+
+            rng = _random.Random(self.osd_id * 2654435761 + 1)
+        next_due: Dict = {}
         while not self._stopped:
-            await asyncio.sleep(interval)
-            for st in list(self.pgs.values()):
-                if st.primary == self.osd_id and not self._stopped:
-                    try:
-                        # background scrub yields to client admission
-                        # pressure, like recovery (QoS class demotion)
-                        await self._yield_under_pressure()
-                        await self.scrub_pg(st)
-                    except Exception:
-                        self.perf.inc("osd_scrub_errors")
+            interval = self.config.osd_scrub_interval
+            if not interval:
+                next_due.clear()
+                await asyncio.sleep(0.5)
+                continue
+            await asyncio.sleep(min(max(interval / 4.0, 0.05), 1.0))
+            now = self.clock.monotonic()
+            jitter = self.config.osd_scrub_jitter
+            for pgid, st in list(self.pgs.items()):
+                if self._stopped:
+                    return
+                if st.primary != self.osd_id:
+                    next_due.pop(pgid, None)
+                    continue
+                due = next_due.get(pgid)
+                if due is None:
+                    # first sight: spread the initial scrub across the
+                    # jitter band instead of stampeding at one beat
+                    next_due[pgid] = now + interval * (
+                        1.0 + jitter * (rng.random() - 1.0))
+                    continue
+                if now < due:
+                    continue
+                # re-arm BEFORE scrubbing (a slow scrub must not
+                # compress the next period), wobbling +/- jitter/2
+                next_due[pgid] = now + interval * (
+                    1.0 + jitter * (rng.random() - 0.5))
+                try:
+                    # background scrub yields to client admission
+                    # pressure, like recovery (QoS class demotion)
+                    await self._yield_under_pressure()
+                    self.perf.inc("osd_scrubs_scheduled")
+                    await self.scrub_pg(st)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    self.perf.inc("osd_scrub_errors")
+            for pgid in [p for p in next_due if p not in self.pgs]:
+                del next_due[pgid]

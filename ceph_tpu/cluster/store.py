@@ -135,16 +135,80 @@ class MemStore(ObjectStore):
     def __init__(self, device_bytes: int = 1 << 30):
         self._colls: Dict[str, Dict[str, Obj]] = {}
         self._lock = threading.RLock()
-        # advertised capacity (memstore_device_bytes analog) for statfs
+        # advertised AND enforced capacity (memstore_device_bytes
+        # analog): statfs reports against it, and (round 16) a
+        # transaction whose net data growth would exceed it is refused
+        # whole with ENOSPC — the store-level backstop beneath the
+        # mon's full-flag protection.  Used bytes are maintained
+        # incrementally (_used) so neither statfs nor admission pays an
+        # all-objects scan on the hot path.
         self.device_bytes = device_bytes
+        self._used = 0
 
     # -- transaction application (atomic under lock) -----------------------
+
+    def _txn_growth(self, txn: Transaction) -> int:
+        """Net DATA bytes this transaction would add (write extensions,
+        upward truncates, clones), credited for its own removes/shrinks
+        — so a delete-and-rewrite txn admits whenever its net effect
+        fits.  Attr/omap bytes are not counted, matching statfs."""
+        grow = 0
+        sizes: Dict[Tuple[str, str], int] = {}
+
+        def cur(coll: str, oid: str) -> int:
+            key = (coll, oid)
+            if key not in sizes:
+                o = self._colls.get(coll, {}).get(oid)
+                sizes[key] = len(o.data) if o is not None else 0
+            return sizes[key]
+
+        for op in txn.ops:
+            kind = op[0]
+            if kind == "write":
+                _, coll, oid, offset, data = op
+                new = max(cur(coll, oid), offset + len(data))
+                grow += new - sizes[(coll, oid)]
+                sizes[(coll, oid)] = new
+            elif kind == "truncate":
+                _, coll, oid, size = op
+                grow += size - cur(coll, oid)
+                sizes[(coll, oid)] = size
+            elif kind == "clone":
+                _, coll, src, dst = op
+                grow += cur(coll, src) - cur(coll, dst)
+                sizes[(coll, dst)] = sizes[(coll, src)]
+            elif kind == "remove":
+                _, coll, oid = op
+                grow -= cur(coll, oid)
+                sizes[(coll, oid)] = 0
+            elif kind == "remove_collection":
+                for oid, o in self._colls.get(op[1], {}).items():
+                    grow -= len(o.data)
+                    sizes[(op[1], oid)] = 0
+        return grow
+
+    def _check_capacity(self, txn: Transaction) -> None:
+        """Refuse a transaction whose net data growth would exceed the
+        enforced capacity — WHOLE, before any byte lands (atomicity,
+        like the injected ENOSPC).  Deletes and shrinks (grow <= 0)
+        always admit, so a full store can dig itself out.  Shared by
+        MemStore and the journal-backed FileStore subclass (which must
+        check BEFORE journaling, or replay would re-meet the frame)."""
+        if not self.device_bytes:
+            return
+        with self._lock:
+            grow = self._txn_growth(txn)
+            if grow > 0 and self._used + grow > self.device_bytes:
+                raise OSError(
+                    28, f"store full: {self._used} used + "
+                        f"{grow} > {self.device_bytes}")
 
     def queue_transaction(self, txn: Transaction) -> None:
         if self.chaos is not None:
             # injected ENOSPC refuses the WHOLE txn before any byte
             # lands (atomicity preserved)
             self.chaos.on_write(txn)
+        self._check_capacity(txn)
         self._commit(txn)
         if self.chaos is not None:
             self.chaos.maybe_rot(self, txn)
@@ -162,12 +226,15 @@ class MemStore(ObjectStore):
         if kind == "create_collection":
             self._colls.setdefault(op[1], {})
         elif kind == "remove_collection":
-            self._colls.pop(op[1], None)
+            dropped = self._colls.pop(op[1], None)
+            if dropped:
+                self._used -= sum(len(o.data) for o in dropped.values())
         elif kind == "touch":
             self._coll(op[1]).setdefault(op[2], Obj())
         elif kind == "write":
             _, coll, oid, offset, data = op
             o = self._coll(coll).setdefault(oid, Obj())
+            old = len(o.data)
             end = offset + len(data)
             if offset == 0 and len(o.data) <= end:
                 # full rewrite/extend from 0 (the EC full-shard write):
@@ -178,20 +245,28 @@ class MemStore(ObjectStore):
                     o.data.extend(b"\0" * (end - len(o.data)))
                 o.data[offset:end] = data
             o.version += 1
+            self._used += len(o.data) - old
         elif kind == "truncate":
             _, coll, oid, size = op
             o = self._coll(coll).setdefault(oid, Obj())
+            old = len(o.data)
             if len(o.data) > size:
                 del o.data[size:]
             else:
                 o.data.extend(b"\0" * (size - len(o.data)))
             o.version += 1
+            self._used += len(o.data) - old
         elif kind == "remove":
-            self._coll(op[1]).pop(op[2], None)
+            dropped = self._coll(op[1]).pop(op[2], None)
+            if dropped is not None:
+                self._used -= len(dropped.data)
         elif kind == "clone":
             _, coll, src, dst = op
             s = self._coll(coll).get(src)
             if s is not None:
+                prev = self._coll(coll).get(dst)
+                self._used += len(s.data) - \
+                    (len(prev.data) if prev is not None else 0)
                 self._coll(coll)[dst] = Obj(
                     data=bytearray(s.data), xattrs=dict(s.xattrs),
                     omap=dict(s.omap), version=s.version)
@@ -293,8 +368,14 @@ class MemStore(ObjectStore):
         with self._lock:
             return sorted(self._colls)
 
+    def _recount_used(self) -> None:
+        """Rebuild the incremental used-bytes counter from the object
+        map — for mount paths that restore ``_colls`` wholesale (the
+        FileStore checkpoint load) instead of replaying ops."""
+        with self._lock:
+            self._used = sum(len(o.data) for c in self._colls.values()
+                             for o in c.values())
+
     def statfs(self) -> Tuple[int, int]:
         with self._lock:
-            used = sum(len(o.data)
-                       for c in self._colls.values() for o in c.values())
-            return (self.device_bytes, used)
+            return (self.device_bytes, self._used)

@@ -17,7 +17,7 @@ compiled executables instead of triggering per-size recompiles.
 
 from __future__ import annotations
 
-from typing import Dict, Mapping, Optional, Tuple
+from typing import Dict, List, Mapping, Optional, Tuple
 
 import numpy as np
 
@@ -143,14 +143,18 @@ def _host_engine_ok(codec) -> bool:
         getattr(eng, "coding", None) is not None
 
 
-def _encode_parity_host(coding: np.ndarray, batch: np.ndarray) -> np.ndarray:
-    """(B, k, S) -> (B, m, S) parity via table-driven GF(2^8) numpy:
-    coefficient-1 terms are pure XOR (the whole of RS m=1), others one
-    256-entry LUT gather per term."""
+def _gf_apply_host(mat: np.ndarray, batch: np.ndarray) -> np.ndarray:
+    """(B, k, S) x (m, k) GF(2^8) matrix -> (B, m, S) via table-driven
+    numpy: coefficient-1 terms are pure XOR (the whole of RS m=1),
+    others one 256-entry LUT gather per term.  Shared by the coalesced
+    host ENCODE (mat = the coding matrix) and the round-16 host DECODE
+    (mat = the inverted-survivor recovery matrix) — same field, same
+    tables, so either direction is bit-exact with the device path by
+    construction."""
     from ceph_tpu.ops.gf8 import GF_MUL
     from ceph_tpu.utils.perf import KERNELS
 
-    m, k = coding.shape
+    m, k = mat.shape
     b, _k, s = batch.shape
     KERNELS.inc("ec_host_matmul_calls")
     KERNELS.inc("ec_host_matmul_bytes", b * k * s)
@@ -158,7 +162,7 @@ def _encode_parity_host(coding: np.ndarray, batch: np.ndarray) -> np.ndarray:
     for j in range(m):
         acc = None
         for i in range(k):
-            c = int(coding[j, i])
+            c = int(mat[j, i])
             if c == 0:
                 continue
             term = batch[:, i, :] if c == 1 else GF_MUL[c][batch[:, i, :]]
@@ -168,6 +172,11 @@ def _encode_parity_host(coding: np.ndarray, batch: np.ndarray) -> np.ndarray:
                 np.bitwise_xor(acc, term, out=acc)
         out[:, j, :] = acc if acc is not None else 0
     return out
+
+
+def _encode_parity_host(coding: np.ndarray, batch: np.ndarray) -> np.ndarray:
+    """(B, k, S) -> (B, m, S) parity on the host GF engine."""
+    return _gf_apply_host(coding, batch)
 
 
 def encode_stripes_multi(codec, sinfo: StripeInfo, datas,
@@ -375,6 +384,260 @@ def reencode_stripes(
     parity_pb = codec.encode_planar(data_pb)
     out = np.asarray(data_pb.concat(parity_pb).to_batch())[:nstripes]
     return out.transpose(1, 0, 2).reshape(n, shard_len)
+
+
+def _assemble_logical(data_rows: Dict[int, np.ndarray], k: int,
+                      nstripes: int, unit: int,
+                      logical_size: int) -> bytes:
+    """Interleave k data shard rows back into logical bytes."""
+    stacked = np.stack([data_rows[s].reshape(nstripes, unit)
+                        for s in range(k)], axis=1)
+    return stacked.reshape(nstripes * k * unit)[:logical_size].tobytes()
+
+
+def assemble_data_stripes(sinfo: StripeInfo, shards: Mapping[int, object],
+                          logical_size: int) -> bytes:
+    """The no-erasure decode: every data shard present, so the logical
+    bytes are a pure host interleave (zero device work) — the fast path
+    ``decode_stripes``/``decode_stripes_multi`` take internally, exposed
+    for the read coalescer's non-degraded short circuit."""
+    k = sinfo.k
+    unit = sinfo.chunk_size
+    nstripes = sinfo.object_stripes(logical_size)
+    if nstripes == 0:
+        return b""
+    shard_len = nstripes * unit
+    rows: Dict[int, np.ndarray] = {}
+    for s in range(k):
+        arr = np.asarray(shards[s], dtype=np.uint8)
+        if arr.shape[0] != shard_len:
+            raise ValueError(
+                f"shard {s}: {arr.shape[0]} bytes, want {shard_len}")
+        rows[s] = arr
+    return _assemble_logical(rows, k, nstripes, unit, logical_size)
+
+
+def _host_decode_matrix(codec, src: Tuple[int, ...],
+                        want: Tuple[int, ...]) -> Optional[np.ndarray]:
+    """GF(2^8) recovery matrix for the host engine (chunk[want] =
+    R @ chunk[src]), or None when this codec/pattern cannot be solved
+    by plain survivor-submatrix inversion (non-MDS plans like SHEC fall
+    back to the codec's own decode machinery)."""
+    eng = getattr(codec, "engine", None)
+    if eng is None or not hasattr(eng, "decode_matrix"):
+        return None
+    try:
+        return np.asarray(eng.decode_matrix(tuple(src), tuple(want)),
+                          dtype=np.uint8)
+    except Exception:
+        return None
+
+
+def decode_stripes_multi(codec, sinfo: StripeInfo, reqs):
+    """Coalesced decode: N read gathers' shard maps in ONE device round
+    trip per distinct erasure pattern — the round-16 decode twin of
+    ``encode_stripes_multi`` (ROADMAP item 1).
+
+    ``reqs`` is a sequence of ``(shards, logical_size)`` pairs shaped
+    exactly like ``decode_stripes`` arguments; returns the list of
+    logical byte strings, aligned with ``reqs``.  Ops with every data
+    shard present never touch the device (pure host interleave); ops
+    missing data shards group by their (erasures, want) pattern and
+    each group pays one layout conversion + one fused decode dispatch
+    for its whole concatenated stripe batch.  Engine per backend like
+    the write side: CPU jax backends reconstruct through the inverted
+    survivor submatrix on the table-driven host GF engine (bit-exact —
+    same field, same generator), device backends keep the planar fused
+    decode.  Bit-exact with per-op ``decode_stripes`` by construction:
+    the code is stripe-local, so batch composition cannot change any
+    op's bytes (the tier-1 read-exactness gate compares them).
+    """
+    from ceph_tpu.utils.perf import KERNELS
+
+    k = sinfo.k
+    unit = sinfo.chunk_size
+    n = codec.get_chunk_count()
+    out: List = [None] * len(reqs)
+    groups: Dict[Tuple, List] = {}
+    for i, (shards, logical_size) in enumerate(reqs):
+        nstripes = sinfo.object_stripes(logical_size)
+        if nstripes == 0:
+            out[i] = b""
+            continue
+        shard_len = nstripes * unit
+        arrs: Dict[int, np.ndarray] = {}
+        data_rows: Dict[int, np.ndarray] = {}
+        for s in sorted(shards):
+            arr = np.asarray(shards[s], dtype=np.uint8)
+            if arr.shape[0] != shard_len:
+                raise ValueError(
+                    f"shard {s}: {arr.shape[0]} bytes, want {shard_len}")
+            arrs[s] = arr
+            if s < k:
+                data_rows[s] = arr
+        missing = tuple(s for s in range(k) if s not in data_rows)
+        if not missing:
+            out[i] = _assemble_logical(data_rows, k, nstripes, unit,
+                                       logical_size)
+            continue
+        if len(arrs) < k:
+            raise ValueError(f"only {len(arrs)} of {k} shards")
+        erasures = tuple(s for s in range(n) if s not in arrs)
+        groups.setdefault((erasures, missing), []).append(
+            (i, arrs, data_rows, nstripes, logical_size))
+    if not groups:
+        return out
+    KERNELS.inc("ec_coalesced_read_ticks")
+    KERNELS.inc("ec_coalesced_reads",
+                sum(len(g) for g in groups.values()))
+    host = _host_engine_ok(codec)
+    for (erasures, want), items in groups.items():
+        total = sum(ns for _i, _a, _d, ns, _ls in items)
+        full = np.zeros((total, n, unit), dtype=np.uint8)
+        ofs = 0
+        for _i, arrs, _d, ns, _ls in items:
+            for s, arr in arrs.items():
+                full[ofs:ofs + ns, s, :] = arr.reshape(ns, unit)
+            ofs += ns
+        recovered = None
+        if host:
+            src = tuple(s for s in range(n) if s not in erasures)[:k]
+            rmat = _host_decode_matrix(codec, src, want)
+            if rmat is not None:
+                recovered = _gf_apply_host(rmat, full[:, list(src), :])
+        if recovered is None:
+            bb = _bucket(total)
+            batch = full if bb == total else np.concatenate(
+                [full, np.zeros((bb - total, n, unit), dtype=np.uint8)])
+            if _planar_ok(codec, unit):
+                pb = codec.to_planar(batch)
+                recovered = np.asarray(
+                    codec.decode_planar(erasures, pb, want=want)
+                    .to_batch())[:total]
+            else:
+                recovered = np.asarray(
+                    codec.decode_batch(erasures, batch,
+                                       want=want))[:total]
+        ofs = 0
+        for i, _arrs, data_rows, ns, logical_size in items:
+            for idx, e in enumerate(want):
+                data_rows[e] = recovered[ofs:ofs + ns, idx, :] \
+                    .reshape(ns * unit)
+            ofs += ns
+            out[i] = _assemble_logical(data_rows, k, ns, unit,
+                                       logical_size)
+    return out
+
+
+def reencode_stripes_multi(codec, sinfo: StripeInfo, reqs):
+    """Coalesced recovery rebuild: N objects' full shard-row matrices in
+    one device round trip per distinct missing-data pattern — the multi
+    twin of ``reencode_stripes``, sharing its contract (returns the
+    per-op (k+m, nstripes*unit) uint8 matrices, aligned with ``reqs``).
+
+    CPU backends reconstruct missing data rows through the inverted
+    survivor submatrix and re-derive parity with the coding matrix —
+    both table-driven host GF passes, no layout conversion at all.
+    Device backends ride the planar grouped round trip (one to_planar,
+    one decode + one encode dispatch per pattern group); codecs without
+    the planar contract fall back to coalesced decode + coalesced
+    encode, which still batches the whole tick.
+    """
+    from ceph_tpu.utils.perf import KERNELS
+
+    k = sinfo.k
+    unit = sinfo.chunk_size
+    n = codec.get_chunk_count()
+    out: List = [None] * len(reqs)
+    groups: Dict[Tuple, List] = {}
+    for i, (shards, logical_size) in enumerate(reqs):
+        nstripes = sinfo.object_stripes(logical_size)
+        if nstripes == 0:
+            out[i] = np.zeros((n, 0), dtype=np.uint8)
+            continue
+        if len(shards) < k:
+            raise ValueError(f"only {len(shards)} of {k} shards")
+        shard_len = nstripes * unit
+        arrs: Dict[int, np.ndarray] = {}
+        for s in sorted(shards):
+            arr = np.asarray(shards[s], dtype=np.uint8)
+            if arr.shape[0] != shard_len:
+                raise ValueError(
+                    f"shard {s}: {arr.shape[0]} bytes, want {shard_len}")
+            arrs[s] = arr
+        erasures = tuple(s for s in range(n) if s not in arrs)
+        missing = tuple(s for s in range(k) if s not in arrs)
+        groups.setdefault((erasures, missing), []).append(
+            (i, arrs, nstripes, logical_size))
+    if not groups:
+        return out
+    KERNELS.inc("ec_coalesced_reencode_ticks")
+    KERNELS.inc("ec_coalesced_reencodes",
+                sum(len(g) for g in groups.values()))
+    host = _host_engine_ok(codec)
+    planar = _planar_ok(codec, unit)
+    for (erasures, want), items in groups.items():
+        total = sum(ns for _i, _a, ns, _ls in items)
+        # ONE assembly of the group's (total, n, unit) batch, shared by
+        # the host and planar branches (the decode twin's shape)
+        full = np.zeros((total, n, unit), dtype=np.uint8)
+        ofs = 0
+        for _i, arrs, ns, _ls in items:
+            for s, arr in arrs.items():
+                full[ofs:ofs + ns, s, :] = arr.reshape(ns, unit)
+            ofs += ns
+        rows = None                     # (total, n, unit) result batch
+        if host:
+            rmat = None
+            if want:
+                src = tuple(s for s in range(n)
+                            if s not in erasures)[:k]
+                rmat = _host_decode_matrix(codec, src, want)
+            if not want or rmat is not None:
+                if want:
+                    rec = _gf_apply_host(rmat, full[:, list(src), :])
+                    for idx, e in enumerate(want):
+                        full[:, e, :] = rec[:, idx, :]
+                data = full[:, :k, :]
+                full[:, k:, :] = _gf_apply_host(codec.engine.coding,
+                                                data)
+                rows = full
+        if rows is None and planar:
+            bb = _bucket(total)
+            if bb != total:
+                full = np.concatenate(
+                    [full, np.zeros((bb - total, n, unit),
+                                    dtype=np.uint8)])
+            pb = codec.to_planar(full)
+            if want:
+                dec = codec.decode_planar(erasures, pb, want=want)
+                combined = pb.concat(dec)
+                order = tuple(n + want.index(j) if j in want else j
+                              for j in range(k))
+                data_pb = combined.select(order)
+            else:
+                data_pb = pb.select(tuple(range(k)))
+            parity_pb = codec.encode_planar(data_pb)
+            rows = np.asarray(
+                data_pb.concat(parity_pb).to_batch())[:total]
+        if rows is None:
+            # no planar contract and no host matrix: coalesced decode
+            # to logical bytes + coalesced encode back to shard rows —
+            # still one batched trip per direction for the whole group
+            idxs = [i for i, _a, _ns, _ls in items]
+            datas = decode_stripes_multi(
+                codec, sinfo,
+                [(arrs, ls) for _i, arrs, _ns, ls in items])
+            encoded = encode_stripes_multi(codec, sinfo, datas)
+            for i, (shards_i, _crcs) in zip(idxs, encoded):
+                out[i] = shards_i
+            continue
+        ofs = 0
+        for i, _arrs, ns, _ls in items:
+            out[i] = rows[ofs:ofs + ns].transpose(1, 0, 2) \
+                .reshape(n, ns * unit)
+            ofs += ns
+    return out
 
 
 def merge_range(old: bytes, old_size: int, offset: int, data: bytes) -> bytes:

@@ -548,6 +548,29 @@ def builtin_specs() -> Dict[str, LoadSpec]:
             verbs=(("write", 4.0), ("read", 3.0), ("rmw", 1.0),
                    ("append", 1.0)),
             config=(("osd_op_throttle_ops", 24),)),
+        # round 16: the verified-read path at rate — read-dominant mix
+        # over an EC pool with verify-on-read (default on), judged by
+        # the same gates plus the integrity-counters presence row
+        "read-heavy": LoadSpec(
+            name="read-heavy", clients=64, sessions=4, rate=1.2,
+            duration=2.5, objects=32, payload=4096, osds=4,
+            pool_kind="erasure", pool_size=3, pg_num=8,
+            ec_profile=(("plugin", "jerasure"),
+                        ("technique", "reed_sol_van"),
+                        ("k", "2"), ("m", "1")),
+            verbs=(("write", 1.5), ("read", 6.0), ("append", 0.5))),
+        # round 16: reads racing the scheduled deep scrubber — scrub
+        # traffic yields to client admission pressure while the SLO
+        # gates (p99/goodput/deadline) must still hold
+        "scrub-concurrent": LoadSpec(
+            name="scrub-concurrent", clients=48, sessions=4, rate=1.0,
+            duration=2.5, objects=24, payload=4096, osds=4,
+            pool_kind="erasure", pool_size=3, pg_num=8,
+            ec_profile=(("plugin", "jerasure"),
+                        ("technique", "reed_sol_van"),
+                        ("k", "2"), ("m", "1")),
+            config=(("osd_scrub_interval", 0.5),),
+            verbs=(("write", 2.0), ("read", 5.0), ("rmw", 0.5))),
         # dmclock conformance under contention: mclock queue with a
         # client reservation, so the conformance gate judges served_
         # reservation from the scrape

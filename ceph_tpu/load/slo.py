@@ -263,6 +263,26 @@ def judge(spec, result, before: TelemetrySnapshot,
          "" if cp_present
          else "control-plane counters MISSING from scrape")
 
+    # integrity & full-protection counters (round 16): the verified
+    # read / read-repair / scheduled-scrub / cluster-full telemetry
+    # must be ON the scrape (an operator alerts on these; a refactor
+    # dropping them from export would blind every such alert), and an
+    # optional repairs floor gates corruption soaks — steady-state
+    # specs leave it 0 (counters-present only, like map_churn)
+    repairs_min = spec.gate("integrity_repairs_min", 0.0)
+    repairs = counter_delta(before, after, "ceph_osd_read_repairs") + \
+        counter_delta(before, after, "ceph_osd_scrub_errors_repaired")
+    integ_present = all(
+        name in after.prom for name in (
+            "ceph_osd_read_repairs", "ceph_osd_read_shard_crc_errors",
+            "ceph_osd_scrub_errors_repaired", "ceph_osd_full_rejects",
+            "ceph_osd_read_batch_ticks"))
+    _row(report, "integrity", round(repairs, 1), repairs_min,
+         integ_present and repairs >= repairs_min,
+         "scrape:ceph_osd_read_repairs",
+         "" if integ_present
+         else "integrity/full counters MISSING from scrape")
+
     # deadline: zero acks past the client budget (client-observed —
     # the one gate that cannot come from a scrape by definition)
     _row(report, "deadline", len(result.late_acks), 0,

@@ -160,6 +160,12 @@ class Incremental:
     new_mds_addrs: Dict[int, object] = field(default_factory=dict)
     new_revoked: Tuple[str, ...] = ()  # cephx entities to revoke
     old_pools: Tuple[int, ...] = ()    # pool deletions
+    # cluster flag transitions (round 16, reference CEPH_OSDMAP_FULL /
+    # NEARFULL / BACKFILLFULL): flag name -> set (True) / clear (False).
+    # The mon's full-ratio tick commits these from beacon statfs; OSDs
+    # enforce them (ENOSPC on client writes under "full", backfill
+    # deferred under "backfillfull").
+    new_flags: Dict[str, bool] = field(default_factory=dict)
     # cluster-log events riding the same Paxos stream (the reference's
     # LogMonitor is likewise a PaxosService on the shared paxos); the
     # OSDMap itself ignores them — the mon's log service consumes them
@@ -182,6 +188,10 @@ class OSDMap:
         # Paxos like every map mutation, so revocation survives mon
         # failover AND restarts via the persisted map)
         self.revoked_entities: set = set()
+        # cluster flags (round 16): "nearfull" | "backfillfull" |
+        # "full", committed by the mon's full-ratio tick and enforced
+        # by every OSD from its own map copy
+        self.flags: set = set()
         self.osd_primary_affinity: Optional[List[int]] = None
         self.pools: Dict[int, PGPool] = {}
         self.pg_upmap: Dict[PGid, List[int]] = {}
@@ -206,6 +216,7 @@ class OSDMap:
 
     def __setstate__(self, d):
         self.__dict__.update(d)
+        self.__dict__.setdefault("flags", set())
         self._scalar = ScalarMapper(self.crush)
         self._tensor = None
 
@@ -280,6 +291,11 @@ class OSDMap:
                 self.mds_addr = tuple(a)
         if inc.new_revoked:
             self.revoked_entities |= set(inc.new_revoked)
+        for flag, on in getattr(inc, "new_flags", {}).items():
+            if on:
+                self.flags.add(flag)
+            else:
+                self.flags.discard(flag)
         for pg, temp in inc.new_pg_temp.items():
             if temp:
                 self.pg_temp[pg] = list(temp)

@@ -59,6 +59,13 @@ EVENT_STAGE = {
     "batch_parked": "op_prepare",
     "batch_tick": "batch_wait",
     "batch_encoded": "batch_encode",
+    # verified batched reads (round 16): the read twin — a gather's
+    # decode parks at the read coalescer until its tick and books the
+    # amortized share of the fused decode, so wall_coverage holds on
+    # the read path with coalescing + verify-on-read enabled
+    "read_batch_parked": "op_prepare",
+    "read_batch_tick": "read_batch_wait",
+    "read_batch_decoded": "batch_decode",
     # reply-leg tail (round 11): the delta from the reply's client-side
     # recv stamp to the caller actually resuming — event-loop wakeup,
     # previously the untraced slice of wall_coverage

@@ -99,7 +99,28 @@ OPTIONS: List[Option] = [
     Option("osd_peering_stagger_max", float, 0.25,
            "cap on the per-round seeded stagger delay (s)", min=0),
     Option("osd_scrub_interval", float, 0.0,
-           "background scrub period per primary PG (0 disables)"),
+           "background deep-scrub period per primary PG (0 disables); "
+           "round 16: the scheduler is per-PG and seeded-jittered so "
+           "a daemon's PGs never scrub in lockstep"),
+    Option("osd_scrub_jitter", float, 0.5,
+           "fraction of osd_scrub_interval used as the per-PG seeded "
+           "jitter band (first scrub spreads across it; later scrubs "
+           "wobble +/- half of it)", min=0, max=1),
+    # verified reads + read-repair (round 16): every EC shard's crc is
+    # checked by its holder before the bytes may feed a decode, and a
+    # shard that fails crc / returns EIO / proves generation-stale is
+    # rebuilt in place asynchronously.  Both default ON; 0 restores the
+    # round-15 opportunistic-verify / fail-the-read behavior (the
+    # verify-on-read A/B lever BENCH_NOTES round 16 uses).
+    Option("osd_ec_verify_reads", int, 1,
+           "verify every EC shard crc at read time (local shard "
+           "batched through the read coalescer's crc tick, peers in "
+           "their sub-read handlers).  0 = serve unverified bytes",
+           min=0, max=1),
+    Option("osd_read_repair", int, 1,
+           "automatically rebuild shards a read gather found bad "
+           "(crc/EIO/stale) from the surviving shards, off the client "
+           "path.  0 = detect only", min=0, max=1),
     Option("osd_op_queue", str, "fifo",
            "client op scheduling: fifo | mclock (dmClock QoS)"),
     # sharded dispatch + per-tick stripe-batch coalescing (round 11):
@@ -159,6 +180,23 @@ OPTIONS: List[Option] = [
     # mon
     Option("mon_osd_down_out_interval", float, 30.0,
            "auto-out after down this long"),
+    # cluster-full protection (round 16, reference mon_osd_*_ratio):
+    # the mon judges per-OSD utilization from beacon statfs and commits
+    # nearfull/backfillfull/full flags into the OSDMap; full pools
+    # reject client writes with ENOSPC (deletes still admitted so the
+    # cluster can dig itself out), backfillfull gates backfill data
+    # movement, and the flags clear as space frees.
+    Option("mon_osd_nearfull_ratio", float, 0.85,
+           "per-OSD used/total at/above this raises OSD_NEARFULL and "
+           "sets the map's nearfull flag", min=0, max=1),
+    Option("mon_osd_backfillfull_ratio", float, 0.90,
+           "at/above this, backfill data movement is refused "
+           "(OSD_BACKFILLFULL + the map's backfillfull flag)",
+           min=0, max=1),
+    Option("mon_osd_full_ratio", float, 0.95,
+           "at/above this the cluster is FULL: client writes are "
+           "rejected with ENOSPC until space frees (OSD_FULL, "
+           "HEALTH_ERR, the map's full flag)", min=0, max=1),
     Option("mon_osd_min_down_reporters", int, 1),
     Option("mon_osd_failure_coalesce", float, 0.05,
            "window (s) to aggregate concurrent failure reports into "

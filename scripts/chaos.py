@@ -46,6 +46,12 @@ def main() -> int:
         frontdoor_scenarios,
         run_frontdoor,
     )
+    from ceph_tpu.chaos.integrity import (
+        FillScenario,
+        build_fill_plan,
+        integrity_scenarios,
+        run_fill_drain,
+    )
     from ceph_tpu.chaos.scenario import (
         build_schedule,
         builtin_scenarios,
@@ -55,9 +61,11 @@ def main() -> int:
 
     scenarios = builtin_scenarios()
     scenarios.update(frontdoor_scenarios(1.0))
+    scenarios.update(integrity_scenarios(1.0))
     if getattr(args, "scale", 1.0) != 1.0:
         scenarios.update(storm_scenarios(args.scale))
         scenarios.update(frontdoor_scenarios(args.scale))
+        scenarios.update(integrity_scenarios(args.scale))
     if args.cmd == "list":
         for name, sc in sorted(scenarios.items()):
             print(f"{name:24s} osds={sc.osds} rounds={sc.rounds} "
@@ -69,7 +77,10 @@ def main() -> int:
               f"(try: {', '.join(sorted(scenarios))})", file=sys.stderr)
         return 2
     if args.cmd == "schedule":
-        print(json.dumps(build_schedule(sc, args.seed), indent=2))
+        if isinstance(sc, FillScenario):
+            print(json.dumps(build_fill_plan(sc, args.seed), indent=2))
+        else:
+            print(json.dumps(build_schedule(sc, args.seed), indent=2))
         return 0
     tmpdir = None
     try:
@@ -78,6 +89,9 @@ def main() -> int:
         if isinstance(sc, FrontdoorScenario):
             verdict = asyncio.run(run_frontdoor(sc, args.seed,
                                                 tmpdir=tmpdir))
+        elif isinstance(sc, FillScenario):
+            verdict = asyncio.run(run_fill_drain(sc, args.seed,
+                                                 tmpdir=tmpdir))
         else:
             verdict = asyncio.run(run_scenario(sc, args.seed,
                                                tmpdir=tmpdir))

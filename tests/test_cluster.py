@@ -45,16 +45,26 @@ def test_replicated_put_get_delete():
             await io.remove("obj1")
             with pytest.raises(FileNotFoundError):
                 await io.read("obj1")
-            # data must exist on every acting replica, not just the primary
+            # data must exist on every acting replica, not just the
+            # primary (converge-poll to a wall deadline: ack precedes
+            # the last store applies only by scheduler noise, but a
+            # fixed beat flaked under host load)
             pgid = client.objecter.object_pgid(pool, "obj2")
             await io.write_full("obj2", b"fanout")
-            await asyncio.sleep(0.1)
             _, _, acting, _ = client.objecter.osdmap.pg_to_up_acting_osds(pgid)
             coll = f"pg_{pgid.pool}_{pgid.seed}"
-            holders = [o for o in acting
-                       if cluster.osds[o].store.stat(coll, "obj2") is not None]
-            assert holders == [o for o in acting], \
-                f"replicas missing: {holders} vs acting {acting}"
+
+            def _holders():
+                return [o for o in acting
+                        if cluster.osds[o].store.stat(coll, "obj2")
+                        is not None]
+
+            deadline = asyncio.get_event_loop().time() + 10
+            while asyncio.get_event_loop().time() < deadline and \
+                    _holders() != list(acting):
+                await asyncio.sleep(0.05)
+            assert _holders() == list(acting), \
+                f"replicas missing: {_holders()} vs acting {acting}"
         finally:
             await cluster.stop()
 
@@ -490,6 +500,8 @@ def test_concurrent_writes_during_restart_converge():
             io = client.ioctx(pool)
             stop_evt = asyncio.Event()
 
+            done = [0]      # completed write rounds across both writers
+
             async def writer(tag):
                 i = 0
                 while not stop_evt.is_set():
@@ -497,20 +509,33 @@ def test_concurrent_writes_during_restart_converge():
                         try:
                             await io.write_full(
                                 oid, f"{tag}-{i}-".encode() * 100)
+                            done[0] += 1
                         except Exception:
                             pass
                     i += 1
                     await asyncio.sleep(0.01)
 
+            async def _writes_past(mark, n, timeout=15.0):
+                # converge on OBSERVED write progress instead of fixed
+                # beats: the scenario needs writes to really land in
+                # each phase (down / recovering), and a timed window
+                # under host load sometimes contained none
+                deadline = asyncio.get_event_loop().time() + timeout
+                while asyncio.get_event_loop().time() < deadline and \
+                        done[0] < mark + n:
+                    await asyncio.sleep(0.05)
+                return done[0]
+
             writers = [asyncio.get_event_loop().create_task(writer(t))
                        for t in ("w1", "w2")]
-            await asyncio.sleep(0.3)
+            await _writes_past(0, 4)
             target = 2
             stopped = cluster.osds.pop(target)
             store = stopped.store
             await stopped.stop()
             await cluster.wait_down(target)
-            await asyncio.sleep(0.5)
+            mark = done[0]
+            await _writes_past(mark, 4)   # writes flow while down
             osd = OSDDaemon(target, cluster.mon_addr, config=cfg, store=store)
             await osd.start()
             cluster.osds[target] = osd
@@ -519,7 +544,8 @@ def test_concurrent_writes_during_restart_converge():
                 if cluster.mon.osdmap.osd_up[target]:
                     break
                 await asyncio.sleep(0.05)
-            await asyncio.sleep(0.5)
+            mark = done[0]
+            await _writes_past(mark, 4)   # writes overlap the resync
             stop_evt.set()
             await asyncio.gather(*writers)
 

@@ -190,8 +190,20 @@ def test_pool_delete_rename_set():
                 await client.pool_delete("renamed")
             await client.pool_delete("renamed", sure=True)
             assert "renamed" not in client.pool_list()
-            # the data is gone from every OSD store
-            await asyncio.sleep(0.3)
+            # the data is gone from every OSD store — converge-poll to
+            # a wall deadline (the deletion rides the map push; a fixed
+            # beat raced it under host load)
+            def _purged():
+                return all(
+                    not [c for c in osd.store.list_collections()
+                         if c.startswith(f"pg_{pool}_")]
+                    and not [p for p in osd.pgs if p.pool == pool]
+                    for osd in cluster.osds.values())
+
+            deadline = asyncio.get_event_loop().time() + 10
+            while asyncio.get_event_loop().time() < deadline and \
+                    not _purged():
+                await asyncio.sleep(0.05)
             for osd in cluster.osds.values():
                 assert not [c for c in osd.store.list_collections()
                             if c.startswith(f"pg_{pool}_")], \

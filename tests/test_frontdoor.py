@@ -320,6 +320,12 @@ def test_graftlint_scopes_cover_frontdoor_files():
         "ceph_tpu/cluster/mds.py", "ceph_tpu/cluster/fs.py",
         "ceph_tpu/cluster/snaps.py", "ceph_tpu/chaos/frontdoor.py",
         "ceph_tpu/chaos/points.py", "ceph_tpu/load/driver.py",
+        # round 16: the read coalescer, the scrub scheduler, and the
+        # integrity scenario runner joined the tree — the rule scopes
+        # must keep covering them (read-repair task spawns, the fill
+        # runner's async phases, the batcher's parked futures)
+        "ceph_tpu/cluster/batcher.py", "ceph_tpu/cluster/scrub.py",
+        "ceph_tpu/chaos/integrity.py",
     ]
     for mod in (taskspawn, async_errors, rpc_timeout):
         for path in frontdoor_files:

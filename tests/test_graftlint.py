@@ -343,6 +343,19 @@ def test_device_dispatch_scoped_and_coalescer_exempt():
     assert findings == []
 
 
+def test_device_dispatch_baseline_is_empty():
+    """Round 16 acceptance: the three accepted per-op-device-dispatch
+    remnants (legacy encode branch, read decode, recovery reencode) are
+    GONE — every device dispatch of the cluster data plane routes
+    through cluster/batcher.py, and the shipped baseline carries ZERO
+    suppressions for the rule (a regression would need a visible
+    baseline diff to land)."""
+    keys = baseline_mod.load_baseline(
+        baseline_mod.default_baseline_path())
+    assert not [k for k in keys
+                if k.startswith("per-op-device-dispatch::")], keys
+
+
 # ------------------------------------------------------- runtime wiring
 
 

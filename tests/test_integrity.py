@@ -1,0 +1,462 @@
+"""Round-16 integrity & self-healing: read-repair, scheduled scrub,
+inconsistent->clean health flow, cluster-full graceful degradation, and
+the seeded integrity scenarios (bitrot-under-load / disk-fill-drain).
+"""
+
+import asyncio
+
+import pytest
+
+from tests._flaky import contention_retry
+
+from ceph_tpu.chaos.disk import DiskInjector
+from ceph_tpu.chaos.rng import stream
+from ceph_tpu.cluster.store import MemStore, Transaction
+from ceph_tpu.cluster.vstart import _fast_config, start_cluster
+from ceph_tpu.ops import crc32c as crcmod
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+EC21 = {"plugin": "jerasure", "technique": "reed_sol_van",
+        "k": "2", "m": "1"}
+
+
+async def _converge_poll(fn, timeout=20.0, interval=0.05):
+    deadline = asyncio.get_event_loop().time() + timeout
+    while asyncio.get_event_loop().time() < deadline:
+        v = fn()
+        if v:
+            return v
+        await asyncio.sleep(interval)
+    return fn()
+
+
+# ------------------------------------------------------- memstore capacity
+
+
+def test_memstore_capacity_enforced_and_accounted():
+    """The used counter tracks write/truncate/clone/remove exactly, a
+    growing txn past capacity refuses WHOLE with ENOSPC (atomicity),
+    and shrink/delete txns always admit (the dig-yourself-out rule)."""
+    st = MemStore(device_bytes=10000)
+    st.queue_transaction(Transaction().write("c", "a", 0, b"x" * 4000))
+    st.queue_transaction(Transaction().write("c", "b", 0, b"y" * 4000))
+    assert st.statfs() == (10000, 8000)
+    # growth past capacity: refused whole, nothing applied
+    with pytest.raises(OSError) as ei:
+        st.queue_transaction(
+            Transaction().write("c", "big", 0, b"z" * 4000))
+    assert ei.value.errno == 28
+    assert st.stat("c", "big") is None and st.statfs()[1] == 8000
+    # overwrite in place (no growth) admits at the brim
+    st.queue_transaction(Transaction().write("c", "a", 0, b"w" * 4000))
+    # delete + rewrite inside ONE txn: net growth fits -> admitted
+    st.queue_transaction(Transaction()
+                         .remove("c", "a")
+                         .write("c", "a2", 0, b"v" * 3000))
+    assert st.statfs()[1] == 7000
+    # truncate up counts, truncate down credits
+    st.queue_transaction(Transaction().truncate("c", "a2", 1000))
+    assert st.statfs()[1] == 5000
+    # clone counts the copy
+    st.queue_transaction(Transaction().clone("c", "b", "b2"))
+    assert st.statfs()[1] == 9000
+    with pytest.raises(OSError):
+        st.queue_transaction(Transaction().clone("c", "b", "b3"))
+    # remove_collection returns everything
+    st.queue_transaction(Transaction().remove_collection("c"))
+    assert st.statfs()[1] == 0
+    # recount matches the incremental counter after arbitrary churn
+    st.queue_transaction(Transaction().write("d", "o", 100, b"q" * 50))
+    used = st.statfs()[1]
+    st._recount_used()
+    assert st.statfs()[1] == used == 150
+
+
+# --------------------------------------------------------- read repair
+
+
+@contention_retry()
+def test_read_repair_heals_bitrot_off_client_path():
+    """A flipped bit on one shard: the read still returns the acked
+    payload (decode around the corruption — zero wrong bytes), the
+    corrupt shard is rebuilt in place asynchronously, counters fire,
+    and the PG's inconsistent set drains (clean health flow)."""
+    async def scenario():
+        cluster = await start_cluster(4)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("rr", "erasure", pg_num=4,
+                                            ec_profile=EC21)
+            io = client.ioctx(pool)
+            payload = b"verified-read-payload-" * 800
+            await io.write_full("obj0", payload, timeout=120)
+            pgid = client.objecter.object_pgid(pool, "obj0")
+            coll = f"pg_{pgid.pool}_{pgid.seed}"
+            _, _, acting, primary = \
+                client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+            victim = [o for o in acting if o >= 0][0]
+            DiskInjector(stream(7, "t")).flip_bit(
+                cluster.osds[victim].store, coll, "obj0", bit=12345)
+            got = await io.read("obj0", timeout=60)
+            assert got == payload          # zero wrong-bytes acks
+            assert await _converge_poll(lambda: sum(
+                o.perf.get("osd_read_repairs")
+                for o in cluster.osds.values()))
+            assert sum(o.perf.get("osd_read_shard_crc_errors")
+                       for o in cluster.osds.values()) >= 1
+
+            def _healed():
+                full = cluster.osds[victim].store.read(coll, "obj0")
+                stored = int(cluster.osds[victim].store.getattr(
+                    coll, "obj0", "hinfo_crc"))
+                return crcmod.crc32c(0xFFFFFFFF, full) == stored
+
+            assert await _converge_poll(_healed)
+            st = cluster.osds[primary].pgs[pgid]
+            assert await _converge_poll(lambda: not st.inconsistent)
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+@contention_retry()
+def test_scheduled_scrub_repairs_without_a_read():
+    """The jittered scrub scheduler finds and heals silent rot that NO
+    client read ever touches, and the list-inconsistent / repair admin
+    commands serve their contract."""
+    async def scenario():
+        cfg = _fast_config()
+        cfg.osd_scrub_interval = 0.4
+        cluster = await start_cluster(4, config=cfg)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("ss", "erasure", pg_num=4,
+                                            ec_profile=EC21)
+            io = client.ioctx(pool)
+            await io.write_full("cold", b"never-read-again-" * 600,
+                                timeout=120)
+            pgid = client.objecter.object_pgid(pool, "cold")
+            coll = f"pg_{pgid.pool}_{pgid.seed}"
+            _, _, acting, primary = \
+                client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+            victim = [o for o in acting if o >= 0][-1]
+            DiskInjector(stream(9, "s")).flip_bit(
+                cluster.osds[victim].store, coll, "cold", bit=777)
+
+            def _healed():
+                full = cluster.osds[victim].store.read(coll, "cold")
+                stored = int(cluster.osds[victim].store.getattr(
+                    coll, "cold", "hinfo_crc"))
+                return crcmod.crc32c(0xFFFFFFFF, full) == stored
+
+            assert await _converge_poll(_healed, timeout=30.0)
+            assert sum(o.perf.get("osd_scrubs_scheduled")
+                       for o in cluster.osds.values()) > 0
+            assert sum(o.perf.get("osd_scrub_errors_repaired")
+                       for o in cluster.osds.values()) >= 1
+            # admin surface: nothing left inconsistent, repair runs
+            li = await cluster.daemon_command(f"osd.{primary}",
+                                              "list-inconsistent")
+            assert li == {}
+            rep = await cluster.daemon_command(f"osd.{primary}",
+                                               "repair")
+            assert all(not r["inconsistent"]
+                       for r in rep.values()), rep
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+@contention_retry()
+def test_inconsistent_health_raises_and_clears():
+    """PG_INCONSISTENT / OSD_SCRUB_ERRORS ride the beacon stream: an
+    unrepaired object raises both (and list-inconsistent names it);
+    healing clears them on the next beacon, like SLOW_OPS."""
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("hi", "replicated",
+                                            pg_num=4, size=3)
+            io = client.ioctx(pool)
+            await io.write_full("h0", b"payload", timeout=60)
+            pgid = client.objecter.object_pgid(pool, "h0")
+            _, _, _, primary = \
+                client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+            st = cluster.osds[primary].pgs[pgid]
+            st.inconsistent.add("h0")
+
+            def _raised():
+                checks = cluster.mon._health_data()["checks"]
+                return "PG_INCONSISTENT" in checks and \
+                    "OSD_SCRUB_ERRORS" in checks
+
+            assert await _converge_poll(_raised)
+            li = await cluster.daemon_command(f"osd.{primary}",
+                                              "list-inconsistent")
+            assert li == {str(pgid): ["h0"]}
+            st.inconsistent.discard("h0")
+            assert await _converge_poll(
+                lambda: "PG_INCONSISTENT" not in
+                cluster.mon._health_data()["checks"])
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+# --------------------------------------------------------- cluster full
+
+
+@contention_retry()
+def test_full_flag_cycle_enospc_drain_resume():
+    """Fill to the enforced capacity: explicit ENOSPC (errno 28, never
+    a timeout), the map's full flag + OSD_FULL/HEALTH_ERR raise,
+    deletes stay admitted, the flag clears as space frees, writes
+    resume, and every surviving acked object reads back intact."""
+    async def scenario():
+        cfg = _fast_config()
+        cfg.memstore_device_bytes = 1 << 19       # 512 KiB stores
+        cluster = await start_cluster(3, config=cfg)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("ff", "replicated",
+                                            pg_num=4, size=3)
+            io = client.ioctx(pool)
+            payload = b"f" * 24576
+            acked, enospc = [], 0
+            for i in range(40):
+                try:
+                    await io.write_full(f"o{i}", payload, timeout=20)
+                    acked.append(f"o{i}")
+                except OSError as e:
+                    assert getattr(e, "errno", None) == 28, e
+                    enospc += 1
+                    if enospc >= 3:
+                        break
+                    await asyncio.sleep(0.15)
+            assert enospc >= 3 and acked
+            assert await _converge_poll(
+                lambda: "full" in cluster.mon.osdmap.flags)
+            h = cluster.mon._health_data()
+            assert "OSD_FULL" in h["checks"]
+            assert h["status"] == "HEALTH_ERR"
+            # deletes admitted WHILE full
+            doomed = acked[: max(1, len(acked) * 3 // 4)]
+            for oid in doomed:
+                await io.remove(oid, timeout=20)
+            survivors = [o for o in acked if o not in doomed]
+            assert await _converge_poll(
+                lambda: "full" not in cluster.mon.osdmap.flags,
+                timeout=30.0)
+            await cluster.wait_for_epoch(cluster.mon.osdmap.epoch,
+                                         timeout=10)
+            await io.write_full("post", payload, timeout=30)
+            assert await io.read("post", timeout=30) == payload
+            for oid in survivors:      # zero acked-then-lost
+                assert await io.read(oid, timeout=30) == payload, oid
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+@contention_retry()
+def test_backfillfull_gates_backfill_data_movement():
+    """With the backfillfull flag on the primary's map, a peering
+    round defers FULL-INVENTORY backfill (counter + incomplete round)
+    while log-DELTA recovery still proceeds; clearing the flag lets
+    the armed retry backfill the member."""
+    async def scenario():
+        cluster = await start_cluster(3)
+        try:
+            client = await cluster.client()
+            # ONE PG so the log-trim below provably strands the victim
+            # behind the tail (a true backfill, not a delta resync)
+            pool = await client.pool_create("bf", "replicated",
+                                            pg_num=1, size=3)
+            io = client.ioctx(pool)
+            payload = b"b" * 8192
+            for i in range(4):
+                await io.write_full(f"g{i}", payload, timeout=60)
+            # the victim must be a NON-primary member: the gate lives
+            # on the pushing primary (a dead primary would come back
+            # and PULL itself current instead — the ungated path)
+            pgid = client.objecter.object_pgid(pool, "g0")
+            _, _, acting, primary = \
+                client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+            victim = next(o for o in acting if o >= 0 and o != primary)
+            await cluster.kill_osd(victim)
+            await cluster.wait_down(victim)
+            # shrink the survivors' log window and write past it: the
+            # dead member falls behind the TAIL — backfill territory
+            for osd in cluster.osds.values():
+                for st in osd.pgs.values():
+                    st.log.max_entries = 2
+            for i in range(4, 12):
+                await io.write_full(f"g{i}", payload, timeout=60)
+            # arm the gate on every survivor's map copy, then revive
+            # the (empty) member: backfill must defer
+            for osd in cluster.osds.values():
+                osd.osdmap.flags.add("backfillfull")
+            await cluster.revive_osd(victim)
+            assert await _converge_poll(lambda: sum(
+                o.perf.get("osd_backfill_blocked_full")
+                for o in cluster.osds.values()), timeout=30.0)
+            # clear the gate; the capped-backoff retry completes the
+            # backfill and the member converges
+            for osd in cluster.osds.values():
+                osd.osdmap.flags.discard("backfillfull")
+
+            def _member_current():
+                osd = cluster.osds.get(victim)
+                if osd is None:
+                    return False
+                return all(osd.store.stat(
+                    f"pg_{p.pool}_{p.seed}", f"g{i}") is not None
+                    for i in range(12)
+                    for p in [client.objecter.object_pgid(
+                        pool, f"g{i}")])
+
+            assert await _converge_poll(_member_current, timeout=40.0)
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+@contention_retry()
+def test_read_repair_heals_generation_stale_shard():
+    """A primary shard surgically regressed to an older committed
+    generation (bytes/attrs/version self-consistent, crc clean — an
+    interrupted recovery's leftover): the read serves the committed
+    group's bytes AND the stale detection queues a read-repair that
+    brings the shard back to the current generation, no scrub needed
+    (the detect-only anchor lives in test_rewind)."""
+    from ceph_tpu.cluster.store import Transaction
+
+    async def scenario():
+        cluster = await start_cluster(4)
+        try:
+            client = await cluster.client()
+            pool = await client.pool_create("sr", "erasure", pg_num=4,
+                                            ec_profile=EC21)
+            io = client.ioctx(pool)
+            g1 = b"g1-" * 340
+            g2 = b"g2-xyz" * 180
+            await io.write_full("obj", g1, timeout=120)
+            pgid = client.objecter.object_pgid(pool, "obj")
+            coll = f"pg_{pgid.pool}_{pgid.seed}"
+            _, _, _, primary = \
+                client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+            posd = cluster.osds[primary]
+            old_bytes = bytes(posd.store.read(coll, "obj"))
+            old_attrs = {k: posd.store.getattr(coll, "obj", k)
+                         for k in ("shard", "size", "hinfo_crc")}
+            old_ver = posd.store.get_version(coll, "obj")
+            await io.write_full("obj", g2, timeout=120)
+            txn = (Transaction()
+                   .write(coll, "obj", 0, old_bytes)
+                   .truncate(coll, "obj", len(old_bytes)))
+            for k, v in old_attrs.items():
+                txn.setattr(coll, "obj", k, v)
+            txn.set_version(coll, "obj", old_ver)
+            posd.store.queue_transaction(txn)
+            assert await io.read("obj", timeout=60) == g2
+
+            def _healed():
+                sa = posd.store.getattr(coll, "obj", "size")
+                return sa == str(len(g2)).encode() and \
+                    posd.store.get_version(coll, "obj") != old_ver
+
+            assert await _converge_poll(_healed)
+            assert sum(o.perf.get("osd_read_repairs")
+                       for o in cluster.osds.values()) >= 1
+        finally:
+            await cluster.stop()
+
+    run(scenario())
+
+
+# ------------------------------------------------------------- scenarios
+
+
+def test_integrity_plans_are_seed_deterministic():
+    """Replay contract, plan level: schedules/plans are pure functions
+    of (scenario, seed) for both integrity scenarios."""
+    from ceph_tpu.chaos.integrity import (FillScenario, build_fill_plan,
+                                          integrity_scenarios)
+    from ceph_tpu.chaos.scenario import build_schedule
+
+    lib = integrity_scenarios(0.06)
+    bl = lib["bitrot-under-load"]
+    assert build_schedule(bl, 23) == build_schedule(bl, 23)
+    fd = lib["disk-fill-drain"]
+    assert isinstance(fd, FillScenario)
+    assert build_fill_plan(fd, 23) == build_fill_plan(fd, 23)
+    assert build_fill_plan(fd, 23) != build_fill_plan(fd, 24)
+
+
+@pytest.mark.chaos
+@contention_retry()
+def test_bitrot_under_load_smoke():
+    """Tier-1 smoke of the bitrot-under-load acceptance scenario at
+    small scale: seeded PASS, flips actually injected, repairs fired."""
+    from ceph_tpu.chaos.integrity import integrity_scenarios
+    from ceph_tpu.chaos.scenario import run_scenario
+
+    sc = integrity_scenarios(0.06)["bitrot-under-load"]
+    verdict = run(run_scenario(sc, 11))
+    assert verdict.passed, verdict.failures
+    assert verdict.counters.get("disk_bitrot_flips", 0) >= 1
+
+
+@pytest.mark.chaos
+@contention_retry()
+def test_disk_fill_drain_smoke():
+    """Tier-1 smoke of the disk-fill-drain acceptance scenario: seeded
+    PASS through the full fill -> flag -> drain -> clear -> resume
+    cycle with zero acked-then-lost writes."""
+    from ceph_tpu.chaos.integrity import integrity_scenarios, \
+        run_fill_drain
+
+    sc = integrity_scenarios(0.06)["disk-fill-drain"]
+    verdict = run(run_fill_drain(sc, 7))
+    assert verdict.passed, verdict.failures
+    assert verdict.counters.get("fill_enospc", 0) >= 1
+    assert verdict.counters.get("full_rejects", 0) >= 1
+    assert verdict.counters.get("drained", 0) >= 1
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_bitrot_under_load_full_replays_bit_identically():
+    """Acceptance: the FULL bitrot-under-load scenario passes seeded
+    and two runs of one seed produce identical replay keys."""
+    from ceph_tpu.chaos.integrity import integrity_scenarios
+    from ceph_tpu.chaos.scenario import run_scenario
+
+    sc = integrity_scenarios(1.0)["bitrot-under-load"]
+    v1 = run(run_scenario(sc, 11))
+    v2 = run(run_scenario(sc, 11))
+    assert v1.passed, v1.failures
+    assert v1.replay_key() == v2.replay_key()
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_disk_fill_drain_full_replays_bit_identically():
+    from ceph_tpu.chaos.integrity import integrity_scenarios, \
+        run_fill_drain
+
+    sc = integrity_scenarios(1.0)["disk-fill-drain"]
+    v1 = run(run_fill_drain(sc, 7))
+    v2 = run(run_fill_drain(sc, 7))
+    assert v1.passed, v1.failures
+    assert v1.replay_key() == v2.replay_key()

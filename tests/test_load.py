@@ -178,6 +178,13 @@ def _mk_snap(ops=0, cwnd=256, pushbacks=0, hist=None, checks=None,
         "ceph_osd_map_skip_to_full": [({"daemon": "osd.0"}, 0)],
         "ceph_osd_peering_lat_hist_bucket": [
             ({"daemon": "osd.0", "le": "+Inf"}, 2)],
+        # round-16 integrity/full counters (the integrity gate requires
+        # presence on the scrape)
+        "ceph_osd_read_repairs": [({"daemon": "osd.0"}, 0)],
+        "ceph_osd_read_shard_crc_errors": [({"daemon": "osd.0"}, 0)],
+        "ceph_osd_scrub_errors_repaired": [({"daemon": "osd.0"}, 0)],
+        "ceph_osd_full_rejects": [({"daemon": "osd.0"}, 0)],
+        "ceph_osd_read_batch_ticks": [({"daemon": "osd.0"}, 1)],
     }
     if hist:
         prom["ceph_osd_op_lat_hist_bucket"] = [
@@ -259,7 +266,7 @@ def test_load_smoke_all_gates_and_bit_identical_replay():
     assert r1.offered == r2.offered == 180
     gates = {r["gate"] for r in rep1.rows}
     assert gates == {"goodput", "p99", "cwnd", "qos", "health",
-                     "map_churn", "deadline"}
+                     "map_churn", "integrity", "deadline"}
     # every scrape-side gate really had scrape data behind it
     by = {r["gate"]: r for r in rep1.rows}
     assert by["goodput"]["value"] >= r1.offered * 0.5
@@ -274,6 +281,12 @@ def test_load_smoke_all_gates_and_bit_identical_replay():
     # under real churn by test_control_plane's storm epochs floor.
     assert by["map_churn"]["passed"], by["map_churn"]
     assert by["map_churn"]["note"] == "", by["map_churn"]
+    # round-16 satellite: the integrity/full counters (read repairs,
+    # crc detections, scrub repairs, full rejects, read ticks) are ON
+    # the scrape — presence-gated like map_churn; counter MOVEMENT is
+    # gated by the bitrot-under-load scenario's repair invariant.
+    assert by["integrity"]["passed"], by["integrity"]
+    assert by["integrity"]["note"] == "", by["integrity"]
 
 
 def test_mgr_scrape_carries_client_and_qos_counters():

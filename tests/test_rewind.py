@@ -68,12 +68,18 @@ def test_ec_partial_write_rolls_back():
             io = client.ioctx(pool)
             v1 = bytes(range(256)) * 32
             await io.write_full("victim", v1)
-            await asyncio.sleep(0.05)
 
             pgid = client.objecter.object_pgid(pool, "victim")
             coll = f"pg_{pgid.pool}_{pgid.seed}"
             _, _, acting, primary = \
                 client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+            # converge-poll (not a fixed beat): every member's shard
+            # apply must land before the crc/log snapshot below
+            deadline = asyncio.get_event_loop().time() + 10
+            while asyncio.get_event_loop().time() < deadline and \
+                    any(cluster.osds[o].store.stat(coll, "victim")
+                        is None for o in acting):
+                await asyncio.sleep(0.05)
             posd = cluster.osds[primary]
             st = posd.pgs[pgid]
             lu_before = st.last_update
@@ -128,11 +134,17 @@ def test_ec_divergent_replica_rewinds_on_instruction():
             io = client.ioctx(pool)
             v1 = b"stable-state" * 100
             await io.write_full("obj", v1)
-            await asyncio.sleep(0.05)
             pgid = client.objecter.object_pgid(pool, "obj")
             coll = f"pg_{pgid.pool}_{pgid.seed}"
             _, _, acting, primary = \
                 client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+            # converge-poll: the replica's shard + log entry must land
+            # before crc_before/lu snapshot below (fixed beat flaked)
+            deadline = asyncio.get_event_loop().time() + 10
+            while asyncio.get_event_loop().time() < deadline and \
+                    any(cluster.osds[o].store.stat(coll, "obj") is None
+                        for o in acting):
+                await asyncio.sleep(0.05)
             replica = next(o for o in acting if o != primary)
             rosd = cluster.osds[replica]
             rst = rosd.pgs[pgid]
@@ -174,11 +186,19 @@ def test_stale_primary_shard_serves_committed_group():
     COMMITTED shard group at the GROUP's size, never the group's bytes
     truncated to the local size attr (graft-chaos: obj read back as g2
     bytes at g1's length).  Scrub must then flag + rebuild the stale
-    shard even though its crc is self-consistent."""
+    shard even though its crc is self-consistent.
+
+    Round 16: automatic READ-repair would heal the stale shard before
+    the scrub half of this test could see it (that path has its own
+    coverage in tests/test_integrity.py), so this anchor runs with
+    osd_read_repair=0 — detection-only — to keep exercising the scrub
+    generation-divergence machinery."""
     from ceph_tpu.cluster.store import Transaction
 
     async def scenario():
-        cluster = await start_cluster(4, config=_fast_config())
+        cfg = _fast_config()
+        cfg.osd_read_repair = 0
+        cluster = await start_cluster(4, config=cfg)
         try:
             client = await cluster.client()
             pool = await client.pool_create("stale", "erasure", pg_num=4,
