@@ -40,7 +40,8 @@ RULE = "swallowed-async-error"
 # round 13: graft-load's async driver joined the scope — a load window
 # that silently eats op failures reports a goodput it never served
 SCOPE = ("ceph_tpu/cluster/", "ceph_tpu/load/",
-         "ceph_tpu/osdmap/", "ceph_tpu/chaos/")
+         "ceph_tpu/osdmap/", "ceph_tpu/chaos/",
+         "ceph_tpu/trace/flight.py", "ceph_tpu/trace/postmortem.py")
 
 _BROAD = ("Exception", "BaseException")
 

@@ -36,7 +36,8 @@ _FUT_MAKERS = frozenset({"create_future", "_make_waiter"})
 # — asserted by tests/test_frontdoor.py so a future scope refactor
 # cannot silently drop them.
 SCOPE = ("ceph_tpu/cluster/", "ceph_tpu/load/",
-         "ceph_tpu/osdmap/", "ceph_tpu/chaos/")
+         "ceph_tpu/osdmap/", "ceph_tpu/chaos/",
+         "ceph_tpu/trace/flight.py", "ceph_tpu/trace/postmortem.py")
 
 
 def _future_names(fn: ast.AsyncFunctionDef) -> set:

@@ -45,7 +45,8 @@ RULE = "task-spawn"
 # round 15: the cluster/ prefix covers the front-door libraries
 # (rbd/rgw*/mds/fs/snaps) — pinned by tests/test_frontdoor.py.
 SCOPE = ("ceph_tpu/cluster/", "ceph_tpu/load/",
-         "ceph_tpu/osdmap/", "ceph_tpu/chaos/")
+         "ceph_tpu/osdmap/", "ceph_tpu/chaos/",
+         "ceph_tpu/trace/flight.py", "ceph_tpu/trace/postmortem.py")
 
 FIX = ("route it through a self-discarding tracker (the messenger "
        "_track pattern: set.add + add_done_callback(discard)) or a "

@@ -141,6 +141,13 @@ class Verdict:
     failures: List[str]
     acked_objects: int = 0
     counters: Dict[str, int] = field(default_factory=dict)
+    # artifact traceability (round 17): observed-vs-threshold rows for
+    # the judged gates and the graft-blackbox bundle path a conviction
+    # triggered.  Excluded from replay_key like counters: gate VALUES
+    # are wire-level timings, and the bundle path only exists when the
+    # recorder is on.
+    gates: List[Dict] = field(default_factory=list)
+    postmortem: Optional[str] = None
 
     def replay_key(self) -> Tuple:
         """The parts of a verdict that must be identical across two runs
@@ -154,7 +161,8 @@ class Verdict:
         return {"name": self.name, "seed": self.seed,
                 "passed": self.passed, "failures": self.failures,
                 "acked_objects": self.acked_objects,
-                "schedule": self.schedule, "counters": self.counters}
+                "schedule": self.schedule, "counters": self.counters,
+                "gates": self.gates, "postmortem": self.postmortem}
 
 
 # --------------------------------------------------------------- schedule
@@ -408,6 +416,8 @@ async def run_scenario(scenario: Scenario, seed: int,
     snaps: Dict[int, Dict[str, bytes]] = {}
     failures: List[str] = []
     gate_stats: Dict[str, int] = {}
+    gate_rows: List[Dict] = []
+    postmortem_path: Optional[str] = None
     ctx = None
     try:
         if scenario.load is not None:
@@ -565,6 +575,10 @@ async def run_scenario(scenario: Scenario, seed: int,
         gate_stats["storm_wall_ms"] = int(storm_wall * 1000)
         if scenario.epochs_floor > 0:
             rate = epochs_generated / storm_wall
+            gate_rows.append({"gate": "epochs",
+                              "value": round(rate, 3),
+                              "threshold": scenario.epochs_floor,
+                              "passed": rate >= scenario.epochs_floor})
             if rate < scenario.epochs_floor:
                 failures.append(
                     f"epochs: {epochs_generated} epochs in "
@@ -587,6 +601,13 @@ async def run_scenario(scenario: Scenario, seed: int,
                     health_ok_s = loop.time() - heal_t0
                     break
                 await asyncio.sleep(0.2)
+            gate_rows.append(
+                {"gate": "health_time",
+                 "value": None if health_ok_s is None
+                 else round(health_ok_s, 3),
+                 "threshold": scenario.health_ok_bound,
+                 "passed": health_ok_s is not None
+                 and health_ok_s <= scenario.health_ok_bound})
             if health_ok_s is None:
                 failures.append(
                     f"health_time: no HEALTH_OK within "
@@ -598,11 +619,33 @@ async def run_scenario(scenario: Scenario, seed: int,
                         f"health_time: HEALTH_OK took "
                         f"{health_ok_s:.1f}s > bound "
                         f"{scenario.health_ok_bound}s")
-        failures += await judge_invariants(
+        inv_failures = await judge_invariants(
             cluster, dmn, io, scenario.invariants, acked,
             attempted=attempted, mode=scenario.durability_mode,
             timeout=scenario.converge_timeout, acked_crcs=acked_crcs,
             snaps=snaps, deadline_misses=deadline_misses)
+        failures += inv_failures
+        gate_rows.append({"gate": "invariants",
+                          "value": len(inv_failures), "threshold": 0,
+                          "passed": not inv_failures})
+        if failures and getattr(cfg, "blackbox_enabled", 0):
+            # graft-blackbox: a convicted scenario triggers a bundle
+            # BEFORE teardown, while the breach evidence is still in
+            # the daemons' rings.  The reason carries only the failure
+            # HEAD (the gate/invariant name): the full failure strings
+            # embed wall timings and live in the detail — the reason
+            # feeds replay_key, which must be bit-identical across two
+            # runs of one seed
+            pm_rec = await cluster.blackbox_trigger(
+                "chaos_conviction",
+                f"scenario {scenario.name} seed={seed} convicted: "
+                f"{failures[0].split(':', 1)[0]}",
+                detail={"scenario": scenario.name, "seed": seed,
+                        "gates": [g for g in gate_rows
+                                  if not g["passed"]],
+                        "failures": list(failures)},
+                clients=(ctx.sessions if ctx is not None else ()))
+            postmortem_path = (pm_rec or {}).get("path")
     finally:
         if ctx is not None:
             await ctx.close()  # no-op: the scenario owns the cluster
@@ -613,7 +656,8 @@ async def run_scenario(scenario: Scenario, seed: int,
     delta.update(gate_stats)
     return Verdict(name=scenario.name, seed=seed, schedule=schedule,
                    passed=not failures, failures=failures,
-                   acked_objects=len(acked), counters=delta)
+                   acked_objects=len(acked), counters=delta,
+                   gates=gate_rows, postmortem=postmortem_path)
 
 
 async def _apply_event(cluster, dmn: DaemonInjector, client, io,

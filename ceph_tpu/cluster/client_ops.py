@@ -537,6 +537,11 @@ class ClientOpsMixin:
             if top.duration is not None:
                 self.perf.tinc("osd_op_lat", top.duration)
                 self.perf.hinc("osd_op_lat_hist", top.duration)
+                if self.flight:
+                    self.flight.op_sample(
+                        top.desc, top.duration,
+                        slow=0 < self.tracker.slow_threshold
+                        <= top.duration)
 
     async def _execute_mutation_dedup(self, conn, msg, m, pool, st, top):
         reqid = tuple(msg.reqid)

@@ -108,11 +108,17 @@ class MgrDaemon(Dispatcher):
         self._stopped = False
         self._exporter = None
         self.exporter_addr: Optional[Tuple[str, int]] = None
+        # graft-blackbox flight ring (NULL_FLIGHT when disabled)
+        from ceph_tpu.trace import FlightRecorder
+
+        self.flight = FlightRecorder.from_config(
+            "mgr", self.config)
         self.asok = self._build_admin_socket()
 
     def _build_admin_socket(self) -> AdminSocket:
         asok = AdminSocket()
-        asok.register_common(self.perfcoll, self.config)
+        asok.register_common(self.perfcoll, self.config,
+                             flight=self.flight)
         asok.register("mgr status",
                       lambda cmd: {
                           "daemons": sorted(self.daemons),
@@ -214,6 +220,11 @@ class MgrDaemon(Dispatcher):
                 "last_report": time.monotonic(),
             }
             self.perf.inc("mgr_reports")
+            if self.flight and self.perf.get("mgr_reports") % 16 == 0:
+                # sampled: the report stream is per-daemon-per-beacon;
+                # one ring event every 16 keeps the box from being all
+                # mgr traffic
+                self.flight.record("report", daemon=msg.daemon)
             return True
         if isinstance(msg, M.MCommand):
             result, data = await self.asok.dispatch(msg.cmd)

@@ -106,6 +106,23 @@ class Monitor(Dispatcher):
         from ceph_tpu.chaos.clock import ChaosClock
 
         self.clock = ChaosClock.from_config(self.config)
+        # graft-blackbox: flight ring + the bounded health-transition
+        # history (the postmortem timeline's health spine) — raise and
+        # clear records diffed from _health_data() each tick
+        from collections import deque as _deque
+
+        from ceph_tpu.trace import FlightRecorder
+
+        self.flight = FlightRecorder.from_config(
+            f"mon.{rank}", self.config, clock=self.clock)
+        self.health_history: _deque = _deque(
+            maxlen=max(1, int(getattr(self.config,
+                                      "mon_health_history", 128))))
+        self._last_health_checks: Dict[str, str] = {}
+        self._last_health_status = "HEALTH_OK"
+        # vstart arms this: fired once per edge INTO HEALTH_ERR with the
+        # active checks (the postmortem trigger seam)
+        self._blackbox_health_cb = None
         self.asok = self._build_admin_socket()
         self._tick_task: Optional[asyncio.Task] = None
         self._log: List[Tuple[str, object]] = []  # committed proposal log
@@ -283,15 +300,60 @@ class Monitor(Dispatcher):
                 out["nearfull"].append(o)
         return out
 
+    def _note_health(self) -> None:
+        """Health-transition bookkeeping, run each tick: diff the live
+        checks against the last tick's view and append raise/clear
+        records to the bounded history ring (satellite: the postmortem
+        timeline's health spine).  An edge INTO HEALTH_ERR fires the
+        vstart-armed blackbox callback — the fourth trigger kind."""
+        data = self._health_data()
+        checks, status = data["checks"], data["status"]
+        now = round(self.clock.time(), 6)
+        epoch = self.osdmap.epoch
+        for name, msg in checks.items():
+            if name not in self._last_health_checks:
+                sev = "ERR" if name == "OSD_FULL" else "WRN"
+                rec = {"check": name, "severity": sev, "op": "raise",
+                       "epoch": epoch, "time": now, "detail": msg}
+                self.health_history.append(rec)
+                if self.flight:
+                    self.flight.record("health", **rec)
+        for name in self._last_health_checks:
+            if name not in checks:
+                rec = {"check": name, "severity": "INF", "op": "clear",
+                       "epoch": epoch, "time": now, "detail": ""}
+                self.health_history.append(rec)
+                if self.flight:
+                    self.flight.record("health", **rec)
+        if status != self._last_health_status:
+            self.health_history.append(
+                {"check": "STATUS", "severity": status, "op": "status",
+                 "epoch": epoch, "time": now,
+                 "detail": f"{self._last_health_status} -> {status}"})
+            if self.flight:
+                self.flight.record("health_status",
+                                   prev=self._last_health_status,
+                                   status=status, epoch=epoch)
+            cb = self._blackbox_health_cb
+            if status == "HEALTH_ERR" and cb is not None:
+                cb(dict(checks))
+        self._last_health_checks = dict(checks)
+        self._last_health_status = status
+
     def _build_admin_socket(self):
         """The mon's 'ceph daemon mon.X' command table (reference
         Monitor::_add_bootstrap_peer_hint et al. asok registration)."""
         from ceph_tpu.utils import AdminSocket
 
         asok = AdminSocket()
-        asok.register_common(self.perf, self.config)
+        asok.register_common(self.perf, self.config,
+                             flight=self.flight)
         asok.register("health", lambda cmd: self._health_data(),
                       "cluster health status + checks")
+        asok.register("health history",
+                      lambda cmd: list(self.health_history),
+                      "bounded ring of health-transition records "
+                      "(check, severity, raise/clear epoch + time)")
         asok.register("quorum_status",
                       lambda cmd: {"rank": self.rank,
                                    "leader": self.leader_rank,
@@ -1349,6 +1411,7 @@ class Monitor(Dispatcher):
         while True:
             await asyncio.sleep(self.config.mon_tick_interval)
             now = self.clock.monotonic()
+            self._note_health()
             async with self._map_mutex:
                 inc = self._new_inc()
                 out_restore: Dict[int, float] = {}

@@ -94,6 +94,12 @@ class Objecter(Dispatcher):
         self._pushback_backoff = ExpBackoff(
             base=0.02, cap=1.0, rng=self._backoff_rng("pushback"))
         self._ops_acked = 0
+        # graft-blackbox flight ring (NULL_FLIGHT when disabled):
+        # clients have no ChaosClock — wall time, zero recorded skew
+        from ceph_tpu.trace import FlightRecorder
+
+        self.flight = FlightRecorder.from_config(
+            f"client.{self.display_name}", self.config)
 
     # -- client telemetry on the mgr Prometheus path (round 13) ------------
 
@@ -126,6 +132,8 @@ class Objecter(Dispatcher):
                 daemon=f"client.{self.display_name}",
                 counters=self.flow_counters(),
                 stamp=_time.monotonic()), tuple(addr))
+            if self.flight:
+                self.flight.record("cwnd", **self.flow_counters())
             return True
         except (ConnectionError, OSError, RuntimeError):
             return False
@@ -421,6 +429,10 @@ class Objecter(Dispatcher):
                         # beat, resend — WITHOUT a map refresh (the
                         # target is right, the daemon is full)
                         self.cwnd.on_pushback()
+                        if self.flight:
+                            self.flight.record(
+                                "cwnd", event="pushback",
+                                limit=self.cwnd.limit)
                         if loop.time() > deadline:
                             raise TimeoutError(
                                 f"op on {oid} throttled past deadline")

@@ -134,6 +134,12 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         self.loopmon = LoopProfiler(
             self.perf, self.config.loop_profile_interval,
             prefix="osd_loop")
+        # graft-blackbox flight ring (NULL_FLIGHT when disabled):
+        # stamped on this daemon's possibly-skewed chaos clock
+        from ceph_tpu.trace import FlightRecorder
+
+        self.flight = FlightRecorder.from_config(
+            f"osd.{osd_id}", self.config, clock=self.clock)
         # live depth of the ordered dispatch queues (ShardedOpWQ-depth
         # analog) — maintained by client_ops, exported as a perf gauge
         self._queued_depth = 0
@@ -291,6 +297,8 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         self._crash_fired = True
         self._stopped = True
         CHAOS.inc("crash_points_fired")
+        if self.flight:
+            self.flight.record("crash_point", point=name)
         if hasattr(self.store, "crash"):
             # freeze the disk AT the instant: nothing the unwinding
             # coroutines do past this point may persist (a real power
@@ -938,11 +946,15 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         from ceph_tpu.utils import AdminSocket
 
         asok = AdminSocket()
-        asok.register_common(self.perfcoll, self.config)
+        asok.register_common(self.perfcoll, self.config,
+                             flight=self.flight)
 
         def _inject(cmd):
-            self.config.injectargs(cmd.get("args", {}))
+            args = cmd.get("args", {})
+            self.config.injectargs(args)
             self.perf.inc("osd_injectargs")
+            if self.flight and any(k.startswith("chaos_") for k in args):
+                self.flight.record("chaos", args=dict(args))
             # complaint-time/history knobs apply to the live tracker
             self.tracker.slow_threshold = \
                 self.config.osd_op_complaint_time
@@ -1169,6 +1181,9 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         if msg.inc_blobs:
             self.perf.inc("osd_map_epochs_applied", len(msg.inc_blobs))
         self.perf.set("osd_map_epoch", m.epoch)
+        if self.flight:
+            self.flight.record("map", epoch=m.epoch,
+                               incs=len(msg.inc_blobs))
         await self._post_map_update()
 
     async def _handle_map(self, msg: M.MOSDMapMsg) -> None:
@@ -1181,6 +1196,8 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                       max(1, newmap.epoch - old.epoch) if old is not None
                       else 1)
         self.perf.set("osd_map_epoch", newmap.epoch)
+        if self.flight:
+            self.flight.record("map", epoch=newmap.epoch, full=True)
         await self._post_map_update()
 
     async def _post_map_update(self) -> None:
@@ -1196,6 +1213,8 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                                             instance=self.boot_instance))
         changed = self._advance_pgs()
         if changed and not self._stopped:
+            if self.flight:
+                self.flight.record("peering", epoch=newmap.epoch)
             self._kick_peering()
         if not self._stopped and any(
                 set(newmap.pools[st.pgid.pool].removed_snaps)
@@ -1401,6 +1420,23 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
             elif not slow_n and self._slow_warned:
                 self.clog("INF", "slow ops cleared")
             self._slow_warned = slow_n
+            if self.flight:
+                # queue/admission/slow-op sample each beacon window, a
+                # LOOP_LAG spike event when the window crossed the
+                # warning bound, and scrub detections when any fired
+                self.flight.record(
+                    "queue", depth=self._queued_depth,
+                    admit_ops=self._admit_ops,
+                    admit_bytes=self._admit_bytes, slow=slow_n)
+                lag = self.loopmon.lag_report()
+                if lag is not None and \
+                        lag[1] >= self.config.loop_lag_warn > 0:
+                    self.flight.record("loop_lag",
+                                       window_max=round(lag[1], 6))
+                bad_objs, bad_pgs = self._scrub_stats()
+                if bad_objs:
+                    self.flight.record("scrub", inconsistent=bad_objs,
+                                       pgs=bad_pgs)
             try:
                 await self._mon_send(M.MOSDAlive(
                     osd_id=self.osd_id, statfs=self.store.statfs(),

@@ -58,6 +58,71 @@ class Cluster:
     # it without re-deriving them
     mds_configs: Dict[int, Config] = field(default_factory=dict)
     mds_pools: Dict[int, tuple] = field(default_factory=dict)
+    # graft-blackbox (round 17): triggered postmortem bundles.  Every
+    # produced bundle record lands here ({kind, reason, path, bundle});
+    # _bb_seen dedups triggers (one bundle per (kind, reason) — a
+    # flapping HEALTH_ERR edge or a re-judged gate must not spray
+    # bundles), _bb_tasks tracks async trigger collection spawned from
+    # sync seams (the mon health callback), drained by stop().
+    postmortems: List[Dict] = field(default_factory=list)
+    _bb_seen: set = field(default_factory=set)
+    _bb_tasks: set = field(default_factory=set)
+
+    async def blackbox_trigger(self, kind: str, reason: str,
+                               detail: Optional[Dict] = None,
+                               clients=()) -> Optional[Dict]:
+        """Fire a postmortem trigger: snapshot every daemon's flight
+        ring + historic ops + mgr scrape + mon health history into ONE
+        bundle (ceph_tpu/trace/postmortem.py), write POSTMORTEM_*.json
+        when blackbox_dir is set, and remember the record.  One falsy
+        test when blackbox_enabled=0 (the no-op contract); deduped per
+        (kind, reason)."""
+        if not getattr(self.config, "blackbox_enabled", 0):
+            return None
+        key = (kind, reason)
+        if key in self._bb_seen:
+            return None
+        self._bb_seen.add(key)
+        from ceph_tpu.trace import postmortem as pm
+
+        bundle = await pm.collect_bundle(self, kind, reason,
+                                         detail=detail, clients=clients)
+        path = None
+        out_dir = getattr(self.config, "blackbox_dir", "")
+        if out_dir:
+            path = pm.write_bundle(bundle, out_dir)
+        rec = {"kind": kind, "reason": reason, "path": path,
+               "bundle": bundle}
+        self.postmortems.append(rec)
+        return rec
+
+    def _arm_blackbox(self, mon: Monitor) -> None:
+        """Install the mon's HEALTH_ERR trigger seam: the edge INTO
+        HEALTH_ERR (detected by the mon's tick) spawns a bundle
+        collection task owned by the cluster (the mon's tick loop must
+        not block on collecting a cluster-wide snapshot)."""
+        if not getattr(self.config, "blackbox_enabled", 0):
+            return
+        from ceph_tpu.utils.tasks import track_task
+
+        def fire(checks: Dict) -> None:
+            async def _collect():
+                await self.blackbox_trigger(
+                    "health_err", f"mon.{mon.rank} HEALTH_ERR",
+                    detail={"checks": checks})
+
+            track_task(self._bb_tasks,
+                       asyncio.get_event_loop().create_task(_collect()))
+
+        mon._blackbox_health_cb = fire
+
+    async def drain_blackbox(self) -> None:
+        """Wait out in-flight trigger collections (stop() calls this
+        first so a bundle never races the teardown)."""
+        while self._bb_tasks:
+            # collection drain: each task's outcome is its bundle record
+            await asyncio.gather(*list(self._bb_tasks),  # graftlint: ignore[swallowed-async-error]
+                                 return_exceptions=True)
 
     def _arm_chaos_crash(self, osd: OSDDaemon) -> None:
         """Install the crash-point callback: when the daemon's write
@@ -70,6 +135,14 @@ class Cluster:
             async def _crash():
                 if self.osds.get(osd.osd_id) is osd:
                     await self.crash_osd(osd.osd_id)
+                # a fired crash point is a postmortem trigger: the
+                # bundle is taken with the victim already down (its
+                # flight ring's tail IS the evidence of interest, and
+                # collection tolerates the dead daemon)
+                await self.blackbox_trigger(
+                    "crash_point",
+                    f"osd.{osd.osd_id} crash point {point!r}",
+                    detail={"osd": osd.osd_id, "point": point})
 
             track_task(self._chaos_tasks,
                        asyncio.get_event_loop().create_task(_crash()))
@@ -223,6 +296,7 @@ class Cluster:
         host, port = self.mon_addrs[rank]
         await mon.start(host, port)
         self.mons[rank] = mon
+        self._arm_blackbox(mon)
         if len(self.mons) > 1:
             mon.set_monmap(self.mon_addrs)
             await mon.begin_elections()
@@ -321,6 +395,7 @@ class Cluster:
         raise TimeoutError(f"osd.{osd_id} never marked down")
 
     async def stop(self) -> None:
+        await self.drain_blackbox()
         for c in self.clients:
             await c.shutdown()
         for d in (self.mdss or {}).values():
@@ -391,6 +466,8 @@ async def start_cluster(n_osds: int = 3, osds_per_host: int = 1,
     cluster = Cluster(mons=mons, osds={}, config=config,
                       mon_addrs=mon_addrs)
     cluster._initial_map_blob = map_blob
+    for mon in mons:
+        cluster._arm_blackbox(mon)
     if n_mons > 1:
         for mon in mons:
             mon.set_monmap(mon_addrs)

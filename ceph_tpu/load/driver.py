@@ -501,6 +501,21 @@ async def run_load(spec: LoadSpec, seed: int, ctx: Optional[LoadContext]
         after = await slo.snapshot(ctx.cluster)
         result.elapsed = max(1e-6, after.stamp - before.stamp)
         report = slo.judge(spec, result, before, after)
+        if not report.passed and \
+                getattr(ctx.cluster.config, "blackbox_enabled", 0):
+            # graft-blackbox: a failed SLO judgment IS a trigger — the
+            # bundle snapshots the cluster while the breach evidence
+            # (historic ops, flight rings) is still in the rings
+            # the reason stays a pure function of (spec, seed) — gate
+            # counts/values are wire-level and ride the detail — so the
+            # bundle path and replay_key are seeded-replay stable
+            rec = await ctx.cluster.blackbox_trigger(
+                "slo_gate",
+                f"load {spec.name} seed={seed} failed SLO gates",
+                detail={"spec": spec.name, "seed": seed,
+                        "gates": report.failing_gates()},
+                clients=ctx.sessions)
+            report.postmortem = (rec or {}).get("path")
         return result, report
     finally:
         if owns:

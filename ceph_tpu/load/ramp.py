@@ -58,6 +58,12 @@ async def ramp(spec: LoadSpec, seed: int,
                 "p99_ms": p99_row.get("value"),
                 "passed": report.passed,
                 "gates": report.as_rows(),
+                # traceability (round 17): the failing gates' observed
+                # vs threshold values and the graft-blackbox bundle a
+                # failed judgment triggered — the artifact alone
+                # diagnoses a failed step
+                "failed_gates": report.failing_gates(),
+                "postmortem": report.postmortem,
                 "client": result.as_dict(),
             }
             steps.append(step)

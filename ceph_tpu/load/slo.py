@@ -151,6 +151,10 @@ class SLOReport:
     """Gate verdicts for one load window (rides the LOAD_r* artifact)."""
 
     rows: List[Dict] = field(default_factory=list)
+    # path of the graft-blackbox bundle a failing judgment triggered
+    # (None when passing or when the recorder is off) — artifact
+    # traceability: a failed run is diagnosable from the artifact alone
+    postmortem: Optional[str] = None
 
     @property
     def passed(self) -> bool:
@@ -159,6 +163,13 @@ class SLOReport:
     def failures(self) -> List[str]:
         return [f"{r['gate']}: value={r['value']} "
                 f"threshold={r['threshold']} ({r.get('note', '')})"
+                for r in self.rows if not r["passed"]]
+
+    def failing_gates(self) -> List[Dict]:
+        """Observed-vs-threshold rows for every failed gate — what the
+        postmortem trigger detail and the artifact record."""
+        return [{"gate": r["gate"], "value": r["value"],
+                 "threshold": r["threshold"]}
                 for r in self.rows if not r["passed"]]
 
     def as_rows(self) -> List[Dict]:

@@ -81,6 +81,7 @@ async def run_soak(spec: SoakSpec, seed: int,
     attempted: Dict[str, set] = {}
     failures = []
     late_acks = []
+    postmortem_path: Optional[str] = None
     try:
         io = ctx.io(0)
         for rnd in range(spec.rounds):
@@ -132,6 +133,20 @@ async def run_soak(spec: SoakSpec, seed: int,
             cluster, dmn, io, spec.invariants, acked,
             attempted=attempted, mode="attempted",
             timeout=spec.converge_timeout, deadline_misses=late_acks)
+        if failures and getattr(cluster.config, "blackbox_enabled", 0):
+            # graft-blackbox: a convicted soak triggers a bundle before
+            # teardown (same seam as a chaos conviction)
+            # reason carries only the failure HEAD (invariant name):
+            # full strings embed wall timings and ride in the detail —
+            # the reason feeds the bundle's deterministic replay_key
+            pm_rec = await cluster.blackbox_trigger(
+                "chaos_conviction",
+                f"soak {spec.name} seed={seed} convicted: "
+                f"{failures[0].split(':', 1)[0]}",
+                detail={"scenario": spec.name, "seed": seed,
+                        "failures": list(failures)},
+                clients=ctx.sessions)
+            postmortem_path = (pm_rec or {}).get("path")
     finally:
         await ctx.close()
     counters1 = CHAOS.dump()["chaos"]
@@ -139,7 +154,10 @@ async def run_soak(spec: SoakSpec, seed: int,
              if counters1[k] - counters0.get(k, 0)}
     return Verdict(name=spec.name, seed=seed, schedule=schedule,
                    passed=not failures, failures=failures,
-                   acked_objects=len(acked), counters=delta)
+                   acked_objects=len(acked), counters=delta,
+                   gates=[{"gate": "invariants", "value": len(failures),
+                           "threshold": 0, "passed": not failures}],
+                   postmortem=postmortem_path)
 
 
 def builtin_soaks() -> Dict[str, SoakSpec]:

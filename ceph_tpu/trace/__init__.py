@@ -12,6 +12,8 @@ model (BENCH_NOTES) is untouched.
 - ``attribution`` event timeline -> per-stage latency attribution.
 - ``loopmon``     sampled event-loop lag + task queue/wall profiling.
 - ``perfetto``    chrome://tracing / Perfetto JSON export.
+- ``flight``      graft-blackbox per-daemon flight-recorder rings.
+- ``postmortem``  triggered POSTMORTEM_* bundles + breach attribution.
 """
 
 from ceph_tpu.trace.span import (  # noqa: F401
@@ -29,3 +31,8 @@ from ceph_tpu.trace.attribution import (  # noqa: F401
     stage_for,
 )
 from ceph_tpu.trace.loopmon import LoopProfiler  # noqa: F401
+from ceph_tpu.trace.flight import (  # noqa: F401
+    NULL_FLIGHT,
+    FlightRecorder,
+    merged_timeline,
+)

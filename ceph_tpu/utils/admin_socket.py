@@ -55,12 +55,24 @@ class AdminSocket:
 
     # -- the standard per-daemon command set --------------------------------
 
-    def register_common(self, perf, config=None) -> None:
+    def register_common(self, perf, config=None, flight=None) -> None:
         """Register the commands every daemon serves: the perf family
         (reference perf dump / perf schema / perf histogram dump /
         perf reset) and config show/injectargs.  ``perf`` is a
-        PerfCounters or a PerfCountersCollection."""
+        PerfCounters or a PerfCountersCollection.  ``flight`` (a
+        FlightRecorder or NULL_FLIGHT) adds ``blackbox dump`` — the
+        per-daemon postmortem snapshot: the flight ring plus the
+        high-priority perf slice.  NULL_FLIGHT serves a disabled
+        payload, so bundle collection never errors on a daemon that
+        has the recorder off."""
         assert isinstance(perf, (PerfCounters, PerfCountersCollection))
+        if flight is not None:
+            self.register(
+                "blackbox dump",
+                lambda cmd: {"flight": flight.dump(),
+                             "perf_critical": perf.dump_critical()},
+                "flight-recorder ring + critical perf counters "
+                "(the postmortem bundle's per-daemon slice)")
         self.register("perf dump", lambda cmd: perf.dump(),
                       "dump perf counter values")
         self.register("perf schema", lambda cmd: perf.dump_schema(),

@@ -177,6 +177,27 @@ OPTIONS: List[Option] = [
     Option("loop_lag_warn", float, 0.5,
            "sampled loop lag at/above this raises the LOOP_LAG health "
            "warning (needs the sampler on)", min=0),
+    # graft-blackbox (ceph_tpu/trace/flight.py + postmortem.py): the
+    # per-daemon flight-recorder ring and triggered postmortem bundles.
+    # Default-off keeps the provable-no-op contract: every daemon's
+    # recorder is the shared NULL_FLIGHT singleton and the trigger path
+    # in vstart/load/chaos is one falsy test.
+    Option("blackbox_enabled", int, 0,
+           "per-daemon flight recorder + triggered postmortem bundles "
+           "(0 = off: provable no-op, the graft-trace contract)",
+           min=0, max=1),
+    Option("blackbox_ring", int, 512,
+           "flight-recorder ring capacity per daemon (hard memory "
+           "bound; overflow drops oldest and counts)", min=1),
+    Option("blackbox_sample", int, 8,
+           "record every Nth completed op in the flight ring (slow "
+           "ops always recorded)", min=1),
+    Option("blackbox_dir", str, "",
+           "directory for triggered POSTMORTEM_*.json bundles; empty "
+           "keeps bundles in-memory only (cluster.postmortems)"),
+    Option("mon_health_history", int, 128,
+           "health-transition records kept in the mon's bounded "
+           "history ring (served by 'health history')", min=1),
     # mon
     Option("mon_osd_down_out_interval", float, 30.0,
            "auto-out after down this long"),

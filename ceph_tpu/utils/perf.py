@@ -214,6 +214,24 @@ class PerfCounters:
             return {self.name: {k: h.dump()
                                 for k, h in self._hists.items()}}
 
+    def dump_critical(self, min_prio: int = PRIO_INTERESTING) -> Dict:
+        """High-priority counters only (reference prio_adjust on the
+        mgr report path): the postmortem bundle's perf slice — small
+        enough to snapshot per daemon at trigger time without dragging
+        the full dump (histograms excluded; they're bulk, not triage)."""
+        with self._lock:
+            out: Dict = {}
+            for k, v in self._counters.items():
+                meta = self._schema.get(k)
+                if meta is None or meta["priority"] >= min_prio:
+                    out[k] = v
+            for k, (count, total, last, mn, mx) in self._avgs.items():
+                meta = self._schema.get(k)
+                if meta is None or meta["priority"] >= min_prio:
+                    out[k] = {"avgcount": count, "sum": total,
+                              "last": last, "min": mn, "max": mx}
+            return {self.name: out}
+
     def dump_schema(self) -> Dict:
         """Counter metadata (reference 'perf schema')."""
         with self._lock:
@@ -297,6 +315,12 @@ class PerfCountersCollection:
         out: Dict = {}
         for pc in self._snapshot():
             out.update(pc.dump_schema())
+        return out
+
+    def dump_critical(self, min_prio: int = PRIO_INTERESTING) -> Dict:
+        out: Dict = {}
+        for pc in self._snapshot():
+            out.update(pc.dump_critical(min_prio=min_prio))
         return out
 
     def reset(self) -> None:

@@ -39,6 +39,13 @@ def main() -> int:
                             "full acceptance shape (slow), small "
                             "fractions run the same code paths at "
                             "tier-1 size (e.g. --scale 0.06)")
+        if name == "run":
+            p.add_argument("--postmortem", default=".", metavar="DIR",
+                           help="directory for triggered "
+                                "POSTMORTEM_*.json bundles (default .)")
+            p.add_argument("--no-postmortem", action="store_true",
+                           help="disable the flight recorder / "
+                                "postmortem bundles for this run")
     args = ap.parse_args()
 
     from ceph_tpu.chaos.frontdoor import (
@@ -82,6 +89,14 @@ def main() -> int:
         else:
             print(json.dumps(build_schedule(sc, args.seed), indent=2))
         return 0
+    if not args.no_postmortem:
+        # graft-blackbox on by default for CLI runs: a conviction (or a
+        # fired crash point / HEALTH_ERR edge) auto-produces a bundle
+        from dataclasses import replace
+
+        sc = replace(sc, config=tuple(sc.config) + (
+            ("blackbox_enabled", 1),
+            ("blackbox_dir", os.path.abspath(args.postmortem))))
     tmpdir = None
     try:
         if sc.store != "mem":
@@ -109,6 +124,8 @@ def main() -> int:
               f"faults={verdict.counters})")
         for f in verdict.failures:
             print(f"  FAIL {f}")
+        if getattr(verdict, "postmortem", None):
+            print(f"  postmortem: {verdict.postmortem}")
     return 0 if verdict.passed else 1
 
 

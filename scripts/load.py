@@ -56,6 +56,20 @@ def _gate_overrides(spec, pairs):
     return replace(spec, gates=tuple(sorted(gates.items())))
 
 
+def _with_blackbox(spec, args):
+    """Arm the graft-blackbox recorder for a CLI run (on by default:
+    a failed judgment auto-produces a POSTMORTEM_*.json bundle in
+    --postmortem DIR; --no-postmortem reverts to the library default
+    of blackbox_enabled=0)."""
+    if getattr(args, "no_postmortem", False):
+        return spec
+    from dataclasses import replace
+
+    return replace(spec, config=tuple(spec.config) + (
+        ("blackbox_enabled", 1),
+        ("blackbox_dir", os.path.abspath(args.postmortem))))
+
+
 def _with_tmpdir(spec_store, fn):
     tmpdir = None
     try:
@@ -81,6 +95,13 @@ def main() -> int:
         p.add_argument("--gate", action="append", default=[],
                        metavar="NAME=VALUE",
                        help="override one SLO gate threshold")
+        if name in ("run", "ramp"):
+            p.add_argument("--postmortem", default=".", metavar="DIR",
+                           help="directory for triggered "
+                                "POSTMORTEM_*.json bundles (default .)")
+            p.add_argument("--no-postmortem", action="store_true",
+                           help="disable the flight recorder / "
+                                "postmortem bundles for this run")
         if name == "ramp":
             p.add_argument("--scales", default=None,
                            help="comma-separated rate multipliers "
@@ -91,6 +112,12 @@ def main() -> int:
     p.add_argument("--scenario", required=True)
     p.add_argument("--seed", type=int, default=1)
     p.add_argument("--json", action="store_true")
+    p.add_argument("--postmortem", default=".", metavar="DIR",
+                   help="directory for triggered POSTMORTEM_*.json "
+                        "bundles (default .)")
+    p.add_argument("--no-postmortem", action="store_true",
+                   help="disable the flight recorder / postmortem "
+                        "bundles for this run")
     p = sub.add_parser("report")
     p.add_argument("path", nargs="?", default=None,
                    help="LOAD_r*.json (default: latest)")
@@ -120,6 +147,9 @@ def main() -> int:
             print(f"unknown soak {args.scenario!r} "
                   f"(try: {', '.join(sorted(soaks))})", file=sys.stderr)
             return 2
+        from dataclasses import replace as _replace
+
+        sk = _replace(sk, load=_with_blackbox(sk.load, args))
         verdict = _with_tmpdir(sk.load.store, lambda tmpdir: asyncio.run(
             run_soak(sk, args.seed, tmpdir=tmpdir)))
         if args.json:
@@ -131,6 +161,8 @@ def main() -> int:
                   f"faults={verdict.counters})")
             for f in verdict.failures:
                 print(f"  FAIL {f}")
+            if verdict.postmortem:
+                print(f"  postmortem: {verdict.postmortem}")
         return 0 if verdict.passed else 1
 
     if args.cmd == "report":
@@ -172,13 +204,16 @@ def main() -> int:
         return 0
 
     if args.cmd == "run":
+        spec = _with_blackbox(spec, args)
         result, report = _with_tmpdir(
             spec.store, lambda tmpdir: asyncio.run(
                 run_load(spec, args.seed, tmpdir=tmpdir)))
         if args.json:
             print(json.dumps({"result": result.as_dict(),
                               "gates": report.as_rows(),
-                              "passed": report.passed}, indent=2))
+                              "passed": report.passed,
+                              "postmortem": report.postmortem},
+                             indent=2))
         else:
             print(f"load {spec.name} seed={args.seed}: "
                   f"{'ALL GATES PASS' if report.passed else 'GATE FAIL'} "
@@ -189,9 +224,12 @@ def main() -> int:
                 print(f"  {mark} {r['gate']:8s} value={r['value']} "
                       f"threshold={r['threshold']} [{r['source']}]"
                       + (f" {r['note']}" if r["note"] else ""))
+            if report.postmortem:
+                print(f"  postmortem: {report.postmortem}")
         return 0 if report.passed else 1
 
     # ramp
+    spec = _with_blackbox(spec, args)
     scales = tuple(float(s) for s in args.scales.split(",")) \
         if args.scales else rampmod.DEFAULT_SCALES
     doc = _with_tmpdir(spec.store, lambda tmpdir: asyncio.run(

@@ -304,6 +304,29 @@ def test_rpc_timeout_scoped_to_cluster():
     assert findings == []
 
 
+def test_scopes_cover_blackbox_modules():
+    """Scope pin (round 17): the task-spawn / swallowed-async-error /
+    rpc-timeout rules must keep the graft-blackbox modules in range —
+    the flight recorder feeds daemon hot paths and the postmortem
+    collector awaits admin commands across a possibly-dying cluster,
+    exactly the bug classes these rules exist for.  A scope refactor
+    that drops them would silently stop linting them."""
+    from ceph_tpu.analysis import async_errors, rpc_timeout, taskspawn
+
+    blackbox_files = [
+        "ceph_tpu/trace/flight.py",
+        "ceph_tpu/trace/postmortem.py",
+        # the trigger/bundle seams live in already-scoped packages —
+        # pinned too so the bundle path can't drift out of range
+        "ceph_tpu/cluster/vstart.py",
+        "ceph_tpu/load/driver.py",
+        "ceph_tpu/chaos/scenario.py",
+    ]
+    for mod in (taskspawn, async_errors, rpc_timeout):
+        for path in blackbox_files:
+            assert path.startswith(mod.SCOPE), (mod.RULE, path)
+
+
 def test_device_dispatch_good_clean():
     from ceph_tpu.analysis import device_dispatch
 

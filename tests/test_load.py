@@ -301,9 +301,18 @@ def test_mgr_scrape_carries_client_and_qos_counters():
         ctx = await LoadContext.create(spec, 5)
         try:
             await drive(ctx, spec, 5)
-            await asyncio.sleep(0.4)
-            text = await ctx.cluster.daemon_command(
-                "mgr", "prometheus metrics")
+            # converge-poll (round-13 deflake convention): wait until
+            # the heartbeat-carried client report actually landed on
+            # the mgr instead of sleeping a fixed beat
+            loop = asyncio.get_event_loop()
+            deadline = loop.time() + 10.0
+            text = ""
+            while loop.time() < deadline:
+                text = await ctx.cluster.daemon_command(
+                    "mgr", "prometheus metrics")
+                if 'ceph_client_cwnd{daemon="client.load0"}' in text:
+                    break
+                await asyncio.sleep(0.05)
         finally:
             await ctx.close()
         return text

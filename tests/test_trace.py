@@ -246,11 +246,16 @@ def test_loop_profiler_catches_a_stall_and_wraps_tasks():
     mon = LoopProfiler(perf, interval=0.01, prefix="loop")
 
     async def scenario():
-        sampler = asyncio.get_event_loop().create_task(mon.sample())
+        loop = asyncio.get_event_loop()
+        sampler = loop.create_task(mon.sample())
         try:
-            # let the sampler enter its sleep so the stall lands inside
-            # a measurement window
-            await asyncio.sleep(0.03)
+            # converge-poll (round-13 deflake convention): wait until
+            # the sampler has provably taken a sample, so the stall
+            # lands inside a measurement window
+            deadline = loop.time() + 5.0
+            while loop.time() < deadline and \
+                    perf.dump()["t"]["loop_lag"]["avgcount"] < 1:
+                await asyncio.sleep(0.005)
 
             async def stall():
                 # deliberate loop stall — the exact bug class the
@@ -259,7 +264,11 @@ def test_loop_profiler_catches_a_stall_and_wraps_tasks():
                 time.sleep(0.08)
 
             await mon.wrap(stall())
-            await asyncio.sleep(0.05)
+            # converge-poll until the sampler observed the stall (a
+            # fixed post-stall sleep flakes on a loaded host)
+            deadline = loop.time() + 5.0
+            while loop.time() < deadline and mon.window_max < 0.05:
+                await asyncio.sleep(0.005)
         finally:
             sampler.cancel()
 
