@@ -385,6 +385,7 @@ def bench_cluster_io(secs_write=4.0, secs_read=3.0, perf_dump=False,
             # in BASELINE_MEASURED.json is captured against
             config.osd_op_shards = 0
             config.osd_batch_tick_ops = 0
+            config.objecter_batch_tick_ops = 0
         if attribute:
             # every write of the timing window must stay in the history
             # ring to be attributable (4s at cluster_io rates is well

@@ -19,6 +19,29 @@ from ceph_tpu.cluster.pg import PGMETA, PGState, _coll
 from ceph_tpu.cluster.store import Transaction
 
 
+class _BatchConn:
+    """Reply router for ops that arrived inside an MOSDOpBatch (round
+    18): their MOSDOpReply acks coalesce through the OSD's
+    ClientReplyBatcher into MOSDOpReplyBatch ticks; every other send
+    (watch/notify pushes, map frames) forwards to the raw connection
+    untouched.  Only batch-arrived ops get batched replies — a plain
+    MOSDOp frame keeps its plain reply, which is what keeps
+    objecter_batch_tick_ops=0 a bit-exact legacy anchor."""
+
+    def __init__(self, osd, raw):
+        self._osd = osd
+        self._raw = raw
+
+    def __getattr__(self, name):
+        return getattr(self._raw, name)
+
+    async def send(self, reply):
+        if isinstance(reply, M.MOSDOpReply):
+            self._osd._reply_batcher.send(self._raw, reply)
+        else:
+            await self._raw.send(reply)
+
+
 class ClientOpsMixin:
 
     # ----------------------------------------------- admission control
@@ -305,6 +328,64 @@ class ClientOpsMixin:
         self.perf.set("osd_dispatch_queue_depth", self._queued_depth)
         if key not in self._ordered_active:
             self._spawn_drainer(key, q)
+
+    def _batch_conn(self, conn):
+        """The STABLE reply-routing wrapper for one client connection:
+        ordered-FIFO and dup-cache keys use (id(conn), pgid), so every
+        batch item from one connection must see the SAME wrapper object
+        across frames (a fresh wrapper per frame would fork per-PG
+        ordering).  Keyed by id() with an identity re-check, so a
+        recycled id after a reconnect can never serve a stale wrap."""
+        key = id(conn)
+        wrapped = self._batch_conns.get(key)
+        if wrapped is None or wrapped._raw is not conn:
+            wrapped = self._batch_conns[key] = _BatchConn(self, conn)
+        return wrapped
+
+    async def _handle_client_op_batch(self, conn, batch) -> None:
+        """Unpack one client tick's MOSDOpBatch: every item is a
+        complete MOSDOp, resolved/admitted/queued individually through
+        the very seam per-op frames use — the sharded WQ receives the
+        whole tick in ONE dispatch, so the EncodeBatcher's next tick
+        sees it pre-coalesced instead of dribbling in op-by-op.  Faults
+        stay per item (the SubWriteBatcher rule): a failing item
+        answers -5/-28 alone and its tick-mates proceed; a THROTTLED or
+        shed-expired item simply never joins the reply tick, leaving
+        only ITS client un-acked."""
+        self.perf.inc("osd_client_batch_frames")
+        self.perf.inc("osd_client_batch_items", len(batch.items))
+        # the messenger's recv hop stamped the FRAME, not the items:
+        # restamp each traced item here so its timeline's wire stage
+        # closes at unpack, exactly where a per-op frame's recv lands
+        now = time.time()
+        arrival = f"msgr:{self.messenger.name}:recv"
+        for msg in batch.items:
+            tr = getattr(msg, "trace", None)
+            if tr is not None:
+                tr.setdefault("events", []).append((arrival, now))
+        bconn = self._batch_conn(conn)
+        for msg in batch.items:
+            try:
+                await self._handle_client_op(bconn, msg)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # ms_dispatch's error contract, applied per ITEM: the
+                # failing op's client gets a prompt error, everyone
+                # else's dispatch continues
+                enospc = isinstance(e, OSError) and \
+                    getattr(e, "errno", 0) == 28
+                if enospc:
+                    self.perf.inc("osd_full_rejects")
+                else:
+                    self.perf.inc("osd_dispatch_errors")
+                    self.perf.inc("osd_client_batch_item_errors")
+                try:
+                    await bconn.send(M.MOSDOpReply(
+                        reqid=msg.reqid, result=-28 if enospc else -5,
+                        data=repr(e)))
+                except (ConnectionError, OSError, RuntimeError):
+                    pass
 
     def _spawn_drainer(self, key, q) -> None:
         """Mark the FIFO active and start its drain task, tracked in

@@ -186,6 +186,29 @@ class MOSDOpReply(Message):
 
 
 @dataclass
+class MOSDOpBatch(Message):
+    """A client tick's ops for ONE OSD in ONE frame (round 18): each
+    item is a complete MOSDOp, resolved/admitted per item on the OSD —
+    the client-edge twin of MOSDECSubOpWriteBatch.  Collapses the
+    per-op frame churn the objecter coalescer measured dominating the
+    saturation knee."""
+
+    items: List[Any] = field(default_factory=list)
+    epoch: int = 0
+
+
+@dataclass
+class MOSDOpReplyBatch(Message):
+    """A reply tick's acks for ONE client conn in ONE frame: each item
+    is a complete MOSDOpReply (result, data, epoch, throttled, and the
+    reply-leg trace all per item).  Ops the OSD SHED (expired deadline)
+    are absent — their clients must stay un-acked, exactly the
+    MOSDECSubOpWriteBatchReply per-item rule."""
+
+    items: List[Any] = field(default_factory=list)
+
+
+@dataclass
 class MCommand(Message):
     """Daemon-directed admin command (reference MCommand / the admin
     socket surface: 'ceph tell osd.N <cmd>')."""

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import pickle
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Set, Tuple
 
 from ceph_tpu.cluster import messages as M
 from ceph_tpu.cluster.messenger import (
@@ -26,6 +26,7 @@ from ceph_tpu.ops.jenkins import str_hash_rjenkins
 from ceph_tpu.osdmap.osdmap import OSDMap, PGid, ceph_stable_mod
 from ceph_tpu.utils import Config
 from ceph_tpu.utils.backoff import AIMDWindow, ExpBackoff
+from ceph_tpu.utils.tasks import track_task
 
 
 class Objecter(Dispatcher):
@@ -100,6 +101,22 @@ class Objecter(Dispatcher):
 
         self.flight = FlightRecorder.from_config(
             f"client.{self.display_name}", self.config)
+        # client-edge op coalescer (round 18): the objecter twin of the
+        # OSD's SubWriteBatcher.  Built unconditionally — the gate is
+        # consulted PER SEND (objecter_batch_tick_ops, injectargs-able),
+        # so 0 keeps the legacy one-frame-per-op anchor byte-for-byte.
+        from ceph_tpu.cluster.batcher import OpBatcher
+
+        self._tasks: Set[asyncio.Task] = set()
+        self._stopped = False
+        self._op_batcher = OpBatcher(self)
+        self._batch_ticks = 0
+        self._batch_tick_ops = 0
+        self._batch_reply_frames = 0
+        self._batch_reply_items = 0
+
+    def _track(self, task: asyncio.Task) -> None:
+        track_task(self._tasks, task)
 
     # -- client telemetry on the mgr Prometheus path (round 13) ------------
 
@@ -113,6 +130,10 @@ class Objecter(Dispatcher):
             "client_cwnd_pushbacks": self.cwnd.pushbacks,
             "client_inflight_ops": self._cwnd_inflight,
             "client_ops_acked": self._ops_acked,
+            "client_batch_ticks": self._batch_ticks,
+            "client_batch_ops": self._batch_tick_ops,
+            "client_batch_reply_frames": self._batch_reply_frames,
+            "client_batch_reply_items": self._batch_reply_items,
         }
 
     async def mgr_report(self) -> bool:
@@ -171,6 +192,13 @@ class Objecter(Dispatcher):
         await asyncio.wait_for(self._map_event.wait(), timeout=10)
 
     async def stop(self) -> None:
+        self._stopped = True
+        for t in list(self._tasks):
+            t.cancel()
+        if self._tasks:
+            # teardown barrier: cancelled batcher ticks fail their
+            # parked ops via the batcher's own finally (ConnectionError)
+            await asyncio.gather(*self._tasks, return_exceptions=True)  # graftlint: ignore[swallowed-async-error]
         await self.messenger.shutdown()
 
     async def ms_handle_reset(self, conn: Connection) -> None:
@@ -206,6 +234,22 @@ class Objecter(Dispatcher):
                     M.MMonSubscribe(what="osdmap",
                                     addr=self.messenger.my_addr,
                                     since=m.epoch if m else 0))
+            return True
+        if isinstance(msg, M.MOSDOpReplyBatch):
+            # scatter a reply tick per item: each MOSDOpReply inside
+            # resolves only ITS op's future — a reqid the OSD shed
+            # (expired deadline) is simply absent, so its future stays
+            # pending and the op's own timeout/resend covers it.  The
+            # SubWriteBatcher per-item rule, applied at the client edge;
+            # per-item `throttled` flags reach _op_submit_attempts
+            # unchanged, so AIMD pushback/ack stays per-op (one
+            # throttled item never collapses its tick-mates' window).
+            self._batch_reply_frames += 1
+            self._batch_reply_items += len(msg.items)
+            for item in msg.items:
+                fut = self._inflight.pop(tuple(item.reqid), None)
+                if fut and not fut.done():
+                    fut.set_result(item)
             return True
         if isinstance(msg, M.MOSDOpReply):
             fut = self._inflight.pop(tuple(msg.reqid), None)
@@ -378,6 +422,16 @@ class Objecter(Dispatcher):
         self._cwnd_inflight = max(0, self._cwnd_inflight - 1)
         self._cwnd_event.set()
 
+    async def _send_op(self, msg: M.MOSDOp, addr: Tuple) -> None:
+        """Route one op frame out: through the per-(session, OSD) tick
+        coalescer when client batching is on, else the legacy per-op
+        frame.  Gated per SEND so objecter_batch_tick_ops=0 is a live
+        anchor (injectargs mid-run flips the path for the next op)."""
+        if self.config.objecter_batch_tick_ops > 0:
+            await self._op_batcher.send(addr, msg)
+        else:
+            await self.messenger.send_message(msg, addr)
+
     async def _op_submit_attempts(self, pool_id, oid, ops, deadline,
                                   wall_deadline, explicit_pgid, trace_id,
                                   trace_events, root, snapc, snapid):
@@ -415,7 +469,7 @@ class Objecter(Dispatcher):
                     # under this client root
                     msg.trace["span"] = root.span_id
                 try:
-                    await self.messenger.send_message(msg, tuple(addr))
+                    await self._send_op(msg, tuple(addr))
                     # outwait the OSD's own replica-ack timeout (abandoning
                     # in parallel just queues a duplicate op behind the PG
                     # lock), but never past the op deadline — an ack past

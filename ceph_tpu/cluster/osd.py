@@ -235,7 +235,8 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         # per-tick stripe-batch coalescer + per-peer sub-write frame
         # batcher (cluster/batcher.py): EC writes ride both when
         # osd_batch_tick_ops > 0
-        from ceph_tpu.cluster.batcher import (EncodeBatcher,
+        from ceph_tpu.cluster.batcher import (ClientReplyBatcher,
+                                              EncodeBatcher,
                                               ReadBatcher,
                                               SubWriteBatcher)
 
@@ -244,6 +245,12 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         # read-side coalescer (round 16): per-tick decode / recovery
         # reencode / shard-crc verification batches — the decode twin
         self._read_batcher = ReadBatcher(self)
+        # client-edge reply coalescer (round 18): acks for ops that
+        # arrived inside an MOSDOpBatch leave as MOSDOpReplyBatch ticks;
+        # per-conn wrapper identity must be STABLE — the ordered-FIFO
+        # keys are (id(conn), pgid) — so batch conns are cached here
+        self._reply_batcher = ClientReplyBatcher(self)
+        self._batch_conns: Dict[int, object] = {}
         # (pgid, oid) pairs with an in-flight async read-repair, so a
         # storm of reads against one corrupt object arms ONE rebuild
         self._read_repairs_inflight: Set[Tuple] = set()
@@ -606,6 +613,9 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         if isinstance(msg, M.MOSDOp):
             await self._handle_client_op(conn, msg)
             return True
+        if isinstance(msg, M.MOSDOpBatch):
+            await self._handle_client_op_batch(conn, msg)
+            return True
         if isinstance(msg, M.MOSDRepOp):
             if self._sub_op_expired(msg):
                 # parent op's client deadline passed: the primary's
@@ -844,6 +854,32 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         self.perf.add_u64("osd_subwrite_batched_items",
                           desc="shard sub-writes that rode a "
                                "multi-item frame")
+        # client-edge batching (round 18): MOSDOpBatch ingest +
+        # MOSDOpReplyBatch egress — items/frames is the realized client
+        # batch factor, the edge twin of osd_batch_coalesced_ops
+        self.perf.add_u64("osd_client_batch_frames",
+                          prio=perfmod.PRIO_INTERESTING,
+                          desc="MOSDOpBatch frames received from "
+                               "client tick coalescers")
+        self.perf.add_u64("osd_client_batch_items",
+                          prio=perfmod.PRIO_INTERESTING,
+                          desc="client ops that arrived inside an "
+                               "MOSDOpBatch frame (items/frames = "
+                               "client batch factor)")
+        self.perf.add_u64("osd_client_batch_item_errors",
+                          desc="batch items that failed dispatch and "
+                               "were answered per item (-5/-28); their "
+                               "tick-mates were unaffected")
+        self.perf.add_u64("osd_client_batch_reply_frames",
+                          desc="MOSDOpReplyBatch frames sent (one per "
+                               "reply tick per client conn)")
+        self.perf.add_u64("osd_client_batch_reply_items",
+                          desc="client acks that rode a batched reply "
+                               "frame")
+        self.perf.add_u64("osd_client_batch_reply_drops",
+                          desc="batched reply items lost to a dead "
+                               "client conn (clients resend on "
+                               "timeout)")
         # crash-safe batched plane (round 12): frontier recovery +
         # batched-ack dedup telemetry
         self.perf.add_u64("osd_frontier_rebuilt",

@@ -430,6 +430,11 @@ def _fast_config() -> Config:
         # Config() zero-defaults remain the per-op bisection anchor
         osd_op_shards=2,
         osd_batch_tick_ops=16,
+        # client-edge batching (round 18): the objecter coalesces a
+        # tick's ops per (session, OSD) into MOSDOpBatch frames with
+        # batched replies; objecter_batch_tick_ops=0 stays the per-op
+        # frame anchor for bit-exactness and same-host A/B
+        objecter_batch_tick_ops=16,
     )
 
 

@@ -70,6 +70,14 @@ EVENT_STAGE = {
     # recv stamp to the caller actually resuming — event-loop wakeup,
     # previously the untraced slice of wall_coverage
     "objecter:complete": "client_wakeup",
+    # client-edge batching (round 18): an op parked at the objecter's
+    # per-(session, OSD) tick coalescer books queued-for-tick time
+    # (client_batch_wait) plus its AMORTIZED share of the tick's frame
+    # build/send (client_batch_send) — the client twin of
+    # batch_wait/batch_encode, so wall_coverage holds with
+    # objecter_batch_tick_ops > 0
+    "objecter:batch_tick": "client_batch_wait",
+    "objecter:batch_sent": "client_batch_send",
 }
 
 

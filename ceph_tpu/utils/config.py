@@ -140,6 +140,23 @@ OPTIONS: List[Option] = [
            "extra accumulation window (s) after a tick's first encode "
            "request; 0 = pure group-commit self-clocking (a lone op "
            "never waits)", min=0),
+    # client-edge op coalescing (round 18): the objecter twin of the
+    # OSD tick batchers.  Ops targeting the same OSD park in a
+    # per-(session, OSD) coalescer and ship as ONE MOSDOpBatch frame
+    # per tick; replies coalesce back as ONE MOSDOpReplyBatch per reply
+    # tick.  Per-item semantics are preserved end to end: a THROTTLED
+    # or shed item un-acks only itself and AIMD pushback/ack accounting
+    # stays per item.  0 = one MOSDOp frame + one reply per op — the
+    # legacy bit-exactness / same-host A/B anchor; vstart _fast_config
+    # turns it on.
+    Option("objecter_batch_tick_ops", int, 0,
+           "max client ops coalesced into ONE MOSDOpBatch frame per "
+           "(session, OSD) tick; a 1-op tick ships the plain legacy "
+           "MOSDOp frame.  0 = per-op frames (the anchor)", min=0),
+    Option("objecter_batch_tick_window", float, 0.0,
+           "extra accumulation window (s) after a client tick's first "
+           "parked op; 0 = pure group-commit self-clocking (a lone op "
+           "never waits)", min=0),
     # unified pipelined commit frontier (round 12): EC RMW and
     # replicated-pool mutations commit through the same split
     # commit-start (under the PG lock) / ack-wait (lock released)

@@ -222,8 +222,11 @@ def test_fast_config_enables_batched_data_plane():
 
     cfg = _fast_config()
     assert cfg.osd_op_shards > 0 and cfg.osd_batch_tick_ops > 0
+    # round 18: the client edge coalesces too — same anchor rule
+    assert cfg.objecter_batch_tick_ops > 0
     plain = Config()
     assert plain.osd_op_shards == 0 and plain.osd_batch_tick_ops == 0
+    assert plain.objecter_batch_tick_ops == 0
 
 
 # ---------------------------------------------------------- cluster level
@@ -353,6 +356,46 @@ def test_coalesced_writes_bit_exact_vs_per_op_path():
     assert set(batched) == set(serial)
     for key in sorted(serial):
         assert batched[key] == serial[key], key
+
+
+@contention_retry()
+def test_client_batched_frames_bit_exact_vs_per_op_frames():
+    """THE round-18 acceptance invariant: the SAME concurrent workload
+    through MOSDOpBatch client frames vs legacy per-op MOSDOp frames
+    (OSD-interior coalescing identical on both sides) leaves every
+    OSD's stored shards and CRCs byte-identical — mixed verbs
+    (write/RMW/append/truncate/delete), replicated + EC pools, and the
+    1-op-tick straggler included."""
+    async def run_path(client_batched: bool):
+        cfg = _fast_config()
+        if not client_batched:
+            # the anchor: per-op client frames, everything else equal
+            cfg.objecter_batch_tick_ops = 0
+        cluster = await start_cluster(5, config=cfg)
+        try:
+            client, pools = await _write_workload(
+                cluster, concurrent=True)
+            snap = _shard_snapshot(cluster, client, pools)
+            frames = sum(o.perf.get("osd_client_batch_frames")
+                         for o in cluster.osds.values())
+            items = sum(o.perf.get("osd_client_batch_items")
+                        for o in cluster.osds.values())
+            if client_batched:
+                # the workload really rode batched client frames
+                assert frames > 0 and items >= frames
+                assert client.objecter.flow_counters()[
+                    "client_batch_ticks"] > 0
+            else:
+                assert frames == 0 and items == 0
+            return snap
+        finally:
+            await cluster.stop()
+
+    batched = run(run_path(True))
+    anchor = run(run_path(False))
+    assert set(batched) == set(anchor)
+    for key in sorted(anchor):
+        assert batched[key] == anchor[key], key
 
 
 @contention_retry()

@@ -274,7 +274,17 @@ def test_client_misdirect_resend():
             victim = 0
             await cluster.kill_osd(victim)
             await cluster.wait_down(victim)
-            await asyncio.sleep(0.3)
+            # converge-poll (round 18 deflake): wait until every
+            # SURVIVING OSD's map marks the victim down — the remapped
+            # primary must know it owns the PG before the stale client
+            # retargets, and on a loaded host that propagation can
+            # outlive any fixed sleep
+            loop = asyncio.get_event_loop()
+            deadline = loop.time() + 10.0
+            while loop.time() < deadline and any(
+                    o.osdmap is None or o.osdmap.is_up(victim)
+                    for oid, o in cluster.osds.items() if oid != victim):
+                await asyncio.sleep(0.05)
             # ops keep succeeding despite the stale cached map (resend loop)
             await io.write_full("mis", b"second")
             assert await io.read("mis") == b"second"

@@ -327,6 +327,33 @@ def test_scopes_cover_blackbox_modules():
             assert path.startswith(mod.SCOPE), (mod.RULE, path)
 
 
+def test_scopes_cover_client_batcher_modules():
+    """Scope pin (round 18): the client-edge coalescer lives in the
+    objecter + cluster/batcher.py — the task-spawn /
+    swallowed-async-error / rpc-timeout rules must keep both in range
+    (the OpBatcher spawns per-(session, OSD) drain tasks and parks ops
+    on futures, exactly these rules' bug classes), and
+    per-op-device-dispatch must keep covering the modules feeding the
+    batch seam.  Zero new baseline entries is the round-18 contract:
+    the only sanctioned quiet zone stays cluster/batcher.py itself."""
+    from ceph_tpu.analysis import (async_errors, device_dispatch,
+                                   rpc_timeout, taskspawn)
+
+    client_batch_files = [
+        "ceph_tpu/cluster/objecter.py",
+        "ceph_tpu/cluster/batcher.py",
+        "ceph_tpu/cluster/client_ops.py",
+    ]
+    for mod in (taskspawn, async_errors, rpc_timeout):
+        for path in client_batch_files:
+            assert path.startswith(mod.SCOPE), (mod.RULE, path)
+    # per-op-device-dispatch scopes to cluster/ with batcher.py as the
+    # one sanctioned coalescer seam — pin both halves of that contract
+    for path in client_batch_files:
+        assert path.startswith("ceph_tpu/cluster/"), path
+    assert device_dispatch.COALESCER == "ceph_tpu/cluster/batcher.py"
+
+
 def test_device_dispatch_good_clean():
     from ceph_tpu.analysis import device_dispatch
 
