@@ -36,11 +36,16 @@ RULE = "per-op-device-dispatch"
 
 # device entry points of the EC data plane: planar layout transforms,
 # batch encode/decode dispatches, and the batched crc kernels
+# (round 19 widened the set with the planar-at-rest multi entry points
+# and the plane-major crc batch — the at-rest format must not become a
+# license to hand-roll per-op dispatches outside the coalescer)
 DEVICE_CALLS = frozenset({
     "to_planar", "encode_planar", "decode_planar",
     "encode_batch", "decode_batch",
     "encode_stripes", "decode_stripes", "reencode_stripes",
     "encode_stripes_multi", "crc32c_batch", "crc32c_rows",
+    "encode_planes_multi", "decode_planes_multi",
+    "reencode_planes_multi", "crc32c_planar_rows",
 })
 
 # the one sanctioned per-op dispatch seam: the tick coalescer

@@ -193,11 +193,11 @@ class LintContext:
 def all_rules():
     """The registered rule families, import-cycle-free."""
     from ceph_tpu.analysis import async_errors, asyncio_rules, \
-        device_dispatch, jax_hygiene, lockgraph, rpc_timeout, \
-        symmetry, taskspawn
+        device_dispatch, jax_hygiene, lockgraph, planar_hygiene, \
+        rpc_timeout, symmetry, taskspawn
 
     return [lockgraph, jax_hygiene, symmetry, asyncio_rules, taskspawn,
-            rpc_timeout, device_dispatch, async_errors]
+            rpc_timeout, device_dispatch, async_errors, planar_hygiene]
 
 
 # cached last report (admin socket `graftlint report` serves this)

@@ -14,6 +14,7 @@ from ceph_tpu.cluster.pglog import LogEntry
 from ceph_tpu.crush.types import CRUSH_ITEM_NONE
 from ceph_tpu.cluster.pg import PGRB, PGState, _coll
 from ceph_tpu.cluster.store import Transaction
+from ceph_tpu.ec import planar_store
 from ceph_tpu.ops import crc32c as crcmod
 from ceph_tpu.osdmap.osdmap import PGid, PGPool
 
@@ -139,6 +140,18 @@ class ECBackendMixin:
             "stripe_unit", self.config.osd_ec_stripe_unit))
         return StripeInfo(codec.get_data_chunk_count(), unit)
 
+    def _planar_mode(self, codec, sinfo) -> bool:
+        """Bit-planar AT-REST gate (round 19): config on AND the codec/
+        stripe geometry supports conversion-free plane-domain compute
+        (w=8 matrix codec, unit % 8 == 0).  Unsupported geometries
+        quietly stay byte-at-rest — the gate never changes what bytes a
+        client sees, only how shards are laid out."""
+        if not self.config.osd_ec_planar_at_rest:
+            return False
+        from ceph_tpu.ec import stripe as stripemod
+
+        return stripemod.planar_at_rest_ok(codec, sinfo.chunk_size)
+
     # ----------------------------------------------------------- EC backend
     #
     # Objects are striped (ECUtil::stripe_info_t math, ceph_tpu.ec.stripe):
@@ -187,15 +200,16 @@ class ECBackendMixin:
         sinfo = self._sinfo(pool, codec)
         if not self._ec_acting_writeable(pool, codec, st):
             return -11  # retry after the map heals; no encode burned
-        shards, crcs, new_size, chunk_off = await self._ec_prepare_write(
-            pool, st, oid, data, offset, codec, sinfo)
+        shards, crcs, new_size, chunk_off, layout = \
+            await self._ec_prepare_write(
+                pool, st, oid, data, offset, codec, sinfo)
         if offset is not None:
             self.perf.inc("osd_rmw_pipelined")
         try:
             async with st.lock:
                 return await self._ec_commit_start(
                     pool, st, oid, new_size, shards, crcs, snapc,
-                    codec, sinfo, chunk_off=chunk_off)
+                    codec, sinfo, chunk_off=chunk_off, layout=layout)
         except ECUndersized:
             return -11
 
@@ -250,12 +264,13 @@ class ECBackendMixin:
         sinfo = self._sinfo(pool, codec)
         if not self._ec_acting_writeable(pool, codec, st):
             return -11
-        shards, crcs, new_size, chunk_off = await self._ec_prepare_write(
-            pool, st, oid, data, offset, codec, sinfo)
+        shards, crcs, new_size, chunk_off, layout = \
+            await self._ec_prepare_write(
+                pool, st, oid, data, offset, codec, sinfo)
         try:
             token = await self._ec_commit_start(
                 pool, st, oid, new_size, shards, crcs, snapc, codec,
-                sinfo, chunk_off=chunk_off)
+                sinfo, chunk_off=chunk_off, layout=layout)
         except ECUndersized:
             return -11
         return await self._ec_commit_finish(st, token)
@@ -265,24 +280,28 @@ class ECBackendMixin:
                                 offset: Optional[int], codec, sinfo):
         """The pure-compute half of an EC write: RMW read-merge (when
         offset is given) + coalesced encode.  Returns ``(shards, crcs,
-        new_size, chunk_off)``.  Shared verbatim by the serial and
-        pipelined paths so the two stay bit-identical by construction
-        (the tier-1 exactness gate compares their stored bytes)."""
+        new_size, chunk_off, layout)``.  Shared verbatim by the serial
+        and pipelined paths so the two stay bit-identical by
+        construction (the tier-1 exactness gate compares their stored
+        bytes).  In planar mode the RMW read-half books the sanctioned
+        egress (inside the read coalescer) and the re-encode books the
+        sanctioned ingest — the merge itself is logical bytes, which
+        is the CLIENT's layout, not a shard layout conversion."""
         from ceph_tpu.ec import stripe as stripemod
 
         coll = _coll(st.pgid)
         if offset is None:
             # write_full: replace the object — a full-shard rewrite, so
             # the coalesced tick also batch-computes the shard crcs
-            shards, crcs = await self._encode_for_write(
+            shards, crcs, layout = await self._encode_for_write(
                 codec, sinfo, data, want_crc=True)
-            return shards, crcs, len(data), 0
+            return shards, crcs, len(data), 0, layout
         sa = self.store.getattr(coll, oid, "size")
         if sa is None:
             # no local shard (lost, or never held): the committed
             # size must come from the acting set — merging against
             # an assumed-empty object would truncate committed bytes
-            _, old_size, _ = await self._gather_shards(
+            _, old_size, _, _ = await self._gather_shards(
                 pool, st, oid, codec.get_data_chunk_count(), 0, 0)
         else:
             old_size = int(sa)
@@ -312,18 +331,24 @@ class ECBackendMixin:
         new_size = max(old_size, offset + len(data))
         # RMW touches a sub-range: the replica-side mid-shard crc
         # merge stays local, so no batch crc here
-        shards, crcs = await self._encode_for_write(
+        shards, crcs, layout = await self._encode_for_write(
             codec, sinfo, merged, want_crc=False)
-        return shards, crcs, new_size, chunk_off
+        return shards, crcs, new_size, chunk_off, layout
 
     async def _ec_commit_start(self, pool: PGPool, st: PGState, oid: str,
                                new_size: int, shards, crcs, snapc,
-                               codec, sinfo, chunk_off: int = 0):
+                               codec, sinfo, chunk_off: int = 0,
+                               layout: Optional[str] = None):
         """Ordered commit section of an EC write (runs under st.lock):
         version assignment + frontier registration, local shard apply,
         log append, and the sub-write fan-out SENDS — everything whose
         PG-wide order must match the version order.  Returns the token
-        ``_ec_commit_finish`` resolves outside the lock."""
+        ``_ec_commit_finish`` resolves outside the lock.
+
+        ``layout`` == "planar8" means ``shards[i]`` is an (8, cols)
+        AT-REST plane matrix: tobytes() serializes it row-major — the
+        same bytes that land in the store and ride the wire, so the
+        commit path is conversion-free end to end (round 19)."""
         from ceph_tpu.cluster.optracker import mark_current
 
         # re-checked UNDER the lock: the acting set can shrink during
@@ -367,7 +392,7 @@ class ECBackendMixin:
                 self._apply_shard(st.pgid, oid, my_shard,
                                   shards[my_shard].tobytes(), chunk_off,
                                   shard_size, hinfo_for(my_shard),
-                                  pre_ops=pre_ops)
+                                  pre_ops=pre_ops, layout=layout)
                 mark_current("store:journal_queued")
             entry = self._log_mutation(st, "modify", oid, eversion)
             self._chaos_point("commit_pre_fanout")
@@ -394,7 +419,8 @@ class ECBackendMixin:
                         entry=entry,
                         pre_ops=pre_ops,
                         epoch=self.osdmap.epoch,
-                        deadline=sub_deadline)
+                        deadline=sub_deadline,
+                        layout=layout)
                     if subctx is not None:
                         sub.trace = dict(subctx)
                     subs.append((osd, sub))
@@ -489,7 +515,8 @@ class ECBackendMixin:
 
     async def _encode_for_write(self, codec, sinfo, data: bytes,
                                 want_crc: bool):
-        """Encode one op's stripe range -> (shards, crcs-or-None).
+        """Encode one op's stripe range -> (shards, crcs-or-None,
+        layout).
 
         With ``osd_batch_tick_ops`` > 0 the encode rides the per-tick
         coalescer (cluster/batcher.py): every same-profile write in the
@@ -498,14 +525,21 @@ class ECBackendMixin:
         stages — ``batch_wait`` (parked awaiting its tick) and
         ``batch_encode`` (its amortized share of the coalesced
         dispatch).  At 0 this is exactly the round-10 per-op dispatch.
-        """
+
+        Round 19 (planar at rest): when the gate is on, the tick runs
+        ``encode_planes_multi`` and the returned shards are (n, 8,
+        cols) AT-REST plane matrices with plane-major crcs —
+        layout == "planar8" tells the commit path to land and ship
+        them as planes (store txn write_planar, wire layout field)."""
         from ceph_tpu.cluster.optracker import CURRENT_OP, mark_current
 
+        planar = self._planar_mode(codec, sinfo)
+        layout = planar_store.LAYOUT_PLANAR if planar else None
         if self.config.osd_batch_tick_ops > 0:
             mark_current("batch_parked")
             shards, crcs, (t0, t1, batch_n) = \
                 await self._ec_batcher.encode(codec, sinfo, data,
-                                              want_crc)
+                                              want_crc, planar=planar)
             op = CURRENT_OP.get()
             if op is not None:
                 # amortized attribution: this op's share of the tick's
@@ -514,22 +548,36 @@ class ECBackendMixin:
                 share = (t1 - t0) / max(batch_n, 1)
                 op.mark_at("batch_tick", t1 - share)
                 op.mark_at("batch_encoded", t1)
-            return shards, crcs
+            if planar:
+                # the tick's client-bytes -> planes hop was this op's
+                # one sanctioned ingest conversion — stamp it so
+                # `bench.py --attribute` books it as planar_convert
+                mark_current("planar_ingest")
+            return shards, crcs, layout
         mark_current("ec_encode")
         # round 16: even the per-op anchor dispatches through the
         # sanctioned coalescer module (batcher.encode_once) — zero
         # device entry points on cluster/ op paths outside that seam
-        shards = await self._ec_batcher.encode_once(codec, sinfo, data)
-        mark_current("ec_encoded")
-        return shards, None
+        shards = await self._ec_batcher.encode_once(codec, sinfo, data,
+                                                    planar=planar)
+        mark_current("planar_ingest" if planar else "ec_encoded")
+        return shards, None, layout
 
     def _apply_shard(self, pgid: PGid, oid: str, shard: int, data: bytes,
                      chunk_off: int, shard_size: int, hinfo: Dict,
-                     pre_ops: Optional[List[Tuple]] = None) -> None:
+                     pre_ops: Optional[List[Tuple]] = None,
+                     layout: Optional[str] = None) -> None:
         """Apply a shard sub-range write with its crc in ONE atomic
         transaction (ECUtil::HashInfo analog, reference ECUtil.h:105-163:
         the crc is CUMULATIVE for appends/full rewrites — no whole-shard
-        re-read on the hot path — and data+crc can never disagree)."""
+        re-read on the hot path — and data+crc can never disagree).
+
+        ``layout`` == "planar8" routes to the planar-at-rest twin: the
+        payload is a plane window, not shard bytes (round 19)."""
+        if layout == planar_store.LAYOUT_PLANAR:
+            self._apply_shard_planar(pgid, oid, shard, data, chunk_off,
+                                     shard_size, hinfo, pre_ops)
+            return
         coll = _coll(pgid)
         old_size = self.store.stat(coll, oid)
         if chunk_off == 0 and len(data) >= shard_size:
@@ -587,6 +635,105 @@ class ECBackendMixin:
            .set_version(coll, oid, hinfo["version"])
         self.store.queue_transaction(txn)
 
+    def _apply_shard_planar(self, pgid: PGid, oid: str, shard: int,
+                            data: bytes, chunk_off: int, shard_size: int,
+                            hinfo: Dict,
+                            pre_ops: Optional[List[Tuple]] = None) -> None:
+        """Planar-at-rest twin of ``_apply_shard`` (round 19): ``data``
+        is an (8, cols) plane window serialized row-major — the SAME
+        bytes the encode produced and the wire carried — and it lands
+        via the store's ``write_planar`` op without ever materializing
+        the byte view.  The cumulative hinfo crc stays bit-identical to
+        the byte anchor because crc32c over plane-major rows uses the
+        column-spread identity (ops/crc32c.crc32c_planar_rows), so
+        verify-on-read and scrub agree across mixed-layout members."""
+        coll = _coll(pgid)
+        Q = planar_store.QUANTUM
+        if chunk_off % Q or len(data) % Q:
+            raise ValueError(f"{oid}: unaligned planar sub-write "
+                             f"(off={chunk_off}, len={len(data)})")
+        old_size = self.store.stat(coll, oid)
+        old_layout = self.store.object_layout(coll, oid)
+        cols = shard_size // Q
+        col_off = chunk_off // Q
+        window = planar_store.blob_to_planes(data)
+        if col_off + window.shape[1] > cols:
+            # window overshoots the final shard (byte path: write then
+            # truncate) — clip COLUMNS, not blob bytes: the serialized
+            # form is row-major so a byte-level cut would shear rows
+            window = window[:, :cols - col_off]
+            data = planar_store.planes_to_blob(window)
+        if chunk_off == 0 and window.shape[1] >= cols:
+            # full-shard rewrite: the tick's batch-computed plane-major
+            # crc when the primary shipped one; else one host pass here
+            crc = hinfo.get("crc")
+            if crc is None:
+                crc = crcmod.crc32c_planar_rows(window)[0]
+        elif old_size is not None and chunk_off == old_size and \
+                shard_size == chunk_off + len(data) and \
+                self.store.getattr(coll, oid, "hinfo_crc") is not None:
+            # append: combine the stored cumulative crc with the delta
+            # window's crc (GF(2) zero-extension) — no whole-shard pass,
+            # and the delta crc comes straight off the planes
+            stored = int(self.store.getattr(coll, oid, "hinfo_crc"))
+            crc = crcmod.crc32c_combine(
+                stored, crcmod.crc32c_planar_rows(window, seed=0)[0],
+                len(data))
+        else:
+            # true mid-shard RMW (or no stored crc): splice the window
+            # into the old plane matrix and crc the merge — plane-major
+            # throughout, zero byte-view materializations
+            old = None
+            if old_size is not None:
+                if old_layout == planar_store.LAYOUT_PLANAR:
+                    old = planar_store.blob_to_planes(
+                        self.store.read_planar(coll, oid))
+                else:
+                    # byte-at-rest pre-state meeting a planar write: the
+                    # one legal relayout hop — the STORE books it when
+                    # the write_planar op lands, so seam=None here
+                    raw = bytes(self.store.read(coll, oid))
+                    if len(raw) % Q:
+                        raw += b"\0" * (Q - len(raw) % Q)
+                    old = planar_store.shard_to_planes(raw, seam=None)
+            merged = planar_store.splice_columns(old, col_off, window,
+                                                 cols)
+            crc = crcmod.crc32c_planar_rows(merged)[0]
+        txn = Transaction()
+        if pre_ops:
+            txn.ops.extend(tuple(op) for op in pre_ops)
+        # rollback record: planar pre-state is captured WHOLE-OBJECT as
+        # the raw stored blob (plane-major for planar members, logical
+        # bytes for a byte-at-rest pre-state) so the peering rewind can
+        # restore it without any layout conversion — rec["layout"]
+        # tells pg.rewind_divergent_log which restore op to emit
+        existed = old_size is not None
+        if existed and old_layout == planar_store.LAYOUT_PLANAR:
+            old_range = self.store.read_planar(coll, oid)
+        elif existed:
+            old_range = bytes(self.store.read(coll, oid))
+        else:
+            old_range = b""
+        rec = {
+            "oid": oid, "existed": existed, "chunk_off": 0,
+            "old_range": old_range,
+            "old_total": old_size or 0,
+            "layout": old_layout,
+            "old_attrs": {k: self.store.getattr(coll, oid, k)
+                          for k in ("shard", "size", "hinfo_crc")},
+            "old_version": self.store.get_version(coll, oid),
+        }
+        txn.omap_set(coll, PGRB,
+                     {self._rb_key(hinfo["version"]): pickle.dumps(rec)})
+        # ONE op covers the byte path's write+truncate pair: total_cols
+        # pins the final shard extent, so no separate truncate
+        txn.write_planar(coll, oid, col_off, data, cols) \
+           .setattr(coll, oid, "shard", str(shard).encode()) \
+           .setattr(coll, oid, "size", str(hinfo["size"]).encode()) \
+           .setattr(coll, oid, "hinfo_crc", str(crc).encode()) \
+           .set_version(coll, oid, hinfo["version"])
+        self.store.queue_transaction(txn)
+
     def _apply_ec_sub_write(self, msg: M.MOSDECSubOpWrite) -> None:
         """Apply one shard sub-write (store txn + log) — the shared
         core of the single-frame and batched handlers."""
@@ -601,7 +748,8 @@ class ECBackendMixin:
                 else msg.chunk_off + len(msg.data)
             self._apply_shard(msg.pgid, msg.oid, msg.shard, msg.data,
                               msg.chunk_off, shard_size, msg.hinfo,
-                              pre_ops=msg.pre_ops)
+                              pre_ops=msg.pre_ops,
+                              layout=getattr(msg, "layout", None))
             st = self.pgs.get(msg.pgid)
             if st is not None and msg.entry is not None:
                 self._log_mutation(st, msg.entry.op, msg.entry.oid,
@@ -656,8 +804,16 @@ class ECBackendMixin:
         if self._sub_op_expired(msg):
             return  # nobody awaits: shed instead of burning device time
         coll = _coll(msg.pgid)
+        # round 19: a planar-at-rest shard is read, verified, sliced and
+        # SHIPPED as its plane matrix — zero layout conversions on this
+        # holder (whole-object pulls, shard == -1, stay on bytes: they
+        # come from the replicated pull path, which stores bytes)
+        planar = (msg.shard != -1 and
+                  self.store.object_layout(coll, msg.oid)
+                  == planar_store.LAYOUT_PLANAR)
         try:
-            full = self.store.read(coll, msg.oid)
+            full = (self.store.read_planar(coll, msg.oid) if planar
+                    else self.store.read(coll, msg.oid))
         except FileNotFoundError:
             await self._reply_osd(conn, msg, M.MOSDECSubOpReadReply(
                 reqid=msg.reqid, result=-2, shard=msg.shard))
@@ -673,17 +829,41 @@ class ECBackendMixin:
         # verify-on-read (round 16, default on): the shard crc checks
         # against the stored hinfo before any byte leaves this holder
         # (ecbackend.rst:86-99); concurrent sub-reads on this daemon
-        # share one crc32c batch through the read coalescer
+        # share one crc32c batch through the read coalescer — planar
+        # shards verify over plane-major rows via the spread identity,
+        # bit-identical to the byte anchor's cumulative crc
         if stored_crc is not None and self.config.osd_ec_verify_reads:
             [ok] = await self._read_batcher.verify([full],
-                                                   [int(stored_crc)])
+                                                   [int(stored_crc)],
+                                                   planar=planar)
             if not ok:
                 self.perf.inc("osd_read_shard_crc_errors")
                 await self._reply_osd(conn, msg, M.MOSDECSubOpReadReply(
                     reqid=msg.reqid, result=-5, shard=msg.shard))
                 return
-        data = full[msg.off: msg.off + msg.length] \
-            if msg.length is not None else full[msg.off:]
+        out_layout = None
+        if planar:
+            Q = planar_store.QUANTUM
+            if msg.off % Q == 0 and (msg.length is None
+                                     or msg.length % Q == 0):
+                # sub-range by COLUMN slice of the plane matrix — every
+                # chunk-aligned gather lands here (unit % 8 == 0 gates
+                # planar mode, so chunk offsets are always 8-aligned)
+                planes = planar_store.blob_to_planes(full)
+                hi = (msg.off + msg.length) // Q \
+                    if msg.length is not None else None
+                data = planar_store.planes_to_blob(
+                    planes[:, msg.off // Q: hi])
+                out_layout = planar_store.LAYOUT_PLANAR
+            else:
+                # unaligned range: correctness-only byte fallback (books
+                # the unseamed counter; never hit by aligned gathers)
+                full = self.store.read(coll, msg.oid)
+                data = full[msg.off: msg.off + msg.length] \
+                    if msg.length is not None else full[msg.off:]
+        else:
+            data = full[msg.off: msg.off + msg.length] \
+                if msg.length is not None else full[msg.off:]
         shard_attr = self.store.getattr(coll, msg.oid, "shard")
         shard = int(shard_attr) if shard_attr else msg.shard
         size = self.store.getattr(coll, msg.oid, "size")
@@ -698,7 +878,7 @@ class ECBackendMixin:
                 coll, msg.oid))
         await self._reply_osd(conn, msg, M.MOSDECSubOpReadReply(
             reqid=msg.reqid, result=0, shard=shard, data=data,
-            hinfo=hinfo))
+            hinfo=hinfo, layout=out_layout))
         self.perf.inc("osd_ec_sub_reads")
 
     def _hedge_delay(self) -> float:
@@ -798,7 +978,7 @@ class ECBackendMixin:
         off: int = 0, length: Optional[int] = None,
         exclude_shards: Optional[Set[int]] = None,
         fast_k: bool = False,
-    ) -> Tuple[Dict[int, bytes], int, int]:
+    ) -> Tuple[Dict[int, bytes], int, int, Dict[int, Optional[str]]]:
         """Collect >= k shard (ranges) from the acting set (own shard
         free).  ``exclude_shards``: shard ids known corrupt — they must
         never be decode sources (scrub repair would otherwise reconstruct
@@ -806,6 +986,12 @@ class ECBackendMixin:
         client reads — contact only the first k shard holders, resolve
         on the first k clean same-generation shards, and hedge/promote
         stragglers instead of gathering the full group.
+
+        Round 19: the 4th return maps each CHOSEN shard id to the
+        layout its payload arrived in (``"planar8"`` plane matrices
+        from planar-at-rest holders, None for byte ranges) — payload
+        lengths are identical either way, so the generation grouping
+        and size checks below are layout-blind.
 
         Round 16 (verified reads): the LOCAL shard's crc checks against
         its stored hinfo before it may feed a decode (riding the read
@@ -817,20 +1003,30 @@ class ECBackendMixin:
         coll = _coll(st.pgid)
         # shard id -> why it needs repair ("crc" | "eio" | "stale")
         repair: Dict[int, str] = {}
-        # (shard -> (bytes, version, size)): versions gate which shards
-        # may decode together — a stale rejoined member's shard from an
-        # older generation mixed with current shards would decode to
-        # garbage (the reference compares per-shard object_info versions
-        # when gathering, ECBackend::handle_sub_read_reply)
-        got: Dict[int, Tuple[bytes, int, int]] = {}
+        # (shard -> (bytes, version, size, layout)): versions gate which
+        # shards may decode together — a stale rejoined member's shard
+        # from an older generation mixed with current shards would
+        # decode to garbage (the reference compares per-shard
+        # object_info versions when gathering,
+        # ECBackend::handle_sub_read_reply)
+        got: Dict[int, Tuple[bytes, int, int, Optional[str]]] = {}
         my = self.store.stat(coll, oid)
         if my is not None:
             shard_attr = self.store.getattr(coll, oid, "shard")
             local_shard = int(shard_attr) if shard_attr is not None \
                 else None
+            Q = planar_store.QUANTUM
+            # planar-at-rest local shard with an aligned range: read
+            # the plane blob, verify plane-major, slice COLUMNS — the
+            # byte view is never materialized (round 19)
+            lp = (self.store.object_layout(coll, oid)
+                  == planar_store.LAYOUT_PLANAR and off % Q == 0
+                  and (length is None or length % Q == 0))
             data = full = None
             try:
-                if self.config.osd_ec_verify_reads:
+                if lp:
+                    full = self.store.read_planar(coll, oid)
+                elif self.config.osd_ec_verify_reads:
                     # the cumulative crc covers the WHOLE shard: read
                     # it all, verify, then slice the requested range
                     full = self.store.read(coll, oid)
@@ -848,12 +1044,20 @@ class ECBackendMixin:
             if full is not None:
                 stored = self.store.getattr(coll, oid, "hinfo_crc")
                 ok = True
-                if stored is not None:
+                if stored is not None and \
+                        self.config.osd_ec_verify_reads:
                     [ok] = await self._read_batcher.verify(
-                        [full], [int(stored)])
+                        [full], [int(stored)], planar=lp)
                 if ok:
-                    data = full[off:] if length is None \
-                        else full[off: off + length]
+                    if lp:
+                        planes = planar_store.blob_to_planes(full)
+                        hi = (off + length) // Q \
+                            if length is not None else None
+                        data = planar_store.planes_to_blob(
+                            planes[:, off // Q: hi])
+                    else:
+                        data = full[off:] if length is None \
+                            else full[off: off + length]
                 else:
                     self.perf.inc("osd_read_shard_crc_errors")
                     if local_shard is not None:
@@ -865,7 +1069,8 @@ class ECBackendMixin:
                 got[local_shard] = (
                     data,
                     self.store.get_version(coll, oid),
-                    int(sa) if sa else 0)
+                    int(sa) if sa else 0,
+                    planar_store.LAYOUT_PLANAR if lp else None)
         committed_seq = st.last_complete[1]
 
         def _committed(v: int) -> bool:
@@ -903,7 +1108,7 @@ class ECBackendMixin:
                     watermark — pinned to the logged generation when
                     the log knows it."""
                     byver: Dict[int, set] = {}
-                    for s, (_d, v, _sz) in _local.items():
+                    for s, (_d, v, _sz, _ly) in _local.items():
                         byver.setdefault(v, set()).add(s)
                     for result, reply in acc:
                         if result == 0 and reply is not None:
@@ -940,7 +1145,8 @@ class ECBackendMixin:
                     got[reply.shard] = (
                         reply.data,
                         reply.hinfo.get("version", 0),
-                        reply.hinfo.get("size", 0))
+                        reply.hinfo.get("size", 0),
+                        getattr(reply, "layout", None))
                 elif result == -5 and reply is not None and \
                         reply.shard >= 0:
                     # the holder found its shard corrupt (crc) or
@@ -951,8 +1157,11 @@ class ECBackendMixin:
             # staleness judged against the START-of-gather watermark
             # snapshot: a write committing mid-gather must not flag
             # members whose replies simply predate their own apply
+            # (choose_decode_group stays the layout-blind 3-tuple pure
+            # function the corruption-matrix tests drive directly)
             shards, size, version, stale = choose_decode_group(
-                got, need_k, _committed,
+                {s: (d, v, sz) for s, (d, v, sz, _ly) in got.items()},
+                need_k, _committed,
                 committed_before=lambda v: v <= committed_seq)
         except IOError as e:
             raise IOError(f"{oid}: {e}") from None
@@ -960,7 +1169,8 @@ class ECBackendMixin:
             repair.setdefault(s, "stale")
         if repair:
             self._queue_read_repair(pool, st, oid, repair)
-        return shards, size, version
+        layouts = {s: got[s][3] for s in shards}
+        return shards, size, version, layouts
 
     def _queue_read_repair(self, pool: PGPool, st: PGState, oid: str,
                            bad: Dict[int, str]) -> None:
@@ -1025,6 +1235,8 @@ class ECBackendMixin:
         so the caller re-ranges against the group's size."""
         import numpy as np
 
+        from ceph_tpu.cluster.optracker import mark_current
+
         codec = self._codec(pool)
         sinfo = self._sinfo(pool, codec)
         k = codec.get_data_chunk_count()
@@ -1032,21 +1244,49 @@ class ECBackendMixin:
         chunk_len = nstripes * sinfo.chunk_size
         # degraded-mode client read: first k clean shards decode, a
         # slow/dead holder is hedged/promoted instead of awaited
-        shards, gsize, _ = await self._gather_shards(
+        shards, gsize, _, layouts = await self._gather_shards(
             pool, st, oid, k, off=chunk_off, length=chunk_len,
             fast_k=True)
         if expected_size is not None and shards and gsize != expected_size:
             raise ECSizeMismatch(gsize)
-        avail = {s: np.frombuffer(d, dtype=np.uint8)
-                 for s, d in shards.items()
-                 if len(d) == chunk_len}
+        planar = self._planar_mode(codec, sinfo)
+        avail = {}
+        for s, d in shards.items():
+            if len(d) != chunk_len:
+                continue
+            shard_planar = layouts.get(s) == planar_store.LAYOUT_PLANAR
+            if planar:
+                # steady state: the holder shipped planes and the
+                # decode consumes planes — blob_to_planes is a reshape,
+                # not a conversion.  A byte reply (mixed-generation
+                # member still byte-at-rest) takes the one legal
+                # relayout hop on the gather edge.
+                avail[s] = planar_store.blob_to_planes(d) \
+                    if shard_planar \
+                    else planar_store.shard_to_planes(d, seam="relayout")
+            else:
+                if shard_planar:
+                    # byte-mode decode of a still-planar holder's reply
+                    # (gate just flipped off): normalize — legal, never
+                    # on the pinned steady-state path
+                    d = planar_store.planes_to_shard(
+                        planar_store.blob_to_planes(d), seam="relayout")
+                avail[s] = np.frombuffer(d, dtype=np.uint8)
         if len(avail) < k:
             raise IOError(
                 f"only {len(avail)} of {k} shard ranges for {oid}")
         # round 16: the decode rides the read coalescer — a tick's read
         # gathers share one layout conversion + one fused decode batch
-        return await self._read_batcher.decode(
-            codec, sinfo, avail, logical_len)
+        # (round 19 planar: NO layout conversion — the fused kernel
+        # consumes the at-rest planes as-shipped)
+        out = await self._read_batcher.decode(
+            codec, sinfo, avail, logical_len, planar=planar)
+        if planar:
+            # the assemble's planes -> logical-bytes hop was this op's
+            # one sanctioned egress conversion — stamp it so
+            # `bench.py --attribute` books it as planar_convert
+            mark_current("planar_egress")
+        return out
 
     async def _ec_read(self, pool: PGPool, st: PGState, oid: str,
                        offset: int = 0, length: Optional[int] = None) -> bytes:
@@ -1057,7 +1297,7 @@ class ECBackendMixin:
         if sa is None:
             # primary lost its shard (or never had one): probe peers
             codec = self._codec(pool)
-            shards, size, _ = await self._gather_shards(
+            shards, size, _, _ = await self._gather_shards(
                 pool, st, oid, codec.get_data_chunk_count(), 0, 0)
             if not shards and size == 0:
                 raise FileNotFoundError(oid)
@@ -1104,11 +1344,28 @@ class ECBackendMixin:
         codec = self._codec(pool)
         sinfo = self._sinfo(pool, codec)
         k = codec.get_data_chunk_count()
-        shards, size, group_version = await self._gather_shards(
+        shards, size, group_version, layouts = await self._gather_shards(
             pool, st, oid, k, exclude_shards=exclude_sources)
         shard_len = sinfo.shard_size(size)
-        avail = {s: np.frombuffer(d, dtype=np.uint8)
-                 for s, d in shards.items() if len(d) == shard_len}
+        planar = self._planar_mode(codec, sinfo)
+        avail = {}
+        for s, d in shards.items():
+            if len(d) != shard_len:
+                continue
+            shard_planar = layouts.get(s) == planar_store.LAYOUT_PLANAR
+            if planar:
+                # steady state: sources shipped planes, the rebuild
+                # decodes AND re-encodes in the plane domain, and the
+                # pushed shards land as planes — conversion-free end to
+                # end; byte replies (mixed members) relayout once here
+                avail[s] = planar_store.blob_to_planes(d) \
+                    if shard_planar \
+                    else planar_store.shard_to_planes(d, seam="relayout")
+            else:
+                if shard_planar:
+                    d = planar_store.planes_to_shard(
+                        planar_store.blob_to_planes(d), seam="relayout")
+                avail[s] = np.frombuffer(d, dtype=np.uint8)
         if len(avail) < k:
             self.perf.inc("osd_unrecoverable")
             return False
@@ -1118,7 +1375,8 @@ class ECBackendMixin:
         # jax backends the rebuild runs the table-driven host GF engine
         # like the coalesced write path (engine-per-backend)
         chunks = await self._read_batcher.reencode(
-            codec, sinfo, avail, size)
+            codec, sinfo, avail, size, planar=planar)
+        out_layout = planar_store.LAYOUT_PLANAR if planar else None
         # stamp the rebuilt shards with the DECODE GROUP's version, not
         # our local one: a primary whose own shard is newer (or staler)
         # than the group it decoded from would otherwise relabel old
@@ -1135,14 +1393,14 @@ class ECBackendMixin:
             blob = chunks[shard].tobytes()
             if osd == self.osd_id:
                 self._apply_shard(st.pgid, oid, shard, blob, 0,
-                                  shard_len, hinfo)
+                                  shard_len, hinfo, layout=out_layout)
             else:
                 try:
                     await self._send_osd(osd, M.MOSDECSubOpWrite(
                         reqid=self._next_reqid(), pgid=st.pgid, oid=oid,
                         shard=shard, data=blob, chunk_off=0,
                         shard_size=shard_len, hinfo=hinfo, entry=entry,
-                        epoch=self.osdmap.epoch))
+                        epoch=self.osdmap.epoch, layout=out_layout))
                     self.perf.inc("osd_pushes_sent")
                 except ConnectionError:
                     # target unreachable: the rebuild did NOT land there —

@@ -302,9 +302,14 @@ class ReadBatcher:
         self._pending: Dict[Tuple, List] = {}
         self._workers: Dict[Tuple, asyncio.Task] = {}
 
-    async def decode(self, codec, sinfo, shards, logical_size) -> bytes:
+    async def decode(self, codec, sinfo, shards, logical_size,
+                     planar: bool = False) -> bytes:
         """Coalesced decode of one gather's shard ranges -> logical
-        bytes (the ``decode_stripes`` contract, tick-batched)."""
+        bytes (the ``decode_stripes`` contract, tick-batched).
+        ``planar`` (round 19): the shards are AT-REST plane matrices
+        and the decode runs in the plane domain end to end
+        (``decode_planes_multi``) — the assemble's planes->bytes hop is
+        the read's ONE sanctioned egress conversion."""
         from ceph_tpu.cluster.optracker import CURRENT_OP, mark_current
 
         if all(s in shards for s in range(sinfo.k)):
@@ -315,11 +320,14 @@ class ReadBatcher:
             # non-missing fast path, so bit-exactness is unaffected)
             from ceph_tpu.ec import stripe as stripemod
 
+            if planar:
+                return stripemod.decode_planes_multi(
+                    codec, sinfo, [(shards, logical_size)])[0]
             return stripemod.assemble_data_stripes(sinfo, shards,
                                                    logical_size)
         mark_current("read_batch_parked")
         data, (t0, t1, batch_n) = await self._submit(
-            ("decode", id(codec), sinfo.k, sinfo.chunk_size),
+            ("decode", id(codec), sinfo.k, sinfo.chunk_size, planar),
             codec, sinfo, (shards, logical_size))
         op = CURRENT_OP.get()
         if op is not None:
@@ -331,19 +339,27 @@ class ReadBatcher:
             op.mark_at("read_batch_decoded", t1)
         return data
 
-    async def reencode(self, codec, sinfo, shards, logical_size):
+    async def reencode(self, codec, sinfo, shards, logical_size,
+                       planar: bool = False):
         """Coalesced recovery rebuild -> the op's (k+m, shard_len)
-        matrix (the ``reencode_stripes`` contract, tick-batched)."""
+        matrix (the ``reencode_stripes`` contract, tick-batched).
+        ``planar``: at-rest plane matrices in, (n, 8, cols) plane
+        matrices out — ZERO layout conversions
+        (``reencode_planes_multi``)."""
         rows, _tick = await self._submit(
-            ("reencode", id(codec), sinfo.k, sinfo.chunk_size),
+            ("reencode", id(codec), sinfo.k, sinfo.chunk_size, planar),
             codec, sinfo, (shards, logical_size))
         return rows
 
-    async def verify(self, rows, crcs) -> List[bool]:
+    async def verify(self, rows, crcs, planar: bool = False) -> List[bool]:
         """Batched shard-crc verification: ``rows[i]`` checks against
         the stored ``ceph_crc32c(~0, row)`` value ``crcs[i]``; a tick's
         verifies share one crc32c batch per row-length group.  Returns
         the per-row pass/fail list.
+
+        ``planar``: each row is an AT-REST plane blob; the crc runs on
+        plane-major rows (``crc32c_planar_rows``) and stays bit-exact
+        with the byte-anchor hinfo crc — no layout conversion.
 
         Hardware-crc hosts short-circuit inline: the per-row C pass
         (5.6 GB/s, GIL-releasing) beats any batching scheme — exactly
@@ -353,11 +369,20 @@ class ReadBatcher:
         from ceph_tpu.ops import crc32c as crcmod
 
         if crcmod._gcrc is not None:
+            if planar:
+                from ceph_tpu.ec import planar_store
+
+                return [crc is None or
+                        int(crcmod.crc32c_planar_rows(
+                            planar_store.blob_to_planes(row))[0])
+                        == int(crc)
+                        for row, crc in zip(rows, crcs)]
             return [crc is None or
                     crcmod.crc32c(0xFFFFFFFF, row) == int(crc)
                     for row, crc in zip(rows, crcs)]
-        oks, _tick = await self._submit(("verify",), None, None,
-                                        (rows, crcs))
+        oks, _tick = await self._submit(
+            ("verify_planar",) if planar else ("verify",), None, None,
+            (rows, crcs))
         return oks
 
     async def _submit(self, key, codec, sinfo, payload):
@@ -397,11 +422,59 @@ class ReadBatcher:
                 out[ri][j] = (crc is None) or (int(g) == int(crc))
         return out
 
+    @staticmethod
+    def _verify_planar_multi(reqs):
+        """One tick's PLANAR crc verifications: every at-rest plane
+        blob of every request, batched per length group through
+        ``crc32c_planar_rows`` (plane-major rows, bit-exact with the
+        byte-anchor hinfo crcs) — zero layout conversions."""
+        import numpy as np
+
+        from ceph_tpu.ec import planar_store
+        from ceph_tpu.ops.crc32c import crc32c_planar_rows
+
+        flat: List = []           # (req index, row index, planes, crc)
+        for ri, (rows, crcs) in enumerate(reqs):
+            for j, (row, crc) in enumerate(zip(rows, crcs)):
+                flat.append((ri, j, planar_store.blob_to_planes(row),
+                             crc))
+        by_len: Dict[int, List] = {}
+        for item in flat:
+            by_len.setdefault(item[2].shape[1], []).append(item)
+        out = [[True] * len(rows) for rows, _c in reqs]
+        for _cols, group in by_len.items():
+            stacked = np.vstack([planes for _ri, _j, planes, _c in group])
+            got = crc32c_planar_rows(stacked)
+            for (ri, j, _p, crc), g in zip(group, got):
+                out[ri][j] = (crc is None) or (int(g) == int(crc))
+        return out
+
     async def _drain(self, key, codec, sinfo) -> None:
         from ceph_tpu.ec import stripe as stripemod
 
         osd = self._osd
         mode = key[0]
+        # one dispatcher per key: the planar flag rides the key (round
+        # 19), so a planar tick and a byte tick of the same codec never
+        # coalesce — their payload types differ
+        if mode == "decode":
+            fn = stripemod.decode_planes_multi if key[4] \
+                else stripemod.decode_stripes_multi
+
+            def compute(reqs):
+                return osd._compute(fn, codec, sinfo, reqs)
+        elif mode == "reencode":
+            fn = stripemod.reencode_planes_multi if key[4] \
+                else stripemod.reencode_stripes_multi
+
+            def compute(reqs):
+                return osd._compute(fn, codec, sinfo, reqs)
+        elif mode == "verify_planar":
+            def compute(reqs):
+                return osd._compute(self._verify_planar_multi, reqs)
+        else:
+            def compute(reqs):
+                return osd._compute(self._verify_multi, reqs)
         batch: List[_Req] = []
         try:
             while not osd._stopped:
@@ -413,17 +486,7 @@ class ReadBatcher:
                 self._pending[key] = pending[cap:]
                 t0 = osd.clock.monotonic()
                 try:
-                    if mode == "decode":
-                        results = await osd._compute(
-                            stripemod.decode_stripes_multi, codec,
-                            sinfo, [r.data for r in batch])
-                    elif mode == "reencode":
-                        results = await osd._compute(
-                            stripemod.reencode_stripes_multi, codec,
-                            sinfo, [r.data for r in batch])
-                    else:
-                        results = await osd._compute(
-                            self._verify_multi, [r.data for r in batch])
+                    results = await compute([r.data for r in batch])
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:
@@ -439,17 +502,7 @@ class ReadBatcher:
                             if r.fut.done():
                                 continue
                             try:
-                                if mode == "decode":
-                                    [res] = await osd._compute(
-                                        stripemod.decode_stripes_multi,
-                                        codec, sinfo, [r.data])
-                                elif mode == "reencode":
-                                    [res] = await osd._compute(
-                                        stripemod.reencode_stripes_multi,
-                                        codec, sinfo, [r.data])
-                                else:
-                                    [res] = await osd._compute(
-                                        self._verify_multi, [r.data])
+                                [res] = await compute([r.data])
                                 r.fut.set_result(
                                     (res, (t0, osd.clock.monotonic(), 1)))
                             except asyncio.CancelledError:
@@ -485,14 +538,19 @@ class EncodeBatcher:
         self._pending: Dict[Tuple, List[_Req]] = {}
         self._workers: Dict[Tuple, asyncio.Task] = {}
 
-    async def encode(self, codec, sinfo, data, want_crc: bool):
+    async def encode(self, codec, sinfo, data, want_crc: bool,
+                     planar: bool = False):
         """Coalesced encode of one op's stripe-aligned byte range.
 
         Returns ``(shards, crcs, (t0, t1, batch_n))``: the op's
         (k+m, nstripes*unit) shard matrix, the per-shard-row crcs (full
         rewrites only, else None), and the tick's encode window +
-        batch size for amortized attribution."""
-        key = (id(codec), sinfo.k, sinfo.chunk_size)
+        batch size for amortized attribution.  ``planar`` (round 19):
+        the tick runs ``encode_planes_multi`` — the op gets (n, 8,
+        cols) AT-REST plane matrices and plane-major crcs; the client
+        bytes -> planes hop inside the tick is the write's ONE
+        sanctioned ingest conversion."""
+        key = (id(codec), sinfo.k, sinfo.chunk_size, planar)
         fut = asyncio.get_event_loop().create_future()
         self._pending.setdefault(key, []).append(
             _Req(data, want_crc, fut))
@@ -507,7 +565,8 @@ class EncodeBatcher:
         # add a spurious failure mode under first-call XLA compiles
         return await fut  # graftlint: ignore[rpc-timeout]
 
-    async def encode_once(self, codec, sinfo, data):
+    async def encode_once(self, codec, sinfo, data,
+                          planar: bool = False):
         """The ``osd_batch_tick_ops=0`` legacy per-op encode — the
         round-10 bisection anchor — hosted INSIDE the sanctioned
         dispatch seam: exactly the per-op ``encode_stripes`` executor
@@ -515,9 +574,15 @@ class EncodeBatcher:
         round-10 contract).  Living here rather than in backend_ec
         keeps the ``per-op-device-dispatch`` rule honest: every device
         dispatch of the cluster data plane, legacy branch included,
-        routes through this module."""
+        routes through this module.  ``planar``: the per-op variant of
+        the planar tick — a 1-request ``encode_planes_multi``."""
         from ceph_tpu.ec import stripe as stripemod
 
+        if planar:
+            [(planes, _crcs)] = await self._osd._compute(
+                stripemod.encode_planes_multi, codec, sinfo, [data],
+                [False])
+            return planes
         return await self._osd._compute(
             stripemod.encode_stripes, codec, sinfo, data)
 
@@ -528,6 +593,11 @@ class EncodeBatcher:
         from ceph_tpu.ec import stripe as stripemod
 
         osd = self._osd
+        # the planar flag rides the key: a planar tick returns plane
+        # matrices + plane-major crcs, a byte tick returns shard rows —
+        # same-profile writes still coalesce within each mode
+        encode_fn = stripemod.encode_planes_multi if key[3] \
+            else stripemod.encode_stripes_multi
         batch: List[_Req] = []
         try:
             while not osd._stopped:
@@ -548,7 +618,7 @@ class EncodeBatcher:
                 t0 = osd.clock.monotonic()
                 try:
                     results = await osd._compute(
-                        stripemod.encode_stripes_multi, codec, sinfo,
+                        encode_fn, codec, sinfo,
                         [r.data for r in batch],
                         [r.want_crc for r in batch])
                 except asyncio.CancelledError:

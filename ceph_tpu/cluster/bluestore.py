@@ -34,6 +34,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ceph_tpu.cluster.store import ObjectStore, Transaction
+from ceph_tpu.ec import planar_store
 from ceph_tpu.ops import crc32c as crcmod
 
 BLOCK = 4096
@@ -51,6 +52,11 @@ class Onode:
     xattrs: Dict[str, bytes] = field(default_factory=dict)
     omap: Dict[str, bytes] = field(default_factory=dict)
     version: int = 0
+    # at-rest data layout (round 19): None = bytes; planar8 means the
+    # blocks hold the shard's packed bit-plane matrix row-major.  Read
+    # with getattr(o, "layout", None) — kv checkpoints written before
+    # this field existed unpickle without it.
+    layout: Optional[str] = None
 
 
 class BitmapAllocator:
@@ -307,6 +313,11 @@ class BlueStore(ObjectStore):
                 if data:
                     need += (offset + len(data) - 1) // BLOCK \
                         - offset // BLOCK + 1
+            elif op[0] == "write_planar":
+                # whole-matrix COW rewrite: blocks of the FINAL size
+                # (old blocks free only after the onode repoints)
+                _, _, _, _, _, total_cols = op
+                need += (8 * total_cols + BLOCK - 1) // BLOCK
             elif op[0] == "truncate":
                 need += 1                       # partial-tail rewrite
             elif op[0] == "clone":
@@ -335,9 +346,38 @@ class BlueStore(ObjectStore):
             self._onode(op[1], op[2])
         elif kind == "write":
             _, coll, oid, offset, data = op
+            o = self._coll(coll).get(oid)
+            if o is not None and \
+                    getattr(o, "layout", None) == planar_store.LAYOUT_PLANAR:
+                # byte write onto a planar object: it leaves planar-at-
+                # rest.  A partial overlay must land on LOGICAL bytes,
+                # so materialize once (counted relayout) first.
+                end = offset + len(data)
+                if not (offset == 0 and o.size <= end) and o.size:
+                    raw = self._read_all_replay_ok(coll, oid, o, replay)
+                    logical = planar_store.planes_to_shard(
+                        planar_store.blob_to_planes(raw), seam="relayout")
+                    self._do_truncate(coll, oid, 0, replay)
+                    self._do_write(coll, oid, 0, logical, replay)
+                o.layout = None
             self._do_write(coll, oid, offset, data, replay)
+        elif kind == "write_planar":
+            _, coll, oid, plane_off, data, total_cols = op
+            self._do_write_planar(coll, oid, plane_off, data, total_cols,
+                                  replay)
         elif kind == "truncate":
             _, coll, oid, size = op
+            o = self._coll(coll).get(oid)
+            if o is not None and o.size != size and o.size and \
+                    getattr(o, "layout", None) == planar_store.LAYOUT_PLANAR:
+                # byte truncate of a planar object cuts PLANE ROWS, not
+                # logical bytes — leave planar first (counted relayout)
+                raw = self._read_all_replay_ok(coll, oid, o, replay)
+                logical = planar_store.planes_to_shard(
+                    planar_store.blob_to_planes(raw), seam="relayout")
+                self._do_truncate(coll, oid, 0, replay)
+                self._do_write(coll, oid, 0, logical, replay)
+                o.layout = None
             self._do_truncate(coll, oid, size, replay)
         elif kind == "remove":
             o = self._coll(op[1]).pop(op[2], None)
@@ -368,6 +408,9 @@ class BlueStore(ObjectStore):
                                for k in ("shard", "size", "hinfo_crc")}
                               if o else {}),
                 "old_version": o.version if o else 0,
+                # at-rest layout travels with the rollback record so a
+                # rewind restores planar objects AS planar
+                "layout": getattr(o, "layout", None) if o else None,
             }
             self._onode(coll, rb_oid).omap[key] = pickle.dumps(rec)
         elif kind == "setattr":
@@ -429,6 +472,48 @@ class BlueStore(ObjectStore):
             o.csums[idx] = crc
         o.size = max(o.size, end)
 
+    def _read_all_replay_ok(self, coll, oid, o, replay) -> bytes:
+        """_read_all, but WAL replay over blocks a later pre-crash txn
+        reused yields zeros instead of failing the mount."""
+        try:
+            return self._read_all(coll, oid, o)
+        except IOError:
+            if not replay:
+                raise
+            return b"\0" * o.size
+
+    def _do_write_planar(self, coll, oid, plane_off, data, total_cols,
+                         replay) -> None:
+        """Planar-at-rest shard write: splice the (8, wc) plane-column
+        window into the object's plane matrix and rewrite it whole —
+        COW into fresh blocks like every other write.  A full rewrite
+        (the common EC case: whole-shard window, plane_off 0) never
+        reads the old blocks; only a windowed splice (RMW delta) does.
+        Documented simplification vs per-block surgery: shard objects
+        are a handful of blocks, and the COW rewrite keeps csums and
+        crash replay identical to the byte path."""
+        o = self._onode(coll, oid)
+        window = planar_store.blob_to_planes(data)
+        full_rewrite = plane_off == 0 and window.shape[1] >= total_cols
+        cur = None
+        if o.size and not full_rewrite:
+            raw = self._read_all_replay_ok(coll, oid, o, replay)
+            if len(raw) % 8:
+                raw += b"\0" * (8 - len(raw) % 8)
+            if getattr(o, "layout", None) == planar_store.LAYOUT_PLANAR:
+                cur = planar_store.blob_to_planes(raw)
+            else:
+                # planar write landing on a byte-at-rest object: the
+                # config gate flipped mid-life — convert once, counted
+                cur = planar_store.shard_to_planes(raw, seam="relayout")
+        merged = planar_store.splice_columns(
+            cur, plane_off, window, total_cols)
+        self._do_truncate(coll, oid, 0, replay)
+        self._do_write(coll, oid, 0, planar_store.planes_to_blob(merged),
+                       replay)
+        o.size = 8 * total_cols
+        o.layout = planar_store.LAYOUT_PLANAR
+
     def _do_truncate(self, coll, oid, size, replay) -> None:
         o = self._onode(coll, oid)
         n_blocks = (size + BLOCK - 1) // BLOCK
@@ -466,7 +551,7 @@ class BlueStore(ObjectStore):
         if old is not None:
             self._free_onode(old)
         d = Onode(size=s.size, xattrs=dict(s.xattrs), omap=dict(s.omap),
-                  version=s.version)
+                  version=s.version, layout=getattr(s, "layout", None))
         # physical copy block-by-block (no refcounted blobs — documented
         # simplification of the reference's shared-blob clone)
         for idx, blk in enumerate(s.blocks):
@@ -501,6 +586,19 @@ class BlueStore(ObjectStore):
             o = self._onodes.get(coll, {}).get(oid)
             if o is None:
                 raise FileNotFoundError(f"{coll}/{oid}")
+            if getattr(o, "layout", None) == planar_store.LAYOUT_PLANAR \
+                    and o.size:
+                # byte view of a planar object OUTSIDE the sanctioned
+                # seams (egress of last resort): logical byte 8i+u needs
+                # column i of ALL 8 plane rows, so the whole object is
+                # read and csum-verified; books the ``unseamed``
+                # counter the steady-state contract pins to zero.
+                data = planar_store.planes_to_shard(  # graftlint: ignore[planar-conversion-hygiene]
+                    planar_store.blob_to_planes(self._read_all(
+                        coll, oid, o)), seam="unseamed")
+                if length is None:
+                    return data[offset:]
+                return data[offset : offset + length]
             end = o.size if length is None else min(o.size,
                                                     offset + length)
             if offset >= end:
@@ -513,6 +611,26 @@ class BlueStore(ObjectStore):
                 out += self._read_block(coll, oid, o, idx)
             lo = offset - first * BLOCK
             return bytes(out[lo: lo + (end - offset)])
+
+    def read_planar(self, coll: str, oid: str) -> bytes:
+        """The at-rest plane blob as stored — ZERO layout conversion
+        (csum-verified block reads).  Callers gate on object_layout; a
+        byte-at-rest object raises."""
+        if self.chaos is not None:
+            self.chaos.on_read(coll, oid)
+        with self._lock:
+            o = self._onodes.get(coll, {}).get(oid)
+            if o is None:
+                raise FileNotFoundError(f"{coll}/{oid}")
+            if getattr(o, "layout", None) != planar_store.LAYOUT_PLANAR:
+                raise ValueError(f"{coll}/{oid} is not planar-at-rest")
+            return self._read_all(coll, oid, o)
+
+    def object_layout(self, coll: str, oid: str) -> Optional[str]:
+        """At-rest layout tag (None = bytes / missing object)."""
+        with self._lock:
+            o = self._onodes.get(coll, {}).get(oid)
+            return None if o is None else getattr(o, "layout", None)
 
     def stat(self, coll: str, oid: str) -> Optional[int]:
         with self._lock:

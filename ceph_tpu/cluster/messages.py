@@ -301,6 +301,11 @@ class MOSDECSubOpWrite(Message):
     entry: Any = None            # pglog.LogEntry
     epoch: int = 0
     deadline: Optional[float] = None  # inherited parent-op deadline
+    # at-rest layout of ``data`` (round 19): None = shard bytes;
+    # "planar8" = the (8, len/8) packed bit-plane matrix row-major, to
+    # be landed via Transaction.write_planar — wire, store, and kernel
+    # agree on layout so the steady state never converts
+    layout: Optional[str] = None
 
 
 @dataclass
@@ -351,6 +356,10 @@ class MOSDECSubOpReadReply(Message):
     shard: int = -1
     data: bytes = b""
     hinfo: Dict[str, Any] = field(default_factory=dict)
+    # at-rest layout of ``data`` (round 19): None = shard bytes;
+    # "planar8" = packed bit-planes straight off the store (full-shard
+    # reads only — sub-range reads always ship bytes)
+    layout: Optional[str] = None
 
 
 @dataclass

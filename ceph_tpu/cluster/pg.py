@@ -394,6 +394,15 @@ class PGLogMixin:
                 rec = pickle.loads(rec_blob)
                 if not rec["existed"]:
                     txn.remove(coll, rec["oid"])
+                elif rec.get("layout") == "planar8":
+                    # planar-at-rest object: old_range IS the captured
+                    # plane blob — restore it AS planes (a byte write
+                    # would land the blob as logical bytes and drop the
+                    # layout); capture is whole-object (chunk_off 0)
+                    txn.write_planar(coll, rec["oid"],
+                                     rec["chunk_off"] // 8,
+                                     rec["old_range"],
+                                     rec["old_total"] // 8)
                 else:
                     txn.write(coll, rec["oid"], rec["chunk_off"],
                               rec["old_range"])

@@ -15,6 +15,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from ceph_tpu.cluster.optracker import mark_current
+from ceph_tpu.ec import planar_store
 
 
 @dataclass
@@ -23,6 +24,11 @@ class Obj:
     xattrs: Dict[str, bytes] = field(default_factory=dict)
     omap: Dict[str, bytes] = field(default_factory=dict)
     version: int = 0
+    # at-rest data layout: None = classic bytes; planar_store.LAYOUT_PLANAR
+    # means ``data`` holds the shard's (8, L/8) packed bit-plane matrix
+    # serialized row-major (round 19).  Same byte length either way, so
+    # _used/statfs/stat need no layout awareness.
+    layout: Optional[str] = None
 
 
 class Transaction:
@@ -41,6 +47,18 @@ class Transaction:
 
     def write(self, coll: str, oid: str, offset: int, data: bytes):
         self.ops.append(("write", coll, oid, offset, bytes(data)))
+        return self
+
+    def write_planar(self, coll: str, oid: str, plane_off: int,
+                     data: bytes, total_cols: int):
+        """Planar-at-rest shard write (round 19): land ``data`` — an
+        (8, wc) plane-column window serialized row-major — at plane
+        column ``plane_off`` (= byte offset / 8) and size the object to
+        exactly ``total_cols`` columns (= shard bytes / 8).  One op
+        covers the byte path's write+truncate pair, and the object's
+        layout becomes planar."""
+        self.ops.append(("write_planar", coll, oid, plane_off,
+                         bytes(data), total_cols))
         return self
 
     def truncate(self, coll: str, oid: str, size: int):
@@ -127,6 +145,13 @@ class ObjectStore:
              length: Optional[int] = None) -> bytes:
         raise NotImplementedError
 
+    def read_planar(self, coll: str, oid: str) -> bytes:
+        raise NotImplementedError
+
+    def object_layout(self, coll: str, oid: str) -> Optional[str]:
+        """At-rest layout tag (None = bytes / missing / unsupported)."""
+        return None
+
     def stat(self, coll: str, oid: str) -> Optional[int]:
         raise NotImplementedError
 
@@ -168,6 +193,15 @@ class MemStore(ObjectStore):
                 _, coll, oid, offset, data = op
                 new = max(cur(coll, oid), offset + len(data))
                 grow += new - sizes[(coll, oid)]
+                sizes[(coll, oid)] = new
+            elif kind == "write_planar":
+                _, coll, oid, _plane_off, _data, total_cols = op
+                # one op fixes the final size exactly: 8 plane rows of
+                # total_cols packed bytes == the shard's byte length, so
+                # planar admission counts TRUE plane bytes (satellite:
+                # same ENOSPC behavior as the byte anchor)
+                new = 8 * total_cols
+                grow += new - cur(coll, oid)
                 sizes[(coll, oid)] = new
             elif kind == "truncate":
                 _, coll, oid, size = op
@@ -236,6 +270,16 @@ class MemStore(ObjectStore):
             o = self._coll(coll).setdefault(oid, Obj())
             old = len(o.data)
             end = offset + len(data)
+            if o.layout == planar_store.LAYOUT_PLANAR:
+                # byte write onto a planar object: the object leaves
+                # planar-at-rest.  A full rewrite just drops the layout;
+                # a partial overlay must land on LOGICAL bytes, so
+                # materialize once (counted relayout) before splicing.
+                if not (offset == 0 and old <= end):
+                    o.data[:] = planar_store.planes_to_shard(
+                        planar_store.blob_to_planes(bytes(o.data)),
+                        seam="relayout")
+                o.layout = None
             if offset == 0 and len(o.data) <= end:
                 # full rewrite/extend from 0 (the EC full-shard write):
                 # one copy, no zero-fill of bytes about to be replaced
@@ -246,10 +290,41 @@ class MemStore(ObjectStore):
                 o.data[offset:end] = data
             o.version += 1
             self._used += len(o.data) - old
+        elif kind == "write_planar":
+            _, coll, oid, plane_off, data, total_cols = op
+            o = self._coll(coll).setdefault(oid, Obj())
+            old = len(o.data)
+            window = planar_store.blob_to_planes(data)
+            if o.data and o.layout == planar_store.LAYOUT_PLANAR:
+                cur = planar_store.blob_to_planes(bytes(o.data))
+            elif o.data:
+                # a planar write landing on a byte-at-rest object: the
+                # config gate flipped mid-life — convert once, counted
+                # (zero-pad to the 8-byte packing quantum; EC shards are
+                # stripe-unit aligned so this is a non-EC-object guard)
+                raw = bytes(o.data)
+                if len(raw) % 8:
+                    raw += b"\0" * (8 - len(raw) % 8)
+                cur = planar_store.shard_to_planes(raw, seam="relayout")
+            else:
+                cur = None
+            merged = planar_store.splice_columns(
+                cur, plane_off, window, total_cols)
+            o.data[:] = planar_store.planes_to_blob(merged)
+            o.layout = planar_store.LAYOUT_PLANAR
+            o.version += 1
+            self._used += len(o.data) - old
         elif kind == "truncate":
             _, coll, oid, size = op
             o = self._coll(coll).setdefault(oid, Obj())
             old = len(o.data)
+            if o.layout == planar_store.LAYOUT_PLANAR and old != size:
+                # byte truncate of a planar object cuts PLANE ROWS, not
+                # logical bytes — leave planar first (counted relayout)
+                o.data[:] = planar_store.planes_to_shard(
+                    planar_store.blob_to_planes(bytes(o.data)),
+                    seam="relayout")
+                o.layout = None
             if len(o.data) > size:
                 del o.data[size:]
             else:
@@ -269,7 +344,8 @@ class MemStore(ObjectStore):
                     (len(prev.data) if prev is not None else 0)
                 self._coll(coll)[dst] = Obj(
                     data=bytearray(s.data), xattrs=dict(s.xattrs),
-                    omap=dict(s.omap), version=s.version)
+                    omap=dict(s.omap), version=s.version,
+                    layout=s.layout)
         elif kind == "rb_capture":
             _, coll, oid, rb_oid, key = op
             o = self._coll(coll).get(oid)
@@ -281,6 +357,10 @@ class MemStore(ObjectStore):
                                for k in ("shard", "size", "hinfo_crc")}
                               if o else {}),
                 "old_version": o.version if o else 0,
+                # at-rest layout travels with the rollback record so a
+                # rewind restores planar objects AS planar (pg.py
+                # rewind_divergent_log dispatches on it)
+                "layout": o.layout if o else None,
             }
             self._coll(coll).setdefault(rb_oid, Obj()).omap[key] = \
                 pickle.dumps(rec)
@@ -320,9 +400,41 @@ class MemStore(ObjectStore):
             o = self._colls.get(coll, {}).get(oid)
             if o is None:
                 raise FileNotFoundError(f"{coll}/{oid}")
+            if o.layout == planar_store.LAYOUT_PLANAR and o.data:
+                # byte view of a planar object OUTSIDE the sanctioned
+                # seams (egress of last resort): correct, but it books
+                # the ``unseamed`` counter the steady-state contract
+                # pins to zero — EC hot paths must use read_planar.
+                data = planar_store.planes_to_shard(  # graftlint: ignore[planar-conversion-hygiene]
+                    planar_store.blob_to_planes(bytes(o.data)),
+                    seam="unseamed")
+                if length is None:
+                    return data[offset:]
+                return data[offset : offset + length]
             if length is None:
                 return bytes(o.data[offset:])
             return bytes(o.data[offset : offset + length])
+
+    def read_planar(self, coll: str, oid: str) -> bytes:
+        """The at-rest plane blob of a planar object, as stored — ZERO
+        layout conversion.  Callers gate on object_layout first; a
+        byte-at-rest object raises (mixed generations are the caller's
+        relayout decision, not a silent conversion here)."""
+        if self.chaos is not None:
+            self.chaos.on_read(coll, oid)
+        with self._lock:
+            o = self._colls.get(coll, {}).get(oid)
+            if o is None:
+                raise FileNotFoundError(f"{coll}/{oid}")
+            if o.layout != planar_store.LAYOUT_PLANAR:
+                raise ValueError(f"{coll}/{oid} is not planar-at-rest")
+            return bytes(o.data)
+
+    def object_layout(self, coll: str, oid: str) -> Optional[str]:
+        """At-rest layout tag (None = bytes / missing object)."""
+        with self._lock:
+            o = self._colls.get(coll, {}).get(oid)
+            return None if o is None else o.layout
 
     def debug_bitrot(self, coll: str, oid: str, bit: int) -> None:
         """Silent in-place bit flip (no version bump, no attr change):

@@ -435,6 +435,11 @@ def _fast_config() -> Config:
         # batched replies; objecter_batch_tick_ops=0 stays the per-op
         # frame anchor for bit-exactness and same-host A/B
         objecter_batch_tick_ops=16,
+        # planar at rest (round 19): vstart clusters store EC shards as
+        # packed bit-planes end-to-end; osd_ec_planar_at_rest=0 (the
+        # plain Config() default) stays the byte-at-rest bit-exactness
+        # anchor for bisection and same-session A/B
+        osd_ec_planar_at_rest=1,
     )
 
 

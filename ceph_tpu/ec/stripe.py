@@ -640,6 +640,363 @@ def reencode_stripes_multi(codec, sinfo: StripeInfo, reqs):
     return out
 
 
+# ---------------------------------------------------------------------------
+# Planar AT-REST entry points (round 19): shards enter and leave as packed
+# bit-planes (ec/planar_store.py layout) — the steady-state write, read,
+# RMW, recovery and scrub paths run below with ZERO byte<->plane layout
+# conversions outside the sanctioned ingest (client bytes at encode) and
+# egress (logical bytes at read assemble) seams.
+# ---------------------------------------------------------------------------
+
+
+def planar_at_rest_ok(codec, unit: int) -> bool:
+    """Can this (codec, stripe_unit) pool store EC shards as packed
+    bit-planes at rest?
+
+    Requires the bitpack layout contract: a MatrixCodec-family engine
+    (w == 8, byte coding matrix, survivor-submatrix decode) and a
+    stripe unit that is a multiple of the 8-byte packing quantum.
+    Packet-interleaved codecs (the BitmatrixCodec family — their planar
+    form is the packet-row matrix, a different serialization) and
+    exotic plans (LRC/SHEC locality groups, mesh adapters) keep
+    byte-at-rest; the config gate falls back per pool, not per cluster.
+    """
+    eng = getattr(codec, "engine", None)
+    if eng is None or getattr(eng, "w", 0) != 8:
+        return False
+    if getattr(eng, "coding", None) is None:
+        return False
+    if not hasattr(eng, "decode_matrix"):
+        return False
+    if getattr(codec, "packetsize", None) is not None:
+        return False
+    if unit <= 0 or unit % 8:
+        return False
+    sup = getattr(codec, "planar_supported", None)
+    return bool(sup and sup(unit))
+
+
+def _planes_rows_for(codec, src: Tuple[int, ...],
+                     want: Tuple[int, ...],
+                     src_planes: np.ndarray) -> Optional[np.ndarray]:
+    """Reconstruct ``want`` chunks' plane rows from ``src`` chunks'
+    plane rows, engine per backend: host XOR over the expanded recovery
+    bit-matrix on CPU, the fused planar matmul elsewhere.  None when the
+    pattern has no survivor-submatrix solution (caller falls back to the
+    byte machinery)."""
+    from ceph_tpu.ec import planar_store as pstore
+    from ceph_tpu.ops import gf8
+
+    rmat = _host_decode_matrix(codec, src, want)
+    if rmat is None:
+        return None
+    if _host_engine_ok(codec):
+        return pstore.planar_matmul_host(gf8.expand_bitmatrix(rmat),
+                                         src_planes)
+    import jax.numpy as jnp
+
+    bitmat = codec.engine.decode_bitmat(tuple(src), tuple(want))
+    return np.asarray(gf8.planar_matmul(bitmat, jnp.asarray(src_planes)))
+
+
+def _parity_planes_for(codec, data_planes: np.ndarray) -> np.ndarray:
+    """(k*8, cols) data plane rows -> (m*8, cols) parity plane rows."""
+    from ceph_tpu.ec import planar_store as pstore
+    from ceph_tpu.ops import gf8
+
+    if _host_engine_ok(codec):
+        return pstore.planar_matmul_host(
+            gf8.expand_bitmatrix(codec.engine.coding), data_planes)
+    import jax.numpy as jnp
+
+    return np.asarray(gf8.planar_matmul(codec.engine._enc_bitmat,
+                                        jnp.asarray(data_planes)))
+
+
+def _select_shard_planes(full_planes: np.ndarray,
+                         shards: Tuple[int, ...]) -> np.ndarray:
+    """Row-select whole shards (8 plane rows each) from a chunk-major
+    plane matrix — a pure gather, no layout change."""
+    idx = np.concatenate([np.arange(s * 8, s * 8 + 8) for s in shards])
+    return full_planes[idx]
+
+
+def encode_planes_multi(codec, sinfo: StripeInfo, datas, want_crcs=None):
+    """Coalesced encode emitting AT-REST PLANES: the planar-at-rest twin
+    of ``encode_stripes_multi``.
+
+    Returns ``[(planes, crcs), ...]`` aligned with ``datas``: ``planes``
+    is the per-op (n, 8, shard_len/8) uint8 array — ``planes[s]`` is
+    shard s's at-rest plane matrix, serialized by ``tobytes()`` — and
+    ``crcs`` (when the op's flag is set) are per-shard
+    ``ceph_crc32c(~0, byte_view)`` values computed through the planar
+    row view, bit-identical to the byte anchor.  Client bytes pack into
+    planes exactly ONCE (the sanctioned ingest conversion, booked on
+    the ``ec_planar_ingest`` counters); parity is derived in the plane
+    domain and shard bytes are never materialized.
+    """
+    from ceph_tpu.ec import planar_store as pstore
+    from ceph_tpu.ops.crc32c import crc32c_planar_rows
+    from ceph_tpu.ops.profiling import record_planar_at_rest
+    from ceph_tpu.utils.perf import KERNELS
+
+    k = sinfo.k
+    unit = sinfo.chunk_size
+    n = codec.get_chunk_count()
+    if want_crcs is None:
+        want_crcs = [False] * len(datas)
+    counts = [sinfo.object_stripes(len(d)) for d in datas]
+    total = sum(counts)
+    out: List = [None] * len(datas)
+    if total == 0:
+        for i in range(len(datas)):
+            planes = np.zeros((n, 8, 0), dtype=np.uint8)
+            out[i] = (planes,
+                      crc32c_planar_rows(planes.reshape(n * 8, 0))
+                      if want_crcs[i] else None)
+        return out
+    KERNELS.inc("ec_coalesced_ticks")
+    KERNELS.inc("ec_coalesced_ops", len(datas))
+    batch = np.zeros((total, k, unit), dtype=np.uint8)
+    pad = 0
+    ofs = 0
+    for d, ns in zip(datas, counts):
+        if ns == 0:
+            continue
+        flat = batch[ofs:ofs + ns].reshape(ns * k * unit)
+        flat[: len(d)] = np.frombuffer(d, dtype=np.uint8)
+        pad += ns * sinfo.stripe_width - len(d)
+        ofs += ns
+    if _host_engine_ok(codec):
+        KERNELS.inc("ec_stripe_pad_bytes", pad)
+        # THE sanctioned ingest: client bytes -> planes, once per tick
+        record_planar_at_rest("ingest", total * k * unit)
+        rows = np.ascontiguousarray(
+            batch.transpose(1, 0, 2).reshape(k, total * unit))
+        data_planes = pstore.rows_to_planes(rows)
+        all_planes = np.vstack(
+            [data_planes, _parity_planes_for(codec, data_planes)])
+    else:
+        bb = _bucket(total)
+        if bb != total:
+            batch = np.concatenate(
+                [batch, np.zeros((bb - total, k, unit), dtype=np.uint8)])
+        KERNELS.inc("ec_stripe_pad_bytes", pad + (bb - total) * k * unit)
+        record_planar_at_rest("ingest", total * k * unit)
+        pb = codec.to_planar(batch)
+        parity_pb = codec.encode_planar(pb)
+        all_planes = np.vstack([np.asarray(pb.planes),
+                                np.asarray(parity_pb.planes)])
+    # per-op at-rest planes slice straight out of the coalesced plane
+    # matrix: op columns are contiguous (unit % 8 == 0), shard s is
+    # plane rows s*8..s*8+8 — no conversion, no transpose of payload
+    crc_groups: Dict[int, List] = {}
+    c0 = 0
+    for i, ns in enumerate(counts):
+        cw = ns * unit // 8
+        op_planes = np.ascontiguousarray(
+            all_planes[:, c0:c0 + cw]).reshape(n, 8, cw)
+        c0 += cw
+        out[i] = (op_planes, None)
+        if want_crcs[i]:
+            crc_groups.setdefault(cw, []).append((i, op_planes))
+    # one planar crc dispatch per shard length group (planar row view:
+    # bit-identical to the byte anchor's crc32c_rows)
+    for _cw, group in crc_groups.items():
+        stacked = np.concatenate(
+            [p.reshape(n * 8, -1) for _i, p in group], axis=0)
+        crcs = crc32c_planar_rows(stacked)
+        for gi, (i, p) in enumerate(group):
+            out[i] = (out[i][0], crcs[gi * n:(gi + 1) * n])
+    return out
+
+
+def _normalize_planes(shards, cols: int) -> Dict[int, np.ndarray]:
+    """Shard map values -> (8, cols) plane matrices (serialized blobs
+    reshape in place; already-shaped arrays pass through)."""
+    from ceph_tpu.ec import planar_store as pstore
+
+    out: Dict[int, np.ndarray] = {}
+    for s, v in shards.items():
+        arr = pstore.blob_to_planes(v) if isinstance(v, (bytes, bytearray,
+                                                         memoryview)) \
+            else np.ascontiguousarray(v, dtype=np.uint8).reshape(8, -1)
+        if arr.shape[1] != cols:
+            raise ValueError(
+                f"shard {s}: {arr.shape[1]} plane cols, want {cols}")
+        out[s] = arr
+    return out
+
+
+def _assemble_from_planes(data_planes: Dict[int, np.ndarray], k: int,
+                          nstripes: int, unit: int,
+                          logical_size: int) -> bytes:
+    """Planar shards -> logical client bytes: THE sanctioned egress."""
+    from ceph_tpu.ec import planar_store as pstore
+    from ceph_tpu.ops.profiling import record_planar_at_rest
+
+    stacked = np.vstack([data_planes[s] for s in range(k)])
+    record_planar_at_rest("egress", int(stacked.size))
+    rows = pstore.planes_to_rows(stacked)          # (k, shard_len)
+    return _assemble_logical({s: rows[s] for s in range(k)},
+                             k, nstripes, unit, logical_size)
+
+
+def decode_planes_multi(codec, sinfo: StripeInfo, reqs):
+    """Coalesced decode from AT-REST PLANES to logical bytes: the
+    planar-at-rest twin of ``decode_stripes_multi``.
+
+    ``reqs`` is a sequence of ``(shard_planes, logical_size)`` pairs;
+    ``shard_planes`` maps shard id -> (8, shard_len/8) plane matrix (or
+    its serialized blob).  Reconstruction of missing data shards runs in
+    the plane domain (grouped by erasure pattern, engine per backend);
+    the ONLY conversion is the final planes -> logical-bytes assemble,
+    booked as the sanctioned egress.  Patterns without a
+    survivor-submatrix solution fall back to the byte machinery through
+    a relayout conversion (legal, counted, never on the steady state).
+    """
+    from ceph_tpu.ec import planar_store as pstore
+    from ceph_tpu.utils.perf import KERNELS
+
+    k = sinfo.k
+    unit = sinfo.chunk_size
+    n = codec.get_chunk_count()
+    out: List = [None] * len(reqs)
+    groups: Dict[Tuple, List] = {}
+    for i, (shards, logical_size) in enumerate(reqs):
+        nstripes = sinfo.object_stripes(logical_size)
+        if nstripes == 0:
+            out[i] = b""
+            continue
+        cols = nstripes * unit // 8
+        arrs = _normalize_planes(shards, cols)
+        missing = tuple(s for s in range(k) if s not in arrs)
+        if not missing:
+            out[i] = _assemble_from_planes(arrs, k, nstripes, unit,
+                                           logical_size)
+            continue
+        if len(arrs) < k:
+            raise ValueError(f"only {len(arrs)} of {k} shards")
+        erasures = tuple(s for s in range(n) if s not in arrs)
+        groups.setdefault((erasures, missing), []).append(
+            (i, arrs, nstripes, logical_size))
+    if not groups:
+        return out
+    KERNELS.inc("ec_coalesced_read_ticks")
+    KERNELS.inc("ec_coalesced_reads", sum(len(g) for g in groups.values()))
+    for (erasures, want), items in groups.items():
+        src = tuple(s for s in range(n) if s not in erasures)[:k]
+        total_cols = sum(ns for _i, _a, ns, _ls in items) * unit // 8
+        src_planes = np.zeros((k * 8, total_cols), dtype=np.uint8)
+        c0 = 0
+        for _i, arrs, ns, _ls in items:
+            cw = ns * unit // 8
+            for j, s in enumerate(src):
+                src_planes[j * 8:j * 8 + 8, c0:c0 + cw] = arrs[s]
+            c0 += cw
+        rec = _planes_rows_for(codec, src, want, src_planes)
+        if rec is None:
+            # unsolvable pattern for the plane engine: relayout to the
+            # byte machinery (counted; never the steady state)
+            for i, arrs, ns, logical_size in items:
+                byte_shards = {
+                    s: np.frombuffer(
+                        pstore.planes_to_shard(a, seam="relayout"),
+                        dtype=np.uint8)
+                    for s, a in arrs.items()}
+                out[i] = decode_stripes_multi(
+                    codec, sinfo, [(byte_shards, logical_size)])[0]
+            continue
+        c0 = 0
+        for i, arrs, ns, logical_size in items:
+            cw = ns * unit // 8
+            data_planes = {s: arrs[s] for s in range(k) if s in arrs}
+            for idx, e in enumerate(want):
+                data_planes[e] = rec[idx * 8:idx * 8 + 8, c0:c0 + cw]
+            c0 += cw
+            out[i] = _assemble_from_planes(data_planes, k, ns, unit,
+                                           logical_size)
+    return out
+
+
+def reencode_planes_multi(codec, sinfo: StripeInfo, reqs):
+    """Coalesced recovery rebuild in the plane domain: AT-REST planes
+    in, AT-REST planes out — ZERO layout conversions (the recovery path
+    neither ingests client bytes nor egresses logical bytes).
+
+    ``reqs`` mirrors ``decode_planes_multi``; returns the per-op
+    (n, 8, shard_len/8) uint8 arrays, aligned with ``reqs``.  Missing
+    chunks' plane rows rebuild through the recovery bit-matrix, parity
+    re-derives from the data plane rows, and surviving shards pass
+    through untouched.
+    """
+    from ceph_tpu.ec import planar_store as pstore
+    from ceph_tpu.utils.perf import KERNELS
+
+    k = sinfo.k
+    unit = sinfo.chunk_size
+    n = codec.get_chunk_count()
+    out: List = [None] * len(reqs)
+    groups: Dict[Tuple, List] = {}
+    for i, (shards, logical_size) in enumerate(reqs):
+        nstripes = sinfo.object_stripes(logical_size)
+        if nstripes == 0:
+            out[i] = np.zeros((n, 8, 0), dtype=np.uint8)
+            continue
+        if len(shards) < k:
+            raise ValueError(f"only {len(shards)} of {k} shards")
+        cols = nstripes * unit // 8
+        arrs = _normalize_planes(shards, cols)
+        erasures = tuple(s for s in range(n) if s not in arrs)
+        missing = tuple(s for s in range(k) if s not in arrs)
+        groups.setdefault((erasures, missing), []).append(
+            (i, arrs, nstripes, logical_size))
+    if not groups:
+        return out
+    KERNELS.inc("ec_coalesced_reencode_ticks")
+    KERNELS.inc("ec_coalesced_reencodes",
+                sum(len(g) for g in groups.values()))
+    for (erasures, want), items in groups.items():
+        src = tuple(s for s in range(n) if s not in erasures)[:k]
+        total_cols = sum(ns for _i, _a, ns, _ls in items) * unit // 8
+        full = np.zeros((n * 8, total_cols), dtype=np.uint8)
+        c0 = 0
+        for _i, arrs, ns, _ls in items:
+            cw = ns * unit // 8
+            for s, a in arrs.items():
+                full[s * 8:s * 8 + 8, c0:c0 + cw] = a
+            c0 += cw
+        rec = None
+        if want:
+            rec = _planes_rows_for(codec, src,
+                                   want, _select_shard_planes(full, src))
+            if rec is None:
+                # relayout fallback through the byte reencode
+                for i, arrs, ns, logical_size in items:
+                    byte_shards = {
+                        s: np.frombuffer(
+                            pstore.planes_to_shard(a, seam="relayout"),
+                            dtype=np.uint8)
+                        for s, a in arrs.items()}
+                    rows = reencode_stripes_multi(
+                        codec, sinfo, [(byte_shards, logical_size)])[0]
+                    out[i] = pstore.rows_to_planes(rows).reshape(
+                        n, 8, rows.shape[1] // 8)
+                    pstore.record_planar_at_rest(
+                        "relayout", int(rows.size))
+                continue
+            for idx, e in enumerate(want):
+                full[e * 8:e * 8 + 8] = rec[idx * 8:idx * 8 + 8]
+        full[k * 8:] = _parity_planes_for(codec, full[: k * 8])
+        c0 = 0
+        for i, _arrs, ns, _ls in items:
+            cw = ns * unit // 8
+            out[i] = np.ascontiguousarray(
+                full[:, c0:c0 + cw]).reshape(n, 8, cw)
+            c0 += cw
+    return out
+
+
 def merge_range(old: bytes, old_size: int, offset: int, data: bytes) -> bytes:
     """Overlay ``data`` at ``offset`` onto ``old`` (zero-extending holes);
     returns the new logical object bytes."""

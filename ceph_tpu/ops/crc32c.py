@@ -330,3 +330,95 @@ def crc32c_rows(rows, seed: int = 0xFFFFFFFF, block: int = 4096):
     # update(seed, row) = update(seed, 0^L) ^ update(0, row)
     head = np.uint32(crc32c_zeros(seed, length))
     return [int(c) for c in (folded ^ head)]
+
+
+# ---------------------------------------------------------------------------
+# Planar row view (round 19): CRC the BYTE stream of packed bit-planes
+# without materializing it
+# ---------------------------------------------------------------------------
+#
+# An at-rest planar shard (ec/planar_store.py) is its (8, cols) packed
+# bit-plane matrix; its logical byte stream D (length M = 8*cols) never
+# exists on the steady-state path.  CRC is GF(2)-linear in the message
+# bits, and D = XOR_t S_t where S_t is the M-byte "spread" of plane t
+# (S_t[8i+u] = bit t of D[8i+u], placed at bit position t), so
+#
+#   update(seed, D) = XOR_t update(0, S_t) ^ update(seed, 0^M)
+#
+# (the 8 linear-part constants cancel pairwise — 8 is even).  hinfo CRCs
+# of planar shards therefore stay bit-identical to the byte anchor.
+
+# cap on the full-length planar message matrix a device dispatch will
+# build ((32, 8*M) uint8); past it the host spread path takes over
+_PLANAR_DEV_MAX = 1 << 15
+
+
+@functools.lru_cache(maxsize=16)
+def _planar_message_bitmat_dev(length: int):
+    """Device copy of ``_message_bitmat(length)`` column-permuted so it
+    applies directly to a plane-group BLOB (8 rows of length/8 packed
+    bytes, row-major): blob bit 8*(t*cols+i)+u is D-bit 8*(8i+u)+t."""
+    import jax.numpy as jnp
+
+    cols = length // 8
+    base = _message_bitmat(length)
+    t, i, u = np.meshgrid(np.arange(8), np.arange(cols), np.arange(8),
+                          indexing="ij")
+    src = (8 * (8 * i + u) + t).reshape(-1)
+    return jnp.asarray(base[:, src])
+
+
+def _planar_spread(planes: np.ndarray) -> np.ndarray:
+    """(g8, cols) packed planes -> (g8, 8*cols) spread byte streams S_t
+    (row 8g+t spreads plane t of group g)."""
+    bits = np.unpackbits(planes, axis=1, bitorder="little")
+    shifts = (np.arange(planes.shape[0], dtype=np.uint8) % 8)[:, None]
+    return (bits << shifts).astype(np.uint8)
+
+
+def crc32c_planar_rows(planes, seed: int = 0xFFFFFFFF):
+    """(G*8, cols) packed bit-planes -> list of G ``ceph_crc32c(seed,
+    byte_view)`` values, one per 8-row plane group, WITHOUT building the
+    byte view.
+
+    Rows come in eights (group g = rows 8g..8g+7 = one shard's at-rest
+    planes, ec/planar_store.py layout).  Device backends run ONE
+    ``crc32c_batch``-style matmul over the raw plane blobs with a
+    column-permuted message matrix; host backends CRC the 8 spread
+    streams per group through ``crc32c_rows`` and XOR-fold.  Both are
+    bit-identical to ``crc32c(seed, planes_to_shard(group))``.
+    """
+    from ceph_tpu.utils.perf import KERNELS
+
+    arr = np.ascontiguousarray(planes, dtype=np.uint8)
+    if arr.ndim != 2 or arr.shape[0] % 8:
+        raise ValueError("planes must be (G*8, cols)")
+    g8, cols = arr.shape
+    g = g8 // 8
+    if g == 0:
+        return []
+    length = 8 * cols
+    KERNELS.inc("crc32c_planar_calls")
+    KERNELS.inc("crc32c_planar_bytes", g * length)
+    if length == 0:
+        return [crc32c(seed, b"")] * g
+    if _gcrc is None and length <= _PLANAR_DEV_MAX:
+        import jax
+
+        if jax.default_backend() != "cpu":
+            # one matmul over the at-rest blobs: no spread, no byte view
+            global _batch_jit
+            if _batch_jit is None:
+                _batch_jit = _crc32c_batch_jit()
+            import jax.numpy as jnp
+
+            bitmat = _planar_message_bitmat_dev(length)
+            const = np.uint32(crc32c_zeros(seed, length))
+            blobs = jnp.asarray(arr.reshape(g, length))
+            return [int(c) for c in np.asarray(
+                _batch_jit(bitmat, blobs, const))]
+    parts = np.asarray(crc32c_rows(_planar_spread(arr), seed=0),
+                       dtype=np.uint32).reshape(g, 8)
+    folded = np.bitwise_xor.reduce(parts, axis=1)
+    head = np.uint32(crc32c_zeros(seed, length))
+    return [int(c) for c in (folded ^ head)]

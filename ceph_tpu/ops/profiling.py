@@ -59,6 +59,29 @@ def record_planar_convert(direction: str, payload_bytes: int) -> None:
     KERNELS.inc("planar_convert_bytes", int(payload_bytes))
 
 
+def record_planar_at_rest(event: str, payload_bytes: int) -> None:
+    """Planar AT-REST conversion telemetry (round 19).
+
+    With ``osd_ec_planar_at_rest=1`` shards are stored as packed
+    bit-planes, so layout conversions may happen ONLY at the sanctioned
+    seams.  ``event`` names which seam booked the conversion:
+
+    - ``ingest``:  client bytes -> planes at the coalesced encode (the
+      one unavoidable conversion per write tick);
+    - ``egress``:  planes -> logical client bytes at the read assemble
+      (the one unavoidable conversion per read);
+    - ``relayout``: a mixed-generation transition (byte-at-rest object
+      met a planar write or vice versa after the config gate flipped) —
+      legal but expected to be rare;
+    - ``unseamed``: a byte view materialized OUTSIDE the seams (e.g. a
+      raw ``store.read`` of a planar object).  The steady-state
+      contract pins this counter to ZERO; tests assert it stays there
+      across write/read/RMW/recovery/deep-scrub.
+    """
+    KERNELS.inc(f"ec_planar_{event}_conversions")
+    KERNELS.inc(f"ec_planar_{event}_bytes", int(payload_bytes))
+
+
 def device_loop_slope(step, feedback, data, repeats: int = 3,
                       L1: int = 300, L2: int = 1200,
                       tag: Optional[str] = None):

@@ -78,6 +78,14 @@ EVENT_STAGE = {
     # objecter_batch_tick_ops > 0
     "objecter:batch_tick": "client_batch_wait",
     "objecter:batch_sent": "client_batch_send",
+    # planar at rest (round 19): the two SANCTIONED layout hops — the
+    # coalesced encode's client-bytes -> planes ingest and the read
+    # assemble's planes -> client-bytes egress — book as planar_convert
+    # so `bench.py --attribute` shows exactly what the at-rest format
+    # costs (steady-state shard traffic between them is conversion-free
+    # by contract; the pinned counter proves it)
+    "planar_ingest": "planar_convert",
+    "planar_egress": "planar_convert",
 }
 
 

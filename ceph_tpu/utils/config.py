@@ -273,6 +273,14 @@ OPTIONS: List[Option] = [
     # ec
     Option("osd_ec_batch_size", int, 64, "stripes per device dispatch"),
     Option("osd_ec_stripe_unit", int, 4096),
+    # bit-planar AT-REST shards (round 19): EC shard objects are stored,
+    # shipped (sub-writes/sub-reads/recovery push), and verified as
+    # packed bit-plane matrices — zero layout conversions on the
+    # steady-state write/read/RMW/recovery/scrub paths (pinned by the
+    # ec_planar_unseamed counter).  0 = byte-at-rest, the
+    # bisection/bit-exactness anchor; requires w=8 matrix codecs and
+    # stripe_unit % 8 == 0 (else the OSD quietly stays on bytes).
+    Option("osd_ec_planar_at_rest", int, 0, min=0, max=1),
     # route EC pool batch encode/decode through the sharded mesh engine
     # (parallel/engine.py): "on" = use a device mesh, "off" = the
     # single-device codec engines.  ("on" needs >1 jax device; the mesh

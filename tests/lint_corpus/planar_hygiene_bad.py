@@ -1,0 +1,30 @@
+"""planar-conversion-hygiene BAD corpus: at-rest layout conversions
+outside the sanctioned seams (linted as if under ceph_tpu/cluster/)."""
+
+from ceph_tpu.ec import planar_store
+from ceph_tpu.ops import gf8
+
+
+class BadStore:
+    def raw_transform_in_cluster(self, batch):
+        # raw layout transform: belongs in the ec/ kernel seam modules
+        return gf8.to_planar(batch)
+
+    def raw_row_transform(self, rows):
+        return planar_store.rows_to_planes(rows)
+
+    def undeclared_seam(self, blob):
+        # no seam= declaration: the silent convert-per-hop this rule
+        # exists to catch
+        return planar_store.shard_to_planes(blob)
+
+    def undeclared_egress(self, planes):
+        return planar_store.planes_to_shard(planes)
+
+    def unseamed_byte_view(self, planes):
+        # declared unseamed: books the PINNED counter — needs a pragma
+        # and a story, like the store read() fallbacks
+        return planar_store.planes_to_shard(planes, seam="unseamed")
+
+    async def undeclared_in_async(self, blob):
+        return planar_store.shard_to_planes(blob)

@@ -1,0 +1,25 @@
+"""planar-conversion-hygiene GOOD corpus: seam-declared transitions
+and reshape-only blob views (linted as if under ceph_tpu/cluster/)."""
+
+from ceph_tpu.ec import planar_store
+
+
+class GoodStore:
+    def declared_relayout(self, blob):
+        # a mixed-generation transition declaring which seam books it
+        return planar_store.shard_to_planes(blob, seam="relayout")
+
+    def declared_store_side(self, raw):
+        # seam=None: the caller explicitly defers the booking to the
+        # store op that lands the planes (still a declared decision)
+        return planar_store.shard_to_planes(raw, seam=None)
+
+    def reshape_only(self, blob, planes):
+        # blob_to_planes / planes_to_blob are views of the SAME bytes,
+        # not conversions — never flagged
+        m = planar_store.blob_to_planes(blob)
+        return planar_store.planes_to_blob(planes), m
+
+    def pragma_suppressed_unseamed(self, planes):
+        return planar_store.planes_to_shard(  # graftlint: ignore[planar-conversion-hygiene]
+            planes, seam="unseamed")

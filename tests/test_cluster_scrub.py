@@ -122,7 +122,14 @@ def test_scrub_repairs_corrupt_ec_shard():
             report = await cluster.osds[primary].scrub_pg(st)
             assert report["inconsistent"] == ["obj"]
             assert report["repaired"] == ["obj"]
-            await asyncio.sleep(0.2)
+            # repair lands asynchronously on the victim: converge-poll
+            # against a wall deadline instead of a fixed sleep
+            deadline = asyncio.get_event_loop().time() + 10
+            while asyncio.get_event_loop().time() < deadline:
+                if bytes(cluster.osds[victim].store.read(
+                        _coll(pgid), "obj")) == before:
+                    break
+                await asyncio.sleep(0.05)
             after = bytes(cluster.osds[victim].store.read(
                 _coll(pgid), "obj"))
             assert after == before

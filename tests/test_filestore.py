@@ -141,7 +141,18 @@ def test_cluster_full_restart_zero_pushes(tmp_path):
                 if all(cluster.mon.osdmap.osd_up[o] for o in ids):
                     break
                 await asyncio.sleep(0.05)
-            await asyncio.sleep(1.0)  # peering window
+            # peering window: converge-poll the first read against a
+            # wall deadline instead of a fixed sleep
+            deadline = asyncio.get_event_loop().time() + 15
+            first = next(iter(payloads))
+            while asyncio.get_event_loop().time() < deadline:
+                try:
+                    if await rio.read(first, timeout=5) \
+                            == payloads[first]:
+                        break
+                except Exception:
+                    pass
+                await asyncio.sleep(0.05)
 
             for oid, data in payloads.items():
                 assert await rio.read(oid) == data, oid

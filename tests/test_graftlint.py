@@ -18,7 +18,8 @@ import pytest
 
 from ceph_tpu.analysis import baseline as baseline_mod
 from ceph_tpu.analysis import (
-    asyncio_rules, engine, jax_hygiene, lockgraph, symmetry, taskspawn,
+    asyncio_rules, engine, jax_hygiene, lockgraph, planar_hygiene,
+    symmetry, taskspawn,
 )
 from ceph_tpu.utils.lockdep import DepLock, LockCycleError, LockDep
 
@@ -579,3 +580,62 @@ def test_stale_baseline_reported(tmp_path):
                              root=str(tmp_path))
     assert report.ok
     assert report.stale_baseline == ["ghost::entry::s::m"]
+
+
+# ------------------------------------------- planar-conversion-hygiene
+
+
+def test_planar_hygiene_good_clean():
+    """Seam-declared transitions and reshape-only blob views pass; the
+    one deliberately-unseamed fixture line carries a pragma the engine
+    (not the raw rule) drops — mirroring the store read() fallbacks."""
+    findings, _ = lint_files(
+        planar_hygiene, "planar_hygiene_good.py",
+        relpath_as="ceph_tpu/cluster/store.py")
+    # the raw rule still sees the pragma'd unseamed call …
+    assert [f for f in findings if "unseamed" not in f.message] == [], \
+        [f.render() for f in findings]
+    # … and the engine's pragma pass is what suppresses it
+    modules, _ = engine.load_modules([corpus("planar_hygiene_good.py")])
+    (m,) = modules
+    assert all(m.pragma_suppressed(f.rule, f.line) for f in findings)
+
+
+def test_planar_hygiene_bad_all_shapes_fire():
+    findings, _ = lint_files(
+        planar_hygiene, "planar_hygiene_bad.py",
+        relpath_as="ceph_tpu/cluster/store.py")
+    msgs = "\n".join(f.message for f in findings)
+    # raw transforms, undeclared seams (sync AND async), and the
+    # declared-unseamed byte view all fire
+    assert "raw layout transform to_planar()" in msgs
+    assert "raw layout transform rows_to_planes()" in msgs
+    assert "shard_to_planes() without an explicit seam=" in msgs
+    assert "planes_to_shard() without an explicit seam=" in msgs
+    assert 'seam="unseamed"' in msgs
+    assert len(findings) == 6, [f.render() for f in findings]
+
+
+def test_planar_hygiene_scoped_to_cluster():
+    """Scope pin: the rule polices cluster/ modules only, and the tick
+    coalescer — the sanctioned dispatch seam — is exempt by name."""
+    for relpath in ("ceph_tpu/ec/planar_store.py",
+                    "ceph_tpu/ops/gf8.py",
+                    "tests/test_ec_planar.py",
+                    "ceph_tpu/cluster/batcher.py"):
+        findings, _ = lint_files(
+            planar_hygiene, "planar_hygiene_bad.py",
+            relpath_as=relpath)
+        assert findings == [], (relpath, [f.render() for f in findings])
+
+
+def test_planar_hygiene_zero_baseline_debt():
+    """Round-19 contract: the at-rest refactor landed with ZERO
+    planar-conversion-hygiene baseline entries — every conversion in
+    cluster/ is seam-declared or pragma'd at a documented fallback."""
+    baseline = baseline_mod.load_baseline(
+        baseline_mod.default_baseline_path())
+    assert not any(k.startswith("planar-conversion-hygiene::")
+                   for k in baseline)
+    report = engine.run_lint(rules=[planar_hygiene])
+    assert report.findings == [], "\n" + report.render_text()
