@@ -193,11 +193,12 @@ class LintContext:
 def all_rules():
     """The registered rule families, import-cycle-free."""
     from ceph_tpu.analysis import async_errors, asyncio_rules, \
-        device_dispatch, jax_hygiene, lockgraph, planar_hygiene, \
-        rpc_timeout, symmetry, taskspawn
+        awaitrace, device_dispatch, jax_hygiene, lockgraph, \
+        planar_hygiene, rpc_timeout, symmetry, taskspawn, testsleep
 
     return [lockgraph, jax_hygiene, symmetry, asyncio_rules, taskspawn,
-            rpc_timeout, device_dispatch, async_errors, planar_hygiene]
+            rpc_timeout, device_dispatch, async_errors, planar_hygiene,
+            awaitrace, testsleep]
 
 
 # cached last report (admin socket `graftlint report` serves this)

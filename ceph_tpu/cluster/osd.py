@@ -19,6 +19,7 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set, Tuple
 
+from ceph_tpu.analysis import racecheck
 from ceph_tpu.cluster import messages as M
 from ceph_tpu.cluster import pglog
 from ceph_tpu.cluster.messenger import (
@@ -1379,6 +1380,12 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                         # peering rules on each (roll forward / rewind)
                         self._frontier_rebuild(st)
                         self.pgs[pgid] = st
+                        if racecheck.TRACKER:  # graft-race: registry
+                            # entry REPLACED — in-flight ack waits
+                            # holding the old PGState are now stale
+                            racecheck.TRACKER.note_write(
+                                ("pgs", self.osd_id, str(pgid)),
+                                "registry")
                         if actp == self.osd_id:
                             to_peer.add(pgid)
                     else:
@@ -1392,6 +1399,10 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                 elif old is not None:
                     del self.pgs[pgid]
                     changed = True
+                    if racecheck.TRACKER:  # graft-race: the PG left
+                        # this OSD — snapshots of its state went stale
+                        racecheck.TRACKER.note_write(
+                            ("pgs", self.osd_id, str(pgid)), "registry")
         # pools deleted from the map: drop their PGs AND their data
         # (reference: pool deletion queues PG removal + collection nuke).
         # Sweep by STORE collection, not just live PGState — collections

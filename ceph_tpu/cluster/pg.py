@@ -20,6 +20,7 @@ from ceph_tpu.cluster import pglog
 from ceph_tpu.cluster.pglog import LogEntry, PGInfo, PGLog
 from ceph_tpu.cluster.store import Transaction
 from ceph_tpu.osdmap.osdmap import PGid, ceph_stable_mod
+from ceph_tpu.analysis import racecheck
 from ceph_tpu.utils.lockdep import DepLock
 
 # the client reqid whose op vector is currently executing (set around
@@ -173,6 +174,12 @@ class PGLogMixin:
                              client_reqid=CURRENT_CLIENT_REQID.get())
         st.log.append(entry)
         st.last_update = version
+        if racecheck.TRACKER:  # graft-race: the log head advanced —
+            # any other task still resting on a round-start self-info
+            # snapshot (recovery's roll-forward floor) is now stale
+            racecheck.TRACKER.note_write(
+                ("pg", getattr(self, "osd_id", -1), str(st.pgid)),
+                "self_info")
         dropped = st.log.trim()
         coll = _coll(st.pgid)
         txn = (Transaction()
@@ -289,6 +296,12 @@ class PGLogMixin:
         PENDING entry — an out-of-order later ack blessing bytes that
         can still fail and roll back would break read-your-ack."""
         st.pipeline_pending[version] = False
+        if racecheck.TRACKER:  # graft-race: the commit's registry
+            # snapshot window OPENS here — `st` will outlive the PG
+            # lock through the ack wait
+            racecheck.TRACKER.note_read(
+                ("pgs", getattr(self, "osd_id", -1), str(st.pgid)),
+                "registry")
 
     def _frontier_done(self, st: PGState, version: pglog.Eversion,
                        ok: bool) -> None:
@@ -297,6 +310,16 @@ class PGLogMixin:
         removed without blocking later acked entries — the pre-pipeline
         semantics, where a later fully-acked op advanced past an earlier
         failed one and peering owns the failed entry's fate."""
+        if racecheck.TRACKER:  # graft-race: the snapshot window
+            # CLOSES — resolution re-consults the registry downstream
+            # (_advance_last_complete's identity re-check is the guard
+            # this attests), so a registry swap during the ack wait is
+            # revalidated, not acted on blind.  A commit task that
+            # finishes without ever resolving its frontier entry keeps
+            # the window open and convicts under the race smoke.
+            racecheck.TRACKER.note_read(
+                ("pgs", getattr(self, "osd_id", -1), str(st.pgid)),
+                "registry")
         fl = st.pipeline_pending
         if version not in fl:
             # unregistered caller (recovery / roll-forward, or a commit
@@ -323,6 +346,25 @@ class PGLogMixin:
             st.frontier_recovering.discard(v)
         if new is not None:
             self._advance_last_complete(st, new)
+        self._frontier_rearm_if_short(st)
+
+    def _frontier_rearm_if_short(self, st: PGState) -> None:
+        """A DRAINED frontier with the watermark still short of the log
+        head means some resolution failed (sub-write acks lost to a
+        drop or a mid-fanout crash): no later ack will ever arrive for
+        those entries and no map change is due, so without a kick the
+        primary stays incomplete until an unrelated epoch — permanently
+        on an idle pool (graft-race: batch-smoke at small scale wedges
+        exactly here once the last round's acks are gone).  Peering's
+        roll-forward owns the failed entries' fate — arm the
+        capped-backoff recovery retry and let it rule on each."""
+        if st.pipeline_pending or st.last_complete >= st.last_update:
+            return
+        if st.primary != getattr(self, "osd_id", -1):
+            return
+        retry = getattr(self, "_queue_recovery_retry", None)
+        if retry is not None:
+            retry(st)
 
     def _advance_last_complete(self, st: PGState, version: pglog.Eversion,
                                txn: Optional[Transaction] = None) -> None:
@@ -394,19 +436,30 @@ class PGLogMixin:
                 rec = pickle.loads(rec_blob)
                 if not rec["existed"]:
                     txn.remove(coll, rec["oid"])
-                elif rec.get("layout") == "planar8":
-                    # planar-at-rest object: old_range IS the captured
-                    # plane blob — restore it AS planes (a byte write
-                    # would land the blob as logical bytes and drop the
-                    # layout); capture is whole-object (chunk_off 0)
-                    txn.write_planar(coll, rec["oid"],
-                                     rec["chunk_off"] // 8,
-                                     rec["old_range"],
-                                     rec["old_total"] // 8)
                 else:
-                    txn.write(coll, rec["oid"], rec["chunk_off"],
-                              rec["old_range"])
-                    txn.truncate(coll, rec["oid"], rec["old_total"])
+                    if rec.get("layout") == "planar8":
+                        # planar-at-rest object: old_range IS the
+                        # captured plane blob — restore it AS planes (a
+                        # byte write would land the blob as logical
+                        # bytes and drop the layout); capture is
+                        # whole-object (chunk_off 0)
+                        txn.write_planar(coll, rec["oid"],
+                                         rec["chunk_off"] // 8,
+                                         rec["old_range"],
+                                         rec["old_total"] // 8)
+                    else:
+                        txn.write(coll, rec["oid"], rec["chunk_off"],
+                                  rec["old_range"])
+                        txn.truncate(coll, rec["oid"], rec["old_total"])
+                    # attrs + version roll back WITH the bytes on BOTH
+                    # layouts: restoring planes while the divergent
+                    # write's size/hinfo_crc/version attrs stay stamped
+                    # leaves old data under a new crc, and the member
+                    # fails verify-on-read forever after — an
+                    # unrepairable-object wedge when it strikes more
+                    # members than the code can spare (graft-race:
+                    # batch-smoke seed 2, mid-fanout crash rewind on
+                    # two of k+m=3 members)
                     for name, val in rec["old_attrs"].items():
                         if val is None:
                             txn.rmattr(coll, rec["oid"], name)

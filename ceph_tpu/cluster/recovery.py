@@ -8,6 +8,7 @@ import asyncio
 import pickle
 from typing import Dict
 
+from ceph_tpu.analysis import racecheck
 from ceph_tpu.cluster import messages as M
 from ceph_tpu.cluster import pglog
 from ceph_tpu.cluster.pglog import PGInfo, PGLog
@@ -171,6 +172,11 @@ class RecoveryMixin:
         members = [o for o in st.acting
                    if o not in (self.osd_id, CRUSH_ITEM_NONE)]
         infos: Dict[int, PGInfo] = {self.osd_id: st.info()}
+        if racecheck.TRACKER:  # graft-race: round-start self-info
+            # snapshot — the roll-forward floor must NOT rest on it
+            # after the member awaits below (the PR-11 bug class)
+            racecheck.TRACKER.note_read(
+                ("pg", self.osd_id, str(st.pgid)), "self_info")
         logs: Dict[int, PGLog] = {self.osd_id: st.log}
         inventories: Dict[int, Dict[str, int]] = {}
         complete = True
@@ -271,6 +277,11 @@ class RecoveryMixin:
         # and nothing ever re-arms it (round 14: the re-peer-all
         # stampede that used to paper over this is gone by design)
         infos[self.osd_id] = st.info()
+        if racecheck.TRACKER:  # graft-race: the PR-11 fix — the
+            # re-read revalidates the round-start snapshot; reverting
+            # it re-convicts under the race smoke
+            racecheck.TRACKER.note_read(
+                ("pg", self.osd_id, str(st.pgid)), "self_info")
         live = [o for o in st.acting if o != CRUSH_ITEM_NONE]
         # EC undersized guard (round 12): with fewer than min_size live
         # members, "every member holds it" is vacuous — rolling the
@@ -295,6 +306,13 @@ class RecoveryMixin:
                         infos.pop(osd, None)
                         continue
                     infos[osd] = reply.info or PGInfo()
+                # the re-query AWAITED: acting can have changed while
+                # the replies trickled in, and a member that joined
+                # mid-round has no info row — re-read it so the
+                # every-live-member-reported gate judges the membership
+                # the roll-forward will actually cover (graft-race:
+                # stale-snapshot-across-await on the round-start `live`)
+                live = [o for o in st.acting if o != CRUSH_ITEM_NONE]
                 if all(o in infos for o in live):
                     floor = min(i.last_update for i in infos.values())
             floor = min(floor, st.last_update)

@@ -457,6 +457,13 @@ async def start_cluster(n_osds: int = 3, osds_per_host: int = 1,
     import pickle as _pickle
 
     config = config or _fast_config()
+    if getattr(config, "race_check_enabled", 0):
+        # arm the process-global write-after-read tracker (graft-race);
+        # race_run installs its own tracker+shim pair, so only arm when
+        # nothing is installed yet — a boot must not wipe a run's state
+        from ceph_tpu.analysis import racecheck
+        if not racecheck.TRACKER:
+            racecheck.install(racecheck.from_config(config))
     n_hosts = (n_osds + osds_per_host - 1) // osds_per_host
     cmap, _ = build_hierarchy(n_hosts, osds_per_host, numrep=3)
     osdmap = OSDMap(cmap, max_osd=n_osds)

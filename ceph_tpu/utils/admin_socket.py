@@ -99,6 +99,11 @@ class AdminSocket:
                       lambda cmd: _chaos_report(config),
                       "injected-fault counters + this daemon's active "
                       "chaos options")
+        self.register("race report", lambda cmd: _race_report(),
+                      "graft-race tracker state: probe counts, ticks, "
+                      "and write-after-read convictions with both "
+                      "task stacks (disabled payload when no tracker "
+                      "is installed)")
 
 
 def _chaos_report(config):
@@ -107,6 +112,15 @@ def _chaos_report(config):
     from ceph_tpu.chaos.counters import chaos_report
 
     return chaos_report(config)
+
+
+def _race_report():
+    """The process-wide graft-race tracker's report: NULL_RACE serves
+    its disabled payload, so the command never errors when the
+    sanitizer is off (the blackbox-dump contract)."""
+    from ceph_tpu.analysis import racecheck
+
+    return racecheck.TRACKER.report()
 
 
 def _lockdep_dump(cmd):

@@ -215,6 +215,21 @@ OPTIONS: List[Option] = [
     Option("mon_health_history", int, 128,
            "health-transition records kept in the mon's bounded "
            "history ring (served by 'health history')", min=1),
+    # graft-race (ceph_tpu/analysis/racecheck.py + utils/schedfuzz.py):
+    # the seeded schedule-perturbation sanitizer.  Default-off keeps the
+    # provable-no-op contract: the module-global probe target stays the
+    # falsy NULL_RACE singleton and every cluster probe site is one
+    # truthiness test (pinned by tests/test_racecheck.py).
+    Option("race_check_enabled", int, 0,
+           "arm the cross-task write-after-read tracker at the cluster "
+           "probe seams (0 = off: provable no-op; 1 = vstart boot arms "
+           "the process-global tracker, served by 'race report'; race "
+           "runs install their own tracker + the SchedFuzzLoop shim)",
+           min=0, max=1),
+    Option("race_check_seed", int, 0,
+           "seed for the schedule-perturbation rng stream and the "
+           "tracker it reports under (chaos-rng derived: replays "
+           "bit-identically)", min=0),
     # mon
     Option("mon_osd_down_out_interval", float, 30.0,
            "auto-out after down this long"),

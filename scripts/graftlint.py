@@ -7,12 +7,21 @@
     python scripts/graftlint.py --write-baseline  # accept current findings
     python scripts/graftlint.py --runtime-edges dump.json  # merge a live
         cluster's `lockdep dump` edges into the static lock graph
+    python scripts/graftlint.py --race batch-smoke --seeds 1,2,3
+        # dynamic half: run the scenario under the seeded
+        # schedule-perturbation loop with the write-after-read tracker
+        # armed, once per seed
 
 Exit status: 0 when every finding is baselined (or none fire), 1
 otherwise — tier-1 runs this over the repo and fails on anything new.
+``--race`` keeps the same contract (0 clean / 1 convictions or
+scenario failures) and adds 2 for usage errors (unknown scenario,
+unparsable seed list), so CI can tell "found a race" from "asked
+wrong".
 
-Pure AST analysis: no jax import, no device, safe under
-JAX_PLATFORMS=cpu and on machines with no accelerator at all.
+The static modes are pure AST analysis: no jax import, no device, safe
+under JAX_PLATFORMS=cpu and on machines with no accelerator at all.
+``--race`` boots real (in-process) clusters and takes seconds per seed.
 """
 
 import argparse
@@ -25,6 +34,53 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from ceph_tpu.analysis import baseline as baseline_mod  # noqa: E402
 from ceph_tpu.analysis import engine  # noqa: E402
 from ceph_tpu.analysis import lockgraph  # noqa: E402
+
+
+def _race_main(args) -> int:
+    """The --race driver: seeds x one scenario through racecheck.race_run.
+
+    2 = usage error (bad seed list, unknown scenario), 1 = any seed's
+    verdict failed or the tracker convicted, 0 = all seeds clean."""
+    from ceph_tpu.analysis import racecheck
+
+    try:
+        seeds = [int(s) for s in args.seeds.split(",") if s.strip()]
+        if not seeds:
+            raise ValueError("empty seed list")
+    except ValueError as e:
+        print(f"graftlint --race: bad --seeds {args.seeds!r}: {e}",
+              file=sys.stderr)
+        return 2
+    runs = []
+    for seed in seeds:
+        try:
+            verdict, report, digest = racecheck.race_run(
+                args.race, seed, shrink=not args.full_scale)
+        except KeyError:
+            print(f"graftlint --race: unknown scenario {args.race!r} "
+                  "(scripts/chaos.py list)", file=sys.stderr)
+            return 2
+        runs.append({"seed": seed, "passed": verdict.passed,
+                     "failures": list(verdict.failures),
+                     "race": report, "trace_digest": digest})
+    bad = sum(1 for r in runs
+              if not r["passed"] or r["race"]["findings"])
+    if args.as_json:
+        print(json.dumps({"scenario": args.race, "runs": runs,
+                          "ok": bad == 0}, indent=2, default=str))
+    else:
+        for r in runs:
+            nf = len(r["race"]["findings"])
+            status = "ok" if r["passed"] and not nf else "FAIL"
+            print(f"{args.race} seed={r['seed']}: {status} "
+                  f"(findings={nf}, ticks={r['race']['ticks']}, "
+                  f"reads={r['race']['reads']}, "
+                  f"writes={r['race']['writes']})")
+            for f in r["failures"]:
+                print(f"    invariant: {f}")
+            for f in r["race"]["findings"]:
+                print(f"    race: {f['message']}")
+    return 1 if bad else 0
 
 
 def main(argv=None) -> int:
@@ -47,7 +103,21 @@ def main(argv=None) -> int:
                          "mapping) to merge into the static lock graph")
     ap.add_argument("--dot", metavar="FILE",
                     help="write the merged lock-order graph as DOT")
+    ap.add_argument("--race", metavar="SCENARIO",
+                    help="dynamic mode: run SCENARIO under the seeded "
+                         "schedule-perturbation loop with the "
+                         "write-after-read tracker armed (once per "
+                         "--seeds entry); skips the static lint")
+    ap.add_argument("--seeds", default="1,2,3",
+                    help="comma-separated seeds for --race "
+                         "(default: 1,2,3)")
+    ap.add_argument("--full-scale", action="store_true",
+                    help="--race at the scenario's full workload scale "
+                         "(default: the shrunk smoke scale)")
     args = ap.parse_args(argv)
+
+    if args.race:
+        return _race_main(args)
 
     runtime_edges = None
     if args.runtime_edges:

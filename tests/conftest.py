@@ -36,13 +36,15 @@ import pytest  # noqa: E402
 
 
 def pytest_collection_modifyitems(items):
-    """``soak`` is slow-implied (pytest.ini): every soak-marked test
-    also gets ``slow``, so the tier-1 gate's ``-m 'not slow'`` always
-    deselects soaks without each test having to remember both marks —
-    a soak accidentally landing on the bench hot path would violate
+    """``soak`` and ``race`` are slow-implied (pytest.ini): every test
+    carrying either mark also gets ``slow``, so the tier-1 gate's
+    ``-m 'not slow'`` always deselects them without each test having
+    to remember both marks — a soak (or a full-scale race-sanitizer
+    scenario) accidentally landing on the bench hot path would violate
     the BENCH_NOTES round-13 contract."""
     for item in items:
-        if "soak" in item.keywords and "slow" not in item.keywords:
+        if ("soak" in item.keywords or "race" in item.keywords) \
+                and "slow" not in item.keywords:
             item.add_marker(pytest.mark.slow)
 
 

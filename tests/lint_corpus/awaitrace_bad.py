@@ -1,0 +1,54 @@
+"""await-atomicity bad corpus: one conviction per rule variant.
+
+Linted with relpath ceph_tpu/cluster/awaitrace_bad.py — the rule is
+cluster/-scoped.  Every shape here is an await-interleaving race:
+shared cluster state snapshotted, an await, then action on the stale
+snapshot.
+"""
+
+from ceph_tpu.utils.lockdep import DepLock
+
+
+class PG:
+    def __init__(self):
+        self.lock = DepLock("pg.lock")
+        self.pgs = {}
+        self.acting = []
+        self.pipeline_pending = {}
+
+    # variant (a): stale-snapshot-across-await — `st` is the PGState
+    # this PG *was*; after the ack-wait await it may have been
+    # superseded (the PR-9 bug shape), yet the watermark advance goes
+    # through the stale snapshot with no revalidation
+    async def stale_snapshot(self, pgid, version):
+        st = self.pgs[pgid]
+        await self._wait_acks(version)
+        st.last_complete = version
+
+    # variant (b): check-then-act-across-await — the absent check
+    # passes, the await yields, ANOTHER task registers the entry, and
+    # the insert clobbers it: the checked predicate no longer held
+    # when the act ran
+    async def check_then_act(self, pgid, entry):
+        if entry not in self.pipeline_pending:
+            await self._fan_out(entry)
+            self.pipeline_pending[entry] = pgid
+        return None
+
+    # variant (c): lock-window-escape — `head` is consistent only
+    # while pg.lock is held; flowing it past the lock release and
+    # acting on it re-creates the race the lock existed to prevent
+    async def lock_window_escape(self, pgid):
+        async with self.lock:
+            head = self.pipeline_pending[pgid]
+        await self._sync(pgid)
+        return head.version
+
+    async def _wait_acks(self, version):
+        return version
+
+    async def _fan_out(self, entry):
+        return entry
+
+    async def _sync(self, pgid):
+        return pgid

@@ -1,0 +1,85 @@
+"""await-atomicity good corpus: the same three shapes, revalidated —
+the rule must stay quiet on every one.
+
+Linted with relpath ceph_tpu/cluster/awaitrace_good.py.
+"""
+
+from ceph_tpu.utils.lockdep import DepLock
+
+
+class PG:
+    def __init__(self):
+        self.lock = DepLock("pg.lock")
+        self.pgs = {}
+        self.acting = []
+        self.pipeline_pending = {}
+
+    # (a) revalidated by the identity re-check (the PR-9 fix shape):
+    # the test mentions both the snapshot name and its watched source
+    async def snapshot_revalidated(self, pgid, version):
+        st = self.pgs[pgid]
+        await self._wait_acks(version)
+        if self.pgs.get(pgid) is not st:
+            return None
+        st.last_complete = version
+
+    # (a) revalidated by re-binding after the await
+    async def snapshot_rebound(self, pgid, version):
+        st = self.pgs[pgid]
+        await self._wait_acks(version)
+        st = self.pgs[pgid]
+        st.last_complete = version
+
+    # (a) no await between snapshot and use: plain sequential code
+    async def snapshot_no_await(self, pgid, version):
+        st = self.pgs[pgid]
+        st.last_complete = version
+        await self._wait_acks(version)
+
+    # (a) the awaits sit in guard clauses that return — executions
+    # that suspended never reach the use, so nothing goes stale
+    async def snapshot_guard_clause(self, pgid, version):
+        st = self.pgs[pgid]
+        if st is None:
+            await self._wait_acks(version)
+            return None
+        return st.last_update
+
+    # (a) the "use" is an argument of the await expression itself:
+    # it evaluates BEFORE the suspension
+    async def snapshot_in_await_args(self, pgid, version):
+        st = self.pgs[pgid]
+        return await self._wait_acks(st.last_update)
+
+    # (b) the conditional re-checks the watched state after the await,
+    # before mutating through it
+    async def check_act_rechecked(self, pgid, entry):
+        if entry not in self.pipeline_pending:
+            await self._fan_out(entry)
+            if entry not in self.pipeline_pending:
+                self.pipeline_pending[entry] = pgid
+        return None
+
+    # (c) the captured value is re-bound after the lock window closes
+    async def lock_window_rebound(self, pgid):
+        async with self.lock:
+            head = self.pipeline_pending[pgid]
+        await self._sync(pgid)
+        head = self.pipeline_pending[pgid]
+        return head.version
+
+    # (c) the whole use stays inside the lock window
+    async def lock_window_contained(self, pgid):
+        async with self.lock:
+            head = self.pipeline_pending[pgid]
+            await self._sync(pgid)
+            return head.version
+
+    async def _wait_acks(self, version):
+        return version
+
+    async def _fan_out(self, entry):
+        return entry
+
+    async def _sync(self, pgid):
+        return pgid

@@ -1,0 +1,45 @@
+"""Historical-race fixture: PR 9's superseded-PGState ack-wait.
+
+The bug this repo actually paid for (found then by a lucky chaos
+seed): ``_advance_last_complete`` snapshotted a PGState, awaited the
+shard-ack fan-out, and persisted the commit watermark through the
+snapshot — but a crash-restart + re-peer during the ack wait had
+REPLACED the registry entry, so the watermark landed on a PGState the
+PG had already left, wedging last_complete behind last_update forever.
+
+``buggy_pr9_shape`` is the pre-fix code shape — the await-atomicity
+rule must convict it.  ``fixed_pr9_shape`` carries the shipped fix
+(the ``pgs.get(pgid) is not st`` identity re-check) — the rule must
+stay quiet on it.  Linted with relpath
+ceph_tpu/cluster/awaitrace_hist_pgstate.py.
+"""
+
+
+class OSD:
+    def __init__(self):
+        self.pgs = {}
+
+    async def buggy_pr9_shape(self, pgid, version, txn):
+        st = self.pgs[pgid]
+        await self._wait_shard_acks(st, version)
+        # stale `st`: the ack wait yielded, a restart re-registered the
+        # PG, and this persists the watermark onto the superseded state
+        st.last_complete = version
+        await self._persist_watermark(txn, version)
+
+    async def fixed_pr9_shape(self, pgid, version, txn):
+        st = self.pgs[pgid]
+        await self._wait_shard_acks(st, version)
+        pgs = self.pgs
+        if pgs is not None and pgs.get(pgid) is not st:
+            # superseded while we awaited: the NEW incarnation owns the
+            # watermark now (the PR-9 fix)
+            return None
+        st.last_complete = version
+        await self._persist_watermark(txn, version)
+
+    async def _wait_shard_acks(self, st, version):
+        return version
+
+    async def _persist_watermark(self, txn, version):
+        return version

@@ -1,0 +1,41 @@
+"""Historical-race fixture: PR 11's stale self-info roll-forward floor.
+
+The peering round snapshotted its OWN log head at round start, then
+pushed deltas to members — awaits that race pipelined commits
+advancing the head.  The roll-forward floor then rested on the
+round-start snapshot, pinning last_complete below entries every member
+verifiably held: the round ended complete with the watermark wedged,
+and nothing ever re-armed it.
+
+``buggy_pr11_shape`` is the pre-fix shape — the await-atomicity rule
+must convict it.  ``fixed_pr11_shape`` re-reads the current self state
+after the awaits (the shipped fix) — the rule must stay quiet.
+Linted with relpath ceph_tpu/cluster/awaitrace_hist_selfinfo.py.
+"""
+
+
+class Recovery:
+    async def buggy_pr11_shape(self, st, members):
+        # round-start snapshot of our own log head
+        my_head = st.last_update
+        for osd in members:
+            # racing pipelined commits advance st.last_update under us
+            await self._push_delta(osd, st)
+        # roll-forward floor rests on the ROUND-START head: a stale
+        # self info pins last_complete below entries every member holds
+        floor = my_head
+        if floor > st.last_complete:
+            st.last_complete = floor
+
+    async def fixed_pr11_shape(self, st, members):
+        my_head = st.last_update
+        for osd in members:
+            await self._push_delta(osd, st)
+        # the PR-11 fix: the floor rests on the CURRENT self state
+        my_head = st.last_update
+        floor = my_head
+        if floor > st.last_complete:
+            st.last_complete = floor
+
+    async def _push_delta(self, osd, st):
+        return osd

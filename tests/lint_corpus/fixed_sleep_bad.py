@@ -1,0 +1,22 @@
+"""fixed-sleep-in-tests bad corpus: every sleep here is a bare timing
+guess.  Linted with relpath tests/fixed_sleep_bad.py — the rule is
+tests/-scoped.
+"""
+
+import asyncio
+import time
+
+
+async def waits_a_guessed_duration():
+    # 1: classic flake: hope 0.1 s outlasts the replica apply
+    await asyncio.sleep(0.1)
+
+
+async def waits_a_whole_second():
+    # 2: bigger guess, same smell
+    await asyncio.sleep(1)
+
+
+def blocks_the_suite():
+    # 3: synchronous flavour
+    time.sleep(0.5)

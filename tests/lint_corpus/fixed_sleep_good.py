@@ -1,0 +1,40 @@
+"""fixed-sleep-in-tests good corpus: the sanctioned shapes the rule
+must stay quiet on.  Linted with relpath tests/fixed_sleep_good.py.
+"""
+
+import asyncio
+import time
+
+
+async def converge_poll(cond):
+    # constant sleep INSIDE a while loop: the poll interval of a
+    # wall-deadline converge-poll — the repo's sanctioned wait
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + 5.0
+    while loop.time() < deadline and not cond():
+        await asyncio.sleep(0.02)
+    assert cond()
+
+
+async def bounded_retry(cond):
+    # for-loop polling: same shape, counted instead of wall-bounded
+    for _ in range(100):
+        if cond():
+            break
+        await asyncio.sleep(0.05)
+
+
+async def pure_yield():
+    # sleep(0) is a cooperative yield, not a wait
+    await asyncio.sleep(0)
+
+
+async def variable_duration(dt):
+    # non-literal durations are the caller's contract, not a guess
+    await asyncio.sleep(dt)
+
+
+def paced_on_purpose():
+    # genuinely time-semantic pacing carries the pragma + the reason:
+    # two wall-clock stamps must differ for the assertion downstream
+    time.sleep(0.01)  # graftlint: ignore[fixed-sleep-in-tests]

@@ -34,7 +34,6 @@ def test_ec_shard_bitrot_detected_and_repaired_by_scrub():
             io = client.ioctx(pool)
             payload = bytes(range(256)) * 24
             await io.write_full("victim", payload, timeout=60)
-            await asyncio.sleep(0.1)
 
             pgid = client.objecter.object_pgid(pool, "victim")
             coll = f"pg_{pgid.pool}_{pgid.seed}"
@@ -44,6 +43,18 @@ def test_ec_shard_bitrot_detected_and_repaired_by_scrub():
                              if o >= 0 and o != primary
                              and o in cluster.osds)
             store = cluster.osds[shard_osd].store
+            # converge-poll: the ack covers shard durability, but the
+            # replica's journal drain to the readable store is async —
+            # wait for the shard bytes instead of hoping a fixed sleep
+            # outlasts a loaded host
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while asyncio.get_event_loop().time() < deadline:
+                try:
+                    if store.read(coll, "victim"):
+                        break
+                except Exception:
+                    pass
+                await asyncio.sleep(0.02)
             clean_shard = bytes(store.read(coll, "victim"))
 
             # ONE silent bit flip via the disk injector: version and

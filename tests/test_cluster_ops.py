@@ -40,7 +40,21 @@ def test_xattr_roundtrip_and_replication():
             pgid = client.objecter.object_pgid(pool, "obj")
             _, _, acting, _ = \
                 client.objecter.osdmap.pg_to_up_acting_osds(pgid)
-            await asyncio.sleep(0.1)
+
+            # converge-poll: replica applies land asynchronously after
+            # the ack — wait for the state, not a guessed duration
+            def _replicated() -> bool:
+                for o in acting:
+                    xs = cluster.osds[o].store.get_xattrs(
+                        f"pg_{pgid.pool}_{pgid.seed}", "obj")
+                    if xs.get("_user.k2") != b"v2" or "_user.k1" in xs:
+                        return False
+                return True
+
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while not _replicated() and \
+                    asyncio.get_event_loop().time() < deadline:
+                await asyncio.sleep(0.02)
             for o in acting:
                 xs = cluster.osds[o].store.get_xattrs(
                     f"pg_{pgid.pool}_{pgid.seed}", "obj")

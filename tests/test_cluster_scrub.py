@@ -26,6 +26,21 @@ def _corrupt(store, coll, oid, at=3):
     store._colls[coll][oid].data[at] ^= 0xFF
 
 
+async def _converge(cond, timeout=10.0):
+    """Wall-deadline converge-poll: replica/shard applies land
+    asynchronously after the ack — wait for the state, not a guessed
+    duration.  The caller asserts the condition afterwards."""
+    loop = asyncio.get_event_loop()
+    deadline = loop.time() + timeout
+    while loop.time() < deadline:
+        try:
+            if cond():
+                return
+        except Exception:
+            pass
+        await asyncio.sleep(0.02)
+
+
 def test_scrub_detects_and_repairs_replica_corruption():
     async def scenario():
         cluster = await start_cluster(3)
@@ -36,11 +51,13 @@ def test_scrub_detects_and_repairs_replica_corruption():
             io = client.ioctx(pool)
             payload = b"scrub-me" * 200
             await io.write_full("obj", payload)
-            await asyncio.sleep(0.1)
 
             pgid = client.objecter.object_pgid(pool, "obj")
             _, _, acting, primary = \
                 client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+            await _converge(lambda: all(
+                cluster.osds[o].store.read(_coll(pgid), "obj") ==
+                bytes(payload) for o in acting))
             victim = next(o for o in acting if o != primary)
             _corrupt(cluster.osds[victim].store, _coll(pgid), "obj")
             assert cluster.osds[victim].store.read(
@@ -50,7 +67,8 @@ def test_scrub_detects_and_repairs_replica_corruption():
             report = await cluster.osds[primary].scrub_pg(st)
             assert report["inconsistent"] == ["obj"]
             assert report["repaired"] == ["obj"]
-            await asyncio.sleep(0.1)
+            await _converge(lambda: cluster.osds[victim].store.read(
+                _coll(pgid), "obj") == bytes(payload))
             # repaired WITHOUT any client read
             assert cluster.osds[victim].store.read(
                 _coll(pgid), "obj") == bytes(payload)
@@ -74,17 +92,20 @@ def test_scrub_detects_and_repairs_primary_corruption():
             io = client.ioctx(pool)
             payload = b"primary-corrupt" * 100
             await io.write_full("obj", payload)
-            await asyncio.sleep(0.1)
 
             pgid = client.objecter.object_pgid(pool, "obj")
             _, _, acting, primary = \
                 client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+            await _converge(lambda: all(
+                cluster.osds[o].store.read(_coll(pgid), "obj") ==
+                bytes(payload) for o in acting))
             _corrupt(cluster.osds[primary].store, _coll(pgid), "obj")
 
             st = cluster.osds[primary].pgs[pgid]
             report = await cluster.osds[primary].scrub_pg(st)
             assert report["inconsistent"] == ["obj"]
-            await asyncio.sleep(0.1)
+            await _converge(lambda: cluster.osds[primary].store.read(
+                _coll(pgid), "obj") == bytes(payload))
             assert cluster.osds[primary].store.read(
                 _coll(pgid), "obj") == bytes(payload)
         finally:
@@ -106,11 +127,13 @@ def test_scrub_repairs_corrupt_ec_shard():
             io = client.ioctx(pool)
             payload = b"ec-scrub" * 300
             await io.write_full("obj", payload, timeout=60)
-            await asyncio.sleep(0.1)
 
             pgid = client.objecter.object_pgid(pool, "obj")
             _, _, acting, primary = \
                 client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+            await _converge(lambda: all(
+                cluster.osds[o].store.read(_coll(pgid), "obj")
+                for o in acting if o >= 0 and o in cluster.osds))
             victim = next(o for o in acting
                           if o >= 0 and o != primary
                           and o in cluster.osds)

@@ -97,7 +97,10 @@ def test_stale_leader_lease_ignored():
             leader_rank = peon.leader_rank
             stale_epoch = peon.elector.epoch - 2
             before = peon._last_lease
-            await asyncio.sleep(0.05)
+            # time-semantic pacing, not a convergence wait: the lease
+            # stamp must tick past `before` so the refresh assertion
+            # below can distinguish the current-epoch lease landing
+            await asyncio.sleep(0.05)  # graftlint: ignore[fixed-sleep-in-tests]
             # forge a lease from a deposed leader (older epoch, rank != now)
             fake_rank = next(r for r in range(3)
                              if r not in (leader_rank, peon.rank))
@@ -130,11 +133,25 @@ def test_scrub_tie_marks_inconsistent_not_repaired():
                                             pg_num=8, size=2)
             io = client.ioctx(pool)
             await io.write_full("tied", b"good-data")
-            await asyncio.sleep(0.1)
             pgid = client.objecter.object_pgid(pool, "tied")
             _, _, acting, primary = \
                 client.objecter.osdmap.pg_to_up_acting_osds(pgid)
             coll = f"pg_{pgid.pool}_{pgid.seed}"
+
+            # converge-poll: wait for BOTH copies to land (the replica
+            # apply is async) before corrupting one of them
+            def _both_hold() -> bool:
+                try:
+                    return all(
+                        cluster.osds[o].store.read(coll, "tied") ==
+                        b"good-data" for o in acting)
+                except Exception:
+                    return False
+
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while not _both_hold() and \
+                    asyncio.get_event_loop().time() < deadline:
+                await asyncio.sleep(0.02)
             # corrupt the PRIMARY copy: under first-inserted tie-breaking
             # this bad copy would win and clobber the good replica
             from ceph_tpu.cluster.store import Transaction

@@ -639,3 +639,162 @@ def test_planar_hygiene_zero_baseline_debt():
                    for k in baseline)
     report = engine.run_lint(rules=[planar_hygiene])
     assert report.findings == [], "\n" + report.render_text()
+
+
+# --------------------------------------------------- rule: await-atomicity
+
+
+def test_awaitrace_good_clean():
+    from ceph_tpu.analysis import awaitrace
+
+    findings, _ = lint_files(
+        awaitrace, "awaitrace_good.py",
+        relpath_as="ceph_tpu/cluster/awaitrace_good.py")
+    assert findings == [], [f.render() for f in findings]
+
+
+def test_awaitrace_bad_all_variants_fire():
+    from ceph_tpu.analysis import awaitrace
+
+    findings, _ = lint_files(
+        awaitrace, "awaitrace_bad.py",
+        relpath_as="ceph_tpu/cluster/awaitrace_bad.py")
+    msgs = "\n".join(f"{f.symbol}: {f.message}" for f in findings)
+    assert "stale_snapshot: stale-snapshot-across-await" in msgs
+    assert "check_then_act: check-then-act-across-await" in msgs
+    assert "lock_window_escape: lock-window-escape" in msgs
+    assert len(findings) == 3, [f.render() for f in findings]
+
+
+def test_awaitrace_scoped_to_cluster():
+    """The bad corpus relabelled outside cluster/ stays quiet."""
+    from ceph_tpu.analysis import awaitrace
+
+    for relpath in ("ceph_tpu/chaos/scenario.py",
+                    "tests/test_cluster_ops.py",
+                    "ceph_tpu/trace/flight.py"):
+        findings, _ = lint_files(
+            awaitrace, "awaitrace_bad.py", relpath_as=relpath)
+        assert findings == [], (relpath, [f.render() for f in findings])
+
+
+def test_awaitrace_convicts_pr9_superseded_pgstate():
+    """Historical-race pin: the PR-9 superseded-PGState ack-wait (the
+    watermark persisted through a registry entry replaced during the
+    await) is convicted in its pre-fix shape, and the shipped identity
+    re-check shape stays quiet — the detector must catch the bugs we
+    already paid for."""
+    from ceph_tpu.analysis import awaitrace
+
+    findings, _ = lint_files(
+        awaitrace, "awaitrace_hist_pgstate.py",
+        relpath_as="ceph_tpu/cluster/awaitrace_hist_pgstate.py")
+    assert len(findings) == 1, [f.render() for f in findings]
+    assert findings[0].symbol.endswith("buggy_pr9_shape")
+    assert "stale-snapshot-across-await" in findings[0].message
+    assert "'pgs'" in findings[0].message
+
+
+def test_awaitrace_convicts_pr11_stale_selfinfo_floor():
+    """Historical-race pin: PR 11's roll-forward floor resting on the
+    round-start self head is convicted; the re-read-after-the-awaits
+    fix shape stays quiet."""
+    from ceph_tpu.analysis import awaitrace
+
+    findings, _ = lint_files(
+        awaitrace, "awaitrace_hist_selfinfo.py",
+        relpath_as="ceph_tpu/cluster/awaitrace_hist_selfinfo.py")
+    assert len(findings) == 1, [f.render() for f in findings]
+    assert findings[0].symbol.endswith("buggy_pr11_shape")
+    assert "stale-snapshot-across-await" in findings[0].message
+    assert "'last_update'" in findings[0].message
+
+
+def test_scopes_cover_awaitrace_cluster_modules():
+    """Scope pin (round 20): await-atomicity must keep the async data
+    plane in range — the PG state machine, the EC backend, recovery,
+    scrub, and the op dispatch edge are exactly where the
+    await-interleaving races this rule exists for have already
+    happened (PRs 9/11/12).  A scope refactor that drops any of them
+    would silently stop linting the hot path."""
+    from ceph_tpu.analysis import awaitrace
+
+    for path in ("ceph_tpu/cluster/pg.py",
+                 "ceph_tpu/cluster/osd.py",
+                 "ceph_tpu/cluster/backend_ec.py",
+                 "ceph_tpu/cluster/recovery.py",
+                 "ceph_tpu/cluster/scrub.py",
+                 "ceph_tpu/cluster/client_ops.py",
+                 "ceph_tpu/cluster/batcher.py"):
+        assert path.startswith(awaitrace.SCOPE), (awaitrace.RULE, path)
+    # the watch-list keeps the fields the historical races moved through
+    for attr in ("pgs", "acting", "last_update", "last_complete",
+                 "pipeline_pending"):
+        assert attr in awaitrace.WATCHED_STATE, attr
+
+
+def test_awaitrace_registered_in_default_rules():
+    """A refactor of all_rules() can't silently drop the race rules."""
+    from ceph_tpu.analysis import awaitrace, testsleep
+
+    rules = engine.all_rules()
+    assert awaitrace in rules
+    assert testsleep in rules
+
+
+# ----------------------------------------------- rule: fixed-sleep-in-tests
+
+
+def test_fixed_sleep_good_clean():
+    """Converge-polls, bounded retries, sleep(0) yields, variable
+    durations, and pragma'd pacing all stay quiet (the pragma is
+    applied the way run_lint applies it)."""
+    from ceph_tpu.analysis import testsleep
+
+    modules, errors = engine.load_modules(
+        [corpus("fixed_sleep_good.py")])
+    assert not errors, errors
+    modules[0].relpath = "tests/fixed_sleep_good.py"
+    findings = testsleep.check(modules, engine.LintContext())
+    live = [f for f in findings
+            if not modules[0].pragma_suppressed(f.rule, f.line)]
+    assert live == [], [f.render() for f in live]
+
+
+def test_fixed_sleep_bad_all_shapes_fire():
+    from ceph_tpu.analysis import testsleep
+
+    findings, _ = lint_files(
+        testsleep, "fixed_sleep_bad.py",
+        relpath_as="tests/fixed_sleep_bad.py")
+    msgs = "\n".join(f.message for f in findings)
+    assert "asyncio.sleep(0.1)" in msgs
+    assert "asyncio.sleep(1)" in msgs
+    assert "time.sleep(0.5)" in msgs
+    assert len(findings) == 3, [f.render() for f in findings]
+
+
+def test_fixed_sleep_scoped_to_tests():
+    """Daemon code is the asyncio-blocking rule's turf: the bad corpus
+    relabelled into ceph_tpu/ stays quiet under THIS rule."""
+    from ceph_tpu.analysis import testsleep
+
+    findings, _ = lint_files(
+        testsleep, "fixed_sleep_bad.py",
+        relpath_as="ceph_tpu/cluster/osd.py")
+    assert findings == []
+
+
+def test_fixed_sleep_zero_baseline_debt():
+    """Round-20 contract: the deflake sweep landed with ZERO
+    fixed-sleep-in-tests baseline entries — every remaining constant
+    sleep in tests/ is a converge-poll interval or a pragma'd,
+    reasoned, time-semantic pacing sleep."""
+    from ceph_tpu.analysis import testsleep
+
+    baseline = baseline_mod.load_baseline(
+        baseline_mod.default_baseline_path())
+    assert not any(k.startswith("fixed-sleep-in-tests::")
+                   for k in baseline)
+    report = engine.run_lint(rules=[testsleep])
+    assert report.findings == [], "\n" + report.render_text()

@@ -59,7 +59,11 @@ def test_reconnect_replays_unacked_in_order():
                     if conn:
                         conn.writer.close()
                 await tx.send_message(Num(n=i), addr)
-            await asyncio.sleep(0.3)
+            # converge-poll: reconnect + replay land asynchronously
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while set(coll.got) < set(range(total)) and \
+                    asyncio.get_event_loop().time() < deadline:
+                await asyncio.sleep(0.02)
             # completeness: every n delivered at least once
             assert set(coll.got) == set(range(total)), \
                 sorted(set(range(total)) - set(coll.got))
@@ -88,7 +92,11 @@ def test_reconnect_survives_receiver_restart():
         try:
             for i in range(10):
                 await tx.send_message(Num(n=i), addr)
-            await asyncio.sleep(0.1)
+            # converge-poll: let the first batch drain before the kill
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while set(coll.got) < set(range(10)) and \
+                    asyncio.get_event_loop().time() < deadline:
+                await asyncio.sleep(0.02)
             await rx.shutdown()
 
             rx2 = Messenger(EntityName("osd", 1))
@@ -98,7 +106,11 @@ def test_reconnect_survives_receiver_restart():
             try:
                 for i in range(10, 20):
                     await tx.send_message(Num(n=i), addr)
-                await asyncio.sleep(0.3)
+                # converge-poll: the tail replays to the new incarnation
+                deadline = asyncio.get_event_loop().time() + 10.0
+                while not set(range(10, 20)) <= set(coll2.got) and \
+                        asyncio.get_event_loop().time() < deadline:
+                    await asyncio.sleep(0.02)
                 got = set(coll2.got)
                 # the new incarnation received at least the new tail; any
                 # unacked old frames replayed too (at-least-once)
@@ -150,10 +162,29 @@ def test_ec_write_survives_connection_drops():
                         for conn in list(osd.messenger._out.values()):
                             conn.writer.close()
                 await io.write_full(oid, payloads[oid], timeout=60)
-            await asyncio.sleep(0.5)
             for oid, data in payloads.items():
                 assert await io.read(oid, timeout=60) == data, oid
-            # shard-level convergence: every acting member holds its shard
+
+            # shard-level convergence: every acting member holds its
+            # shard (replays after the drops land asynchronously —
+            # converge-poll, then assert)
+            def _all_shards_present() -> bool:
+                for oid in payloads:
+                    pgid = client.objecter.object_pgid(pool, oid)
+                    _, _, acting, _ = \
+                        client.objecter.osdmap.pg_to_up_acting_osds(pgid)
+                    for o in acting:
+                        if o >= 0 and o in cluster.osds and \
+                                cluster.osds[o].store.stat(
+                                    f"pg_{pgid.pool}_{pgid.seed}",
+                                    oid) is None:
+                            return False
+                return True
+
+            deadline = asyncio.get_event_loop().time() + 15.0
+            while not _all_shards_present() and \
+                    asyncio.get_event_loop().time() < deadline:
+                await asyncio.sleep(0.05)
             for oid in payloads:
                 pgid = client.objecter.object_pgid(pool, oid)
                 _, _, acting, _ = \
@@ -211,7 +242,11 @@ def test_tampered_frame_rejected():
         tx = Messenger(EntityName("osd", 2), secret=b"k")
         try:
             await tx.send_message(Num(n=1), addr)
-            await asyncio.sleep(0.1)
+            # converge-poll: the signed frame lands first
+            deadline = asyncio.get_event_loop().time() + 10.0
+            while coll.got != [1] and \
+                    asyncio.get_event_loop().time() < deadline:
+                await asyncio.sleep(0.02)
             # flip a byte inside the next frame by writing raw garbage on
             # a fresh socket (wrong signature)
             import pickle as p
@@ -223,7 +258,10 @@ def test_tampered_frame_rejected():
             payload = p.dumps(m) + b"\x00" * 16
             writer.write(struct.pack("<I", len(payload)) + payload)
             await writer.drain()
-            await asyncio.sleep(0.2)
+            # negative-condition window: give the rx loop the chance to
+            # (wrongly) dispatch the forged frame — there is no positive
+            # state to converge on when asserting an absence
+            await asyncio.sleep(0.2)  # graftlint: ignore[fixed-sleep-in-tests]
             writer.close()
             assert coll.got == [1]      # forged 666 never dispatched
         finally:
@@ -275,11 +313,20 @@ def test_byte_throttle_backpressure():
         try:
             for s in senders:
                 await s.send_message(_Blob(data=b"x" * 65536), addr)
-            await asyncio.sleep(0.5)
+            loop = asyncio.get_event_loop()
+            deadline = loop.time() + 10.0
+            while len(in_dispatch) < 1 and loop.time() < deadline:
+                await asyncio.sleep(0.02)
+            # negative-condition window: the OTHER two frames must NOT
+            # enter dispatch while the byte budget is held — an absence
+            # has no positive state to converge on
+            await asyncio.sleep(0.3)  # graftlint: ignore[fixed-sleep-in-tests]
             # only one frame admitted into dispatch; the rest backpressure
             assert len(in_dispatch) == 1, in_dispatch
             gate.set()
-            await asyncio.sleep(0.5)
+            deadline = loop.time() + 10.0
+            while len(in_dispatch) < 3 and loop.time() < deadline:
+                await asyncio.sleep(0.02)
             assert len(in_dispatch) == 3, in_dispatch
         finally:
             gate.set()

@@ -145,7 +145,17 @@ def test_osd_down_across_split_splits_on_resume():
             victim = next(iter(cluster.osds))
             await cluster.osds[victim].stop()
             await client.pool_set("rsplit", "pg_num", 8)
-            await asyncio.sleep(1.0)
+            # converge-poll: the SURVIVING daemons learn the split map
+            # and split their collections before the victim resumes
+            loop = asyncio.get_event_loop()
+            deadline = loop.time() + 15.0
+            while loop.time() < deadline:
+                if all(o.osdmap.pools.get(pool) is not None and
+                       o.osdmap.pools[pool].pg_num == 8
+                       for o in cluster.osds.values()
+                       if o.osd_id != victim):
+                    break
+                await asyncio.sleep(0.05)
             osd = await cluster.restart_osd(victim)
             # wait for the resumed OSD to advance to the split map
             for _ in range(300):
@@ -153,14 +163,37 @@ def test_osd_down_across_split_splits_on_resume():
                         osd.osdmap.pools[pool].pg_num == 8:
                     break
                 await asyncio.sleep(0.1)
-            await asyncio.sleep(1.0)
+
+            from ceph_tpu.cluster.pg import PGMETA, PGRB, _coll
+            from ceph_tpu.ops.jenkins import str_hash_rjenkins
+            from ceph_tpu.osdmap.osdmap import ceph_stable_mod
+
+            def _no_stranded() -> bool:
+                # collection splits run asynchronously after the map
+                # advance — converge on the final no-child-objects-in-
+                # parent condition, then assert it below
+                p = osd.osdmap.pools[pool]
+                for coll in osd.store.list_collections():
+                    if not coll.startswith(f"pg_{pool}_"):
+                        continue
+                    seed = int(coll.split("_")[2])
+                    for name in osd.store.list_objects(coll):
+                        if name in (PGMETA, PGRB):
+                            continue
+                        want = ceph_stable_mod(
+                            str_hash_rjenkins(name.encode()),
+                            p.pg_num, p.pg_num_mask)
+                        if want != seed:
+                            return False
+                return True
+
+            deadline = loop.time() + 15.0
+            while not _no_stranded() and loop.time() < deadline:
+                await asyncio.sleep(0.05)
             for i in range(20):
                 assert await io.read(f"r-{i}", timeout=60) \
                     == b"resume-%d" % i
             # the resumed OSD's parent collections hold no child objects
-            from ceph_tpu.cluster.pg import PGMETA, PGRB, _coll
-            from ceph_tpu.ops.jenkins import str_hash_rjenkins
-            from ceph_tpu.osdmap.osdmap import ceph_stable_mod
             p = osd.osdmap.pools[pool]
             for coll in osd.store.list_collections():
                 if not coll.startswith(f"pg_{pool}_"):

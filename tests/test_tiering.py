@@ -110,7 +110,14 @@ def test_writeback_promote_flush_evict():
             await bio.remove("hot")
             with pytest.raises((IOError, FileNotFoundError)):
                 await bio.read("hot", timeout=15)
-            await asyncio.sleep(0.5)
+            # converge-poll: the write-through delete of the base copy
+            # lands asynchronously behind the overlay ack
+            deadline = asyncio.get_event_loop().time() + 15.0
+            while asyncio.get_event_loop().time() < deadline:
+                if "hot" not in _pool_objects(cluster, base) and \
+                        "hot" not in _pool_objects(cluster, cache):
+                    break
+                await asyncio.sleep(0.05)
             assert "hot" not in _pool_objects(cluster, base)
             assert "hot" not in _pool_objects(cluster, cache)
         finally:

@@ -259,8 +259,9 @@ def test_loop_profiler_catches_a_stall_and_wraps_tasks():
 
             async def stall():
                 # deliberate loop stall — the exact bug class the
-                # profiler exists to expose
-                # graftlint: ignore[asyncio-blocking]
+                # profiler exists to expose; the duration IS the test
+                # stimulus, not a convergence wait
+                # graftlint: ignore[asyncio-blocking] graftlint: ignore[fixed-sleep-in-tests]
                 time.sleep(0.08)
 
             await mon.wrap(stall())
@@ -593,8 +594,9 @@ def test_loop_lag_health_warning_raises_and_clears():
 
             async def stall():
                 # block the shared loop long enough for a sample to
-                # overshoot the warn threshold
-                # graftlint: ignore[asyncio-blocking]
+                # overshoot the warn threshold — the duration IS the
+                # test stimulus, not a convergence wait
+                # graftlint: ignore[asyncio-blocking] graftlint: ignore[fixed-sleep-in-tests]
                 time.sleep(0.12)
 
             await stall()
