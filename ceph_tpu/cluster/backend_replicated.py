@@ -11,7 +11,7 @@ from ceph_tpu.cluster import messages as M
 from ceph_tpu.cluster import pglog
 from ceph_tpu.cluster.pglog import LogEntry
 from ceph_tpu.crush.types import CRUSH_ITEM_NONE
-from ceph_tpu.cluster.pg import PGState, _coll
+from ceph_tpu.cluster.pg import PGMETA, PGState, _coll
 from ceph_tpu.cluster.store import Transaction
 from ceph_tpu.osdmap.osdmap import PGPool
 
@@ -475,6 +475,22 @@ class ReplicatedBackendMixin:
             if st is not None:
                 st.last_update, st.log = pickle.loads(msg.data)
                 self._save_pg_meta(st)
+            else:
+                # backfill target OUTSIDE acting (pg_temp handoff): we
+                # hold the pushed data but not the PGState yet — it
+                # materializes when the temp entry clears and the map
+                # puts us in acting.  Persist the shipped meta now, and
+                # stamp last_complete at the shipped head so the resume
+                # path (_frontier_rebuild) doesn't treat every adopted
+                # entry as an open frontier needing re-verification.
+                tmp = PGState(msg.pgid, [], [], -1)
+                tmp.last_update, tmp.log = pickle.loads(msg.data)
+                self._save_pg_meta(tmp)
+                txn = Transaction()
+                txn.setattr(coll, PGMETA, "last_complete",
+                            pickle.dumps(tmp.last_update))
+                self.store.queue_transaction(txn)
+            self.perf.inc("osd_pushes_applied")
             return
         if msg.op == "rewind":
             # primary-instructed divergent-log rewind (PGLog.cc:287):

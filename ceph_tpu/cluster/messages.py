@@ -48,6 +48,12 @@ class MOSDAlive(Message):
     # PG_INCONSISTENT / OSD_SCRUB_ERRORS health checks, raised while
     # nonzero and cleared by the next clean beacon like SLOW_OPS.
     scrub_stats: Optional[Tuple[int, int]] = None
+    # recovery feed (round 21): primary PGs still owing a peering or
+    # backfill round, and the map epoch this beacon judged them under.
+    # Drives the mon's PG_RECOVERING check: an epoch older than the
+    # last placement change means the claim is stale (pessimistic).
+    unclean_pgs: Optional[int] = None
+    map_epoch: int = 0
 
 
 # throttle-full admission pushback result (EBUSY): distinct from the
@@ -77,6 +83,21 @@ class MLog(Message):
     priority, message).  The mon's log service Paxos-replicates them."""
 
     entries: Tuple = ()   # of (who: str, stamp: float, prio: str, msg: str)
+
+
+@dataclass
+class MOSDPGTemp(Message):
+    """Primary -> mon temp-mapping request (reference MOSDPGTemp):
+    ``osds`` empty asks the mon to CLEAR the pg's temp entry — sent by
+    the acting primary once every up-member is backfilled current, the
+    handoff that completes an elastic reshape."""
+
+    pgid: Optional[PGid] = None
+    osds: Tuple[int, ...] = ()
+    epoch: int = 0       # sender's map epoch (staleness witness)
+    osd_id: int = -1     # sender: the mon only honors a clear from a
+                         # member of the live temp entry (a blip-degraded
+                         # non-donor "primary" must not drop the handoff)
 
 
 @dataclass

@@ -19,13 +19,40 @@ an optional HTTP endpoint (``serve_exporter``).
 from __future__ import annotations
 
 import asyncio
+import pickle
 import time
-from typing import Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
+from ceph_tpu.balance import PgAutoscaler, Reshaper, UpmapBalancer
 from ceph_tpu.cluster import messages as M
 from ceph_tpu.cluster.messenger import Addr, Connection, Dispatcher, EntityName, Messenger
 from ceph_tpu.cluster.monclient import MonTargeter
 from ceph_tpu.utils import AdminSocket, Config, KERNELS, PerfCountersCollection
+from ceph_tpu.utils.backoff import ExpBackoff
+
+# the graft-balance counter families, DECLARED (present-and-zero on the
+# scrape) at mgr init whether or not the loops ever run: the SLO
+# balance gate asserts presence, and a disabled subsystem showing
+# all-zeros is the provable-no-op witness
+_BALANCE_COUNTERS = (
+    ("mgr_balancer_rounds", "balancer optimization rounds"),
+    ("mgr_balancer_candidates", "candidate moves scored"),
+    ("mgr_balancer_moves_proposed", "moves chosen by the optimizer"),
+    ("mgr_balancer_moves_committed", "moves committed to the mon"),
+    ("mgr_balancer_throttled", "rounds skipped for *full flags, "
+                               "recovery pressure, or unclean health"),
+    ("mgr_balancer_bytes_projected", "projected bytes the committed "
+                                     "moves will shift"),
+    ("mgr_balancer_skew_before_milli", "pg-per-osd stddev before the "
+                                       "last round (x1000)"),
+    ("mgr_balancer_skew_after_milli", "pg-per-osd stddev after the "
+                                      "last round (x1000)"),
+    ("mgr_autoscale_rounds", "autoscaler rounds"),
+    ("mgr_autoscale_splits", "pg_num doublings issued"),
+    ("mgr_autoscale_pgp_bumps", "pgp_num catch-ups issued"),
+    ("mgr_reshape_grows", "grow operations started"),
+    ("mgr_reshape_drains", "drain operations started"),
+)
 
 
 def _prom_name(counter: str) -> str:
@@ -113,6 +140,17 @@ class MgrDaemon(Dispatcher):
 
         self.flight = FlightRecorder.from_config(
             "mgr", self.config)
+        # graft-balance: the policy subsystem.  Objects always exist
+        # (admin commands work pull-driven); the LOOPS only start when
+        # mgr_balancer_enabled / mgr_autoscale_enabled say so.
+        for name, desc in _BALANCE_COUNTERS:
+            self.perf.add_u64(name, desc=desc)
+        self.osdmap = None
+        self._mon_tid = 0
+        self._mon_inflight: Dict[int, asyncio.Future] = {}
+        self.balancer = UpmapBalancer(self)
+        self.autoscaler = PgAutoscaler(self)
+        self.reshaper = Reshaper(self)
         self.asok = self._build_admin_socket()
 
     def _build_admin_socket(self) -> AdminSocket:
@@ -134,7 +172,41 @@ class MgrDaemon(Dispatcher):
                       lambda cmd: self.prometheus_metrics(),
                       "Prometheus text-format exposition of all "
                       "daemons' counters")
+        asok.register("balance status", self._cmd_balance_status,
+                      "balancer/autoscaler last rounds + reshape ops "
+                      "(advances open reshape ops)")
+        asok.register("balance optimize",
+                      lambda cmd: self.balancer.tick(
+                          dry_run=bool(cmd.get("dry_run"))),
+                      "run one balancer round now (dry_run=True plans "
+                      "without committing)")
+        asok.register("balance autoscale",
+                      lambda cmd: self.autoscaler.tick(
+                          dry_run=bool(cmd.get("dry_run"))),
+                      "run one autoscaler round now")
+        asok.register("balance grow",
+                      lambda cmd: self.reshaper.grow(
+                          int(cmd.get("count", 0)),
+                          int(cmd.get("osds_per_host", 1) or 1)),
+                      "mint new OSD ids + CRUSH hosts through the mon")
+        asok.register("balance drain",
+                      lambda cmd: self.reshaper.drain_osds(
+                          [int(o) for o in cmd.get("osds", [])]),
+                      "start draining OSDs (out -> wait-clean -> purge)")
         return asok
+
+    async def _cmd_balance_status(self, cmd) -> Dict:
+        # pull-driven advance: with the loops disabled, polling status
+        # is what moves reshape ops forward (zero background activity)
+        ops = await self.reshaper.advance()
+        return {"enabled": bool(self.config.mgr_balancer_enabled),
+                "autoscale_enabled": bool(self.config.mgr_autoscale_enabled),
+                "vectorized": bool(self.config.mgr_balancer_vectorized),
+                "epoch": self.osdmap.epoch if self.osdmap else 0,
+                "last_round": self.balancer.last_round,
+                "last_autoscale": self.autoscaler.last_round,
+                "pools": self.autoscaler.pool_targets(),
+                "reshape_ops": ops}
 
     def _counter_sum(self, cmd):
         name = cmd.get("counter", "")
@@ -159,7 +231,73 @@ class MgrDaemon(Dispatcher):
         await self.monc.send(M.MMgrBeacon(addr=addr), raise_on_fail=True)
         self._beacon_task = asyncio.get_event_loop().create_task(
             self._beacon_loop(addr))
+        # follow the osdmap like any daemon: the balance subsystem plans
+        # against the subscribed map, never a side-channel copy
+        await self.monc.send(M.MMonSubscribe(what="osdmap", addr=addr),
+                             raise_on_fail=True)
+        if self.config.mgr_balancer_enabled:
+            self._balance_task = asyncio.get_event_loop().create_task(
+                self._balance_loop())
+        if self.config.mgr_autoscale_enabled:
+            self._autoscale_task = asyncio.get_event_loop().create_task(
+                self._autoscale_loop())
         return addr
+
+    async def _balance_loop(self) -> None:
+        while not self._stopped:
+            await asyncio.sleep(
+                max(0.05, self.config.mgr_balancer_interval))
+            try:
+                await self.reshaper.advance()
+                await self.balancer.tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # a failed round must not kill the policy loop; counted,
+                # and the next round reads fresh state anyway
+                self.perf.inc("mgr_balancer_round_errors")
+
+    async def _autoscale_loop(self) -> None:
+        while not self._stopped:
+            await asyncio.sleep(
+                max(0.05, self.config.mgr_autoscale_interval))
+            try:
+                await self.autoscaler.tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                self.perf.inc("mgr_autoscale_round_errors")
+
+    async def mon_command(self, cmd: Dict[str, Any],
+                          timeout: float = 10.0):
+        """Objecter-style mon command from the mgr: tid-matched futures,
+        capped jittered retry on -11 (leaderless quorum) and transport
+        errors, RuntimeError on real failures."""
+        deadline = asyncio.get_event_loop().time() + timeout * 3
+        backoff = ExpBackoff(base=0.05, cap=1.0)
+        last_err: Optional[BaseException] = None
+        while asyncio.get_event_loop().time() < deadline:
+            self._mon_tid += 1
+            tid = self._mon_tid
+            fut = asyncio.get_event_loop().create_future()
+            self._mon_inflight[tid] = fut
+            try:
+                await self.monc.send(M.MMonCommand(cmd=cmd, tid=tid),
+                                     raise_on_fail=True)
+                reply = await asyncio.wait_for(fut, timeout=timeout)
+            except (asyncio.TimeoutError, ConnectionError, OSError) as e:
+                self._mon_inflight.pop(tid, None)
+                last_err = e
+                await asyncio.sleep(backoff.next())
+                continue
+            if reply.result == -11:   # no leader yet: retry
+                last_err = RuntimeError(str(reply.data))
+                await asyncio.sleep(backoff.next())
+                continue
+            if reply.result != 0:
+                raise RuntimeError(f"mon command failed: {reply.data}")
+            return reply.data
+        raise TimeoutError(f"mgr mon command never succeeded: {last_err}")
 
     async def serve_exporter(self, host: str = "127.0.0.1",
                              port: int = 0) -> Tuple[str, int]:
@@ -206,8 +344,10 @@ class MgrDaemon(Dispatcher):
 
     async def stop(self) -> None:
         self._stopped = True
-        if getattr(self, "_beacon_task", None):
-            self._beacon_task.cancel()
+        for tname in ("_beacon_task", "_balance_task", "_autoscale_task"):
+            t = getattr(self, tname, None)
+            if t:
+                t.cancel()
         if self._exporter is not None:
             self._exporter.close()
         await self.messenger.shutdown()
@@ -230,5 +370,28 @@ class MgrDaemon(Dispatcher):
             result, data = await self.asok.dispatch(msg.cmd)
             await conn.send(M.MCommandReply(tid=msg.tid, result=result,
                                             data=data))
+            return True
+        if isinstance(msg, M.MOSDMapMsg):
+            newmap = pickle.loads(msg.osdmap_blob)
+            if self.osdmap is None or newmap.epoch >= self.osdmap.epoch:
+                self.osdmap = newmap
+            return True
+        if isinstance(msg, M.MOSDIncMapMsg):
+            m = self.osdmap
+            if m is not None and msg.prev_epoch == m.epoch:
+                for blob in msg.inc_blobs:
+                    m.apply_incremental(pickle.loads(blob))
+            elif m is not None and msg.epoch <= m.epoch:
+                pass  # already current
+            else:
+                # gap: resync from our epoch (objecter's recovery move)
+                await self.monc.send(M.MMonSubscribe(
+                    what="osdmap", addr=self.messenger.my_addr,
+                    since=m.epoch if m else 0))
+            return True
+        if isinstance(msg, M.MMonCommandReply):
+            fut = self._mon_inflight.pop(msg.tid, None)
+            if fut and not fut.done():
+                fut.set_result(msg)
             return True
         return False

@@ -17,6 +17,7 @@ subscriptions from its replicated state.
 from __future__ import annotations
 
 import asyncio
+import copy
 import pickle
 import time
 from typing import Dict, List, Optional, Set, Tuple
@@ -24,6 +25,7 @@ from typing import Dict, List, Optional, Set, Tuple
 from ceph_tpu.cluster import messages as M
 from ceph_tpu.cluster.messenger import Addr, Connection, Dispatcher, EntityName, Messenger
 from ceph_tpu.crush.types import (
+    CRUSH_ITEM_NONE,
     RULE_CHOOSELEAF_FIRSTN,
     RULE_CHOOSELEAF_INDEP,
     RULE_EMIT,
@@ -33,6 +35,7 @@ from ceph_tpu.crush.types import (
 from ceph_tpu.osdmap.osdmap import (
     Incremental,
     OSDMap,
+    PGid,
     PGPool,
     POOL_TYPE_ERASURE,
     POOL_TYPE_REPLICATED,
@@ -99,6 +102,14 @@ class Monitor(Dispatcher):
         # while any primary holds unrepaired damage, cleared by the
         # next clean beacon — the SLOW_OPS raise/clear shape
         self.osd_scrub_stats: Dict[int, Tuple[int, int]] = {}
+        # per-osd (unclean primary pgs, beacon map epoch) — the round-21
+        # PG_RECOVERING feed: a PG is unclean while its primary still
+        # owes it a peering/backfill round, and a beacon OLDER than the
+        # last placement-changing epoch cannot yet vouch for that
+        # epoch's reshuffle (pessimistic-until-reported, the misplaced-
+        # ratio gate the balancer/reshaper throttle on)
+        self.osd_unclean: Dict[int, Tuple[int, int]] = {}
+        self._placement_epoch = 0
         self.perf = PerfCounters("mon")
         # chaos-skewable per-daemon time source: lease staleness, beacon
         # grace, and the down-out tick all judge from THIS clock, so a
@@ -266,6 +277,35 @@ class Monitor(Dispatcher):
             checks["SLOW_OPS"] = (
                 f"{total} slow ops, oldest age {oldest:.2f}s "
                 f"(osds: {sorted(slow)})")
+        # PG_RECOVERING (round 21): data is still chasing placement.
+        # Three feeds, all pessimistic: live pg_temp entries (a reshape
+        # handoff in flight), any up OSD reporting unclean primary PGs,
+        # and any up OSD whose last beacon predates the last placement-
+        # changing epoch (it hasn't re-peered that reshuffle yet, so
+        # its "clean" claim is stale).  The balancer's require_clean
+        # gate and the reshaper's wait-clean both key off this check —
+        # it is what stops a round-N+1 upmap or a daemon stop from
+        # yanking a member that is still the sole holder of acked bytes.
+        if m.pools:
+            ups = [o for o in range(m.max_osd)
+                   if m.osd_exists[o] and m.osd_up[o]]
+            unclean = {o: self.osd_unclean[o][0] for o in ups
+                       if self.osd_unclean.get(o, (0, 0))[0] > 0}
+            behind = [o for o in ups
+                      if self.osd_unclean.get(o, (0, -1))[1]
+                      < self._placement_epoch]
+            parts = []
+            if m.pg_temp:
+                parts.append(f"{len(m.pg_temp)} pg(s) on temp acting "
+                             f"(reshape handoff)")
+            if unclean:
+                parts.append(f"{sum(unclean.values())} pg(s) "
+                             f"recovering (osds: {sorted(unclean)})")
+            if behind:
+                parts.append(f"{len(behind)} osd(s) not yet reported "
+                             f"since epoch {self._placement_epoch}")
+            if parts:
+                checks["PG_RECOVERING"] = "; ".join(parts)
         lagged = {o: ll for o, ll in self.osd_loop_lag.items()
                   if o < m.max_osd and m.osd_up[o]}
         if lagged:
@@ -613,14 +653,114 @@ class Monitor(Dispatcher):
     async def _commit_inc(self, inc: Incremental) -> bool:
         """Commit a map delta: direct in single-mon mode, through a Paxos
         round (begin/accept/commit on the quorum) otherwise."""
+        self._mint_pg_temp(inc)
         if self.paxos is None:
             await self._apply_inc_local(inc)
             return True
         return await self.paxos.propose(pickle.dumps(inc))
 
+    def _mint_pg_temp(self, inc: Incremental) -> None:
+        """Conservative temp mappings for wholesale remaps (round 21).
+
+        The reference's primaries request pg_temp themselves when they
+        discover a backfill interval; here the leader derives the same
+        entries AT COMMIT TIME, before the delta ships: any PG whose
+        new up set shares NO member with its current acting set would
+        strand its only copies on daemons the new map no longer names —
+        an elastic drain (weight->0) or a big upmap batch can replace a
+        whole acting set in one epoch.  Such PGs keep serving from the
+        old holders (pg_temp = old acting) until the acting primary
+        backfills the up members and requests the clear (MOSDPGTemp
+        with empty osds).  Minted entries ride IN the same Incremental,
+        so every quorum member and subscriber applies one atomic view.
+
+        Also sweeps the opposite edge: a temp entry whose members were
+        ALL purged from the map pins the PG to ids that can never come
+        back — clear it and let acting fall back to up.  Down-but-
+        existing members are NOT grounds to sweep: down is transient
+        (a beacon blip marks every OSD down at once), and a swept
+        handoff strands the data when the donors return."""
+        placement = (inc.new_up or inc.new_weights or inc.new_pools
+                     or inc.new_pg_upmap_items or inc.new_crush_hosts
+                     or inc.old_osds or inc.new_primary_affinity)
+        if not placement and not inc.new_down:
+            return
+        old = self.osdmap
+        new = copy.deepcopy(old)
+        new.apply_incremental(copy.deepcopy(inc))
+        if placement:
+            for pid, pool in new.pools.items():
+                for seed in range(pool.pg_num):
+                    pgid = PGid(pid, seed)
+                    if pgid in inc.new_pg_temp:
+                        continue   # an explicit request wins
+                    cur = old.pg_temp.get(pgid)
+                    if cur is not None and any(
+                            o < new.max_osd and new.osd_exists[o]
+                            for o in cur if o >= 0):
+                        # a handoff is already armed for this PG — never
+                        # re-derive it: a mid-blip re-mint computes its
+                        # donor list from a DEGRADED acting view and
+                        # overwrites the entry that names the real
+                        # data-bearers (observed: [4,5,0] -> [5,1])
+                        continue
+                    # DOWN-BLIND on both sides: mint reasons about data
+                    # LOCATION, and a beacon blip marking an OSD down
+                    # does not move its bytes.  Up-filtered views here
+                    # were the observed failure mode — an out committed
+                    # mid-blip saw empty donors (no mint, data stranded)
+                    # or degraded newcomers (a crippled entry).
+                    new_raw = new.pg_raw_up(pgid)
+                    new_set = {o for o in new_raw if o >= 0}
+                    if not new_set:
+                        continue
+                    old_raw = old.pg_raw_up(pgid)
+                    donors = [o for o in old_raw
+                              if o >= 0 and o < new.max_osd
+                              and new.osd_exists[o]]
+                    if not donors or new_set & set(donors):
+                        continue   # a survivor carries the data
+                    if pool.can_shift_osds():
+                        # replicated: acting = donors FIRST (the primary
+                        # stays data-bearing) + the incoming up members.
+                        # Newcomers joining acting immediately is the
+                        # race-closer: every write acked during the
+                        # handoff replicates to them too, so the clear
+                        # can land at any moment without stranding a
+                        # just-acked mutation on the donors.
+                        inc.new_pg_temp[pgid] = donors + [
+                            o for o in new_raw
+                            if o >= 0 and o not in donors]
+                    else:
+                        # erasure: acting positions are shard slots —
+                        # splicing newcomers in would scramble them.
+                        # Donors-only keeps the data reachable; the
+                        # primary's handoff backfill covers the rest.
+                        inc.new_pg_temp[pgid] = [
+                            o if (o >= 0 and o < new.max_osd
+                                  and new.osd_exists[o])
+                            else CRUSH_ITEM_NONE for o in old_raw]
+                    self.perf.inc("mon_pg_temp_minted")
+        for pgid, temp in new.pg_temp.items():
+            if pgid in inc.new_pg_temp:
+                continue
+            if not any(o < new.max_osd and new.osd_exists[o]
+                       for o in temp if o >= 0):
+                inc.new_pg_temp[pgid] = []
+                self.perf.inc("mon_pg_temp_swept")
+
     async def _apply_inc_local(self, inc: Incremental) -> None:
         """Apply a delta to the replicated map, log it, broadcast it."""
         self.osdmap.apply_incremental(inc)
+        if (inc.new_up or inc.new_down or inc.new_weights or inc.new_pools
+                or inc.new_pg_temp or getattr(inc, "new_pg_upmap_items", None)
+                or getattr(inc, "new_crush_hosts", None)
+                or getattr(inc, "old_osds", None)
+                or getattr(inc, "new_max_osd", 0)
+                or inc.new_primary_affinity):
+            # any epoch that can move a PG re-arms the PG_RECOVERING
+            # pessimism: beacons older than this can't vouch for it
+            self._placement_epoch = self.osdmap.epoch
         # cluster-log events ride the delta stream: every quorum member
         # appends the same entries in the same order (LogMonitor refresh)
         new_clog = getattr(inc, "new_log_entries", ())
@@ -684,7 +824,8 @@ class Monitor(Dispatcher):
                 return True
             self._pending_clog.extend(tuple(e) for e in msg.entries)
             return True
-        if isinstance(msg, (M.MOSDBoot, M.MOSDFailure, M.MOSDAlive)):
+        if isinstance(msg, (M.MOSDBoot, M.MOSDFailure, M.MOSDAlive,
+                            M.MOSDPGTemp)):
             if not self.is_leader:
                 # peon: relay to the leader (reference forward_request)
                 if self.leader_rank is not None and \
@@ -698,6 +839,8 @@ class Monitor(Dispatcher):
                 await self._handle_boot(msg)
             elif isinstance(msg, M.MOSDFailure):
                 await self._handle_failure(msg)
+            elif isinstance(msg, M.MOSDPGTemp):
+                await self._handle_pg_temp(msg)
             elif 0 <= msg.osd_id < self.osdmap.max_osd:
                 self.last_beacon[msg.osd_id] = self.clock.monotonic()
                 if getattr(msg, "statfs", None) is not None:
@@ -717,6 +860,10 @@ class Monitor(Dispatcher):
                     # repaired (or a restarted daemon with nothing
                     # flagged): PG_INCONSISTENT clears like SLOW_OPS
                     self.osd_scrub_stats.pop(msg.osd_id, None)
+                uc = getattr(msg, "unclean_pgs", None)
+                if uc is not None:
+                    self.osd_unclean[msg.osd_id] = (
+                        int(uc), int(getattr(msg, "map_epoch", 0)))
                 lag = getattr(msg, "loop_lag", None)
                 warn_at = self.config.loop_lag_warn
                 if lag is not None and warn_at > 0 and lag[1] >= warn_at:
@@ -855,6 +1002,40 @@ class Monitor(Dispatcher):
             self.clog("INF", f"osd.{msg.osd_id} boot")
             await self._commit_inc(inc)
 
+    async def _handle_pg_temp(self, msg: M.MOSDPGTemp) -> None:
+        """Primary-requested temp-mapping change.  Today the only sender
+        is a recovered primary asking for a CLEAR (osds=()): every
+        up-member is backfilled current, so the conservative mon-minted
+        pg_temp entry can drop and the map's real up set take over."""
+        pgid = msg.pgid
+        if pgid is None:
+            return
+        pool = self.osdmap.pools.get(pgid.pool)
+        if pool is None or pgid.seed >= pool.pg_num:
+            return
+        async with self._map_mutex:
+            cur = self.osdmap.pg_temp.get(pgid)
+            want = [int(o) for o in msg.osds]
+            # idempotent: a clear for an absent entry (or a set request
+            # matching the current one) commits nothing
+            if cur is None and not want:
+                return
+            if cur is not None and list(cur) == want:
+                return
+            # a CLEAR is only honored from a member of the live entry:
+            # under a beacon blip an OSD whose degraded map shows every
+            # donor down computes itself sole primary of an EMPTY pg,
+            # finds nothing to hand off, and asks for the clear — honoring
+            # it drops the only pointer to the data-bearing donors
+            if cur is not None and not want and \
+                    getattr(msg, "osd_id", -1) not in cur:
+                self.perf.inc("mon_pg_temp_clear_rejected")
+                return
+            inc = self._new_inc()
+            inc.new_pg_temp[pgid] = want
+            self.perf.inc("mon_pg_temp_requests")
+            await self._commit_inc(inc)
+
     async def _handle_failure(self, msg: M.MOSDFailure) -> None:
         m = self.osdmap
         osd = msg.failed_osd
@@ -938,7 +1119,9 @@ class Monitor(Dispatcher):
         "osd pool selfmanaged_snap_remove", "auth revoke",
         "osd pool delete", "osd pool rename", "osd pool set",
         "osd tier add", "osd tier remove", "osd tier cache-mode",
-        "osd tier set-overlay", "osd tier remove-overlay"})
+        "osd tier set-overlay", "osd tier remove-overlay",
+        "osd pg-upmap-items", "osd rm-pg-upmap-items",
+        "osd grow", "osd purge"})
 
     async def _handle_command(self, conn: Connection, msg: M.MMonCommand) -> None:
         cmd = msg.cmd
@@ -961,7 +1144,9 @@ class Monitor(Dispatcher):
             "osd pool selfmanaged_snap_remove", "auth revoke",
             "osd pool delete", "osd pool rename", "osd pool set",
             "osd tier add", "osd tier remove", "osd tier cache-mode",
-            "osd tier set-overlay", "osd tier remove-overlay")
+            "osd tier set-overlay", "osd tier remove-overlay",
+            "osd pg-upmap-items", "osd rm-pg-upmap-items",
+            "osd grow", "osd purge")
         if mutating and not self.is_leader:
             # forward to the leader, relay its reply (reference
             # Monitor::forward_request_leader)
@@ -1109,18 +1294,37 @@ class Monitor(Dispatcher):
                         result, data = -11, "quorum lost"
                     else:
                         data = sorted(self.osdmap.revoked_entities)
-            elif prefix == "osd out":
+            elif prefix in ("osd out", "osd in"):
+                # 'ids' batches the whole set into ONE epoch.  That is
+                # load-bearing for drain safety: outing N OSDs as N
+                # epochs lets the acting set WALK — each epoch keeps a
+                # one-member overlap with the last, but the survivor it
+                # keeps may itself be a just-added, not-yet-backfilled
+                # member, so N quick epochs can strand every current
+                # copy with no pg_temp ever minted.  One epoch makes the
+                # wholesale replacement visible to _mint_pg_temp.
+                ids = cmd.get("ids")
+                ids = [int(i) for i in ids] if ids is not None \
+                    else [int(cmd["id"])]
+                w = 0 if prefix == "osd out" else 0x10000
                 async with self._map_mutex:
                     inc = self._new_inc()
-                    inc.new_weights[int(cmd["id"])] = 0
+                    for i in ids:
+                        inc.new_weights[i] = w
                     if not await self._commit_inc(inc):
                         result, data = -11, "quorum lost"
-            elif prefix == "osd in":
-                async with self._map_mutex:
-                    inc = self._new_inc()
-                    inc.new_weights[int(cmd["id"])] = 0x10000
-                    if not await self._commit_inc(inc):
-                        result, data = -11, "quorum lost"
+            elif prefix == "osd pg-upmap-items":
+                # the balancer's commit edge: a BATCH of upmap exception
+                # pairs as one Incremental (reference OSDMonitor
+                # 'osd pg-upmap-items', one pg per command there; batched
+                # here so a whole balancer round is one map epoch)
+                result, data = await self._handle_upmap_items(cmd)
+            elif prefix == "osd rm-pg-upmap-items":
+                result, data = await self._handle_rm_upmap_items(cmd)
+            elif prefix == "osd grow":
+                result, data = await self._handle_grow(cmd)
+            elif prefix == "osd purge":
+                result, data = await self._handle_purge(cmd)
             elif prefix == "injectargs":
                 # fan the config mutation out to the targeted daemons
                 # (reference injectargs via mon 'ceph tell')
@@ -1200,6 +1404,141 @@ class Monitor(Dispatcher):
             result, data = -22, repr(e)
         reply = M.MMonCommandReply(tid=msg.tid, result=result, data=data)
         await conn.send(reply)
+
+    def _parse_pgid(self, s: str) -> Optional[PGid]:
+        try:
+            pool_s, seed_s = str(s).split(".", 1)
+            pgid = PGid(int(pool_s), int(seed_s))
+        except (TypeError, ValueError):
+            return None
+        pool = self.osdmap.pools.get(pgid.pool)
+        if pool is None or not (0 <= pgid.seed < pool.pg_num):
+            return None
+        return pgid
+
+    async def _handle_upmap_items(self, cmd: Dict):
+        """Batched 'osd pg-upmap-items': validate every pair against the
+        CURRENT map, commit the whole set as one Incremental.  An empty
+        pair list clears the pg's entry."""
+        items = cmd.get("items") or {}
+        m = self.osdmap
+        new_items: Dict[PGid, list] = {}
+        for key, pairs in items.items():
+            pgid = self._parse_pgid(key)
+            if pgid is None:
+                return -22, f"bad pgid {key!r}"
+            clean = []
+            for pair in pairs or []:
+                try:
+                    src, dst = int(pair[0]), int(pair[1])
+                except (TypeError, ValueError, IndexError):
+                    return -22, f"bad pair {pair!r} for {key}"
+                # destination must be a live, in OSD — committing a map
+                # that remaps onto an out/absent OSD would undo the
+                # balancer's own safety story
+                if not (0 <= dst < m.max_osd and m.osd_exists[dst]
+                        and m.osd_weight[dst] > 0):
+                    return -22, f"osd.{dst} not usable as upmap target"
+                if not (0 <= src < m.max_osd):
+                    return -22, f"bad source osd.{src}"
+                clean.append((src, dst))
+            new_items[pgid] = clean
+        if not new_items:
+            return -22, "no items"
+        async with self._map_mutex:
+            inc = self._new_inc()
+            inc.new_pg_upmap_items = dict(new_items)
+            if not await self._commit_inc(inc):
+                return -11, "quorum lost"
+        self.perf.inc("mon_upmap_commits")
+        self.perf.inc("mon_upmap_items", len(new_items))
+        return 0, {"applied": len(new_items)}
+
+    async def _handle_rm_upmap_items(self, cmd: Dict):
+        pgids = cmd.get("pgids") or []
+        clear: Dict[PGid, list] = {}
+        for key in pgids:
+            pgid = self._parse_pgid(key)
+            if pgid is None:
+                return -22, f"bad pgid {key!r}"
+            clear[pgid] = []
+        if not clear:
+            return -22, "no pgids"
+        async with self._map_mutex:
+            inc = self._new_inc()
+            inc.new_pg_upmap_items = clear
+            if not await self._commit_inc(inc):
+                return -11, "quorum lost"
+        return 0, {"removed": len(clear)}
+
+    async def _handle_grow(self, cmd: Dict):
+        """'osd grow': mint count new OSD ids and their CRUSH hosts in
+        ONE Incremental (the reference's 'osd crush add-bucket' + 'osd
+        crush move' + ids choreography, collapsed).  New ids start
+        exists/down/in; daemons boot into them like any revived OSD."""
+        try:
+            count = int(cmd.get("count", 0))
+            per_host = int(cmd.get("osds_per_host", 1) or 1)
+        except (TypeError, ValueError):
+            return -22, "count/osds_per_host must be ints"
+        if count <= 0 or per_host <= 0 or count % per_host:
+            return -22, (f"need count > 0 divisible by osds_per_host "
+                         f"(got {count}/{per_host})")
+        root = cmd.get("root", "default")
+        if root not in self.osdmap.crush.item_names.values():
+            return -2, f"crush root {root!r} not found"
+        async with self._map_mutex:
+            m = self.osdmap
+            base = m.max_osd
+            taken = set(m.crush.item_names.values())
+            hosts = []
+            hno = sum(1 for b in m.crush.buckets.values() if b.type == 1)
+            for i in range(count // per_host):
+                name = f"host{hno + i}"
+                while name in taken:
+                    name += "x"
+                taken.add(name)
+                ids = tuple(range(base + i * per_host,
+                                  base + (i + 1) * per_host))
+                hosts.append((name, ids, (0x10000,) * per_host, root))
+            inc = self._new_inc()
+            inc.new_max_osd = base + count
+            inc.new_crush_hosts = tuple(hosts)
+            if not await self._commit_inc(inc):
+                return -11, "quorum lost"
+        self.clog("INF", f"osd grow: +{count} osds "
+                         f"({base}..{base + count - 1})")
+        return 0, {"new_osds": list(range(base, base + count)),
+                   "max_osd": base + count,
+                   "hosts": [h[0] for h in hosts]}
+
+    async def _handle_purge(self, cmd: Dict):
+        """'osd purge': remove a DRAINED osd from existence (reference
+        OSDMonitor 'osd purge' = rm + crush remove + auth del).  Refused
+        unless the osd is already down AND out — purging a live or
+        still-weighted osd silently degrades PGs."""
+        try:
+            osd = int(cmd["id"])
+        except (KeyError, TypeError, ValueError):
+            return -22, "need id=<osd>"
+        m = self.osdmap
+        if not (0 <= osd < m.max_osd) or not m.osd_exists[osd]:
+            return -2, f"osd.{osd} does not exist"
+        if not cmd.get("sure"):
+            return -1, "EPERM: pass sure=True to really purge"
+        if m.osd_up[osd] or m.osd_weight[osd] > 0:
+            return -16, (f"osd.{osd} must be down+out before purge "
+                         f"(up={bool(m.osd_up[osd])}, "
+                         f"weight={m.osd_weight[osd]})")
+        async with self._map_mutex:
+            inc = self._new_inc()
+            inc.old_osds = (osd,)
+            if not await self._commit_inc(inc):
+                return -11, "quorum lost"
+        self.down_since.pop(osd, None)
+        self.osd_statfs.pop(osd, None)
+        self.clog("INF", f"osd.{osd} purged")
+        return 0, {"purged": osd}
 
     def _create_pool(self, cmd: Dict) -> Tuple[int, Incremental]:
         """Build the pool + rule delta (committed by the caller)."""

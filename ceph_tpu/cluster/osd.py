@@ -191,6 +191,12 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         self._placement_cache: Dict[int, object] = {}
         self._peering_pending: Set[PGid] = set()
         self._peering_task: Optional[asyncio.Task] = None
+        # primary PGs owing a peering/recovery round (round 21): added
+        # when an epoch queues them to re-peer, cleared when a round
+        # completes clean (or the PG leaves this OSD).  The beacon
+        # reports the count — the mon's PG_RECOVERING feed that gates
+        # the balancer's next round and the reshaper's wait-clean.
+        self._unclean_pgs: Set[PGid] = set()
         # a COUNTED throttle, not a mutual-exclusion lock: DepLock has
         # no semaphore mode, and ordering is safe by construction — the
         # semaphore is only ever acquired BEFORE (never while holding)
@@ -342,6 +348,13 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
             self._shardedq.start()
         if self.loopmon.enabled:
             self._track(loop.create_task(self.loopmon.sample()))
+        if self._peering_pending:
+            # superblock resume queued our primary PGs before the loop
+            # tasks existed; if the subscribed map matches the persisted
+            # one no _post_map_update ever fires changed=True, and the
+            # boot-time queue (plus its unclean-beacon claim) would sit
+            # forever — the restarted primary owes these PGs a round
+            self._kick_peering()
         return addr
 
     def _track(self, task: asyncio.Task) -> asyncio.Task:
@@ -1389,7 +1402,13 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                         if actp == self.osd_id:
                             to_peer.add(pgid)
                     else:
-                        if old.acting != acting or (
+                        # up-only changes re-peer too (round 21): a
+                        # drain with a minted pg_temp leaves acting
+                        # untouched while up moves to the incoming set —
+                        # the primary must notice, backfill the up
+                        # members, and request the temp clear, and
+                        # nothing but this diff tells it to.
+                        if old.acting != acting or old.up != up or (
                                 old.primary != actp
                                 and actp == self.osd_id):
                             changed = True
@@ -1398,6 +1417,7 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                         old.up, old.acting, old.primary = up, acting, actp
                 elif old is not None:
                     del self.pgs[pgid]
+                    self._unclean_pgs.discard(pgid)
                     changed = True
                     if racecheck.TRACKER:  # graft-race: the PG left
                         # this OSD — snapshots of its state went stale
@@ -1409,6 +1429,7 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         # from past intervals must die too.
         for pgid in [p for p in self.pgs if p.pool not in m.pools]:
             del self.pgs[pgid]
+            self._unclean_pgs.discard(pgid)
             changed = True
         for pool_id in [p for p in self._placement_cache
                         if p not in m.pools]:
@@ -1439,6 +1460,7 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
         if to_peer:
             self.perf.inc("osd_pgs_repeered", len(to_peer))
             self._peering_pending.update(to_peer)
+            self._unclean_pgs.update(to_peer)
         return bool(to_peer)
 
     # ------------------------------------------------------------ heartbeat
@@ -1485,11 +1507,22 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
                     self.flight.record("scrub", inconsistent=bad_objs,
                                        pgs=bad_pgs)
             try:
+                # only PGs we still PRIMARY count as unclean — a PG
+                # that moved away (or whose primaryship did) is the new
+                # primary's to report; keeping it here pins the mon's
+                # PG_RECOVERING check on an OSD that will never run the
+                # recovery that clears it
+                self._unclean_pgs = {
+                    p for p in self._unclean_pgs
+                    if p in self.pgs
+                    and self.pgs[p].primary == self.osd_id}
                 await self._mon_send(M.MOSDAlive(
                     osd_id=self.osd_id, statfs=self.store.statfs(),
                     slow_ops=(slow_n, slow_oldest),
                     loop_lag=self.loopmon.lag_report(),
-                    scrub_stats=self._scrub_stats()))
+                    scrub_stats=self._scrub_stats(),
+                    unclean_pgs=len(self._unclean_pgs),
+                    map_epoch=m.epoch))
                 # the beacon delivered this window's max: start the next
                 # window, so a drained stall clears LOOP_LAG like a
                 # drained op queue clears SLOW_OPS
@@ -1502,10 +1535,26 @@ class OSDDaemon(PGLogMixin, ClientOpsMixin, ReplicatedBackendMixin,
             mgr_addr = getattr(m, "mgr_addr", None)
             if mgr_addr:
                 try:
+                    counters = dict(
+                        self.perf.dump()[f"osd.{self.osd_id}"])
+                    # load observation for graft-balance: statfs + this
+                    # OSD's per-pool PRIMARY object counts ride the
+                    # report (primaries only, so summing across daemons
+                    # counts each object once — the autoscaler's and
+                    # balancer's byte/object feed)
+                    total_b, used_b = self.store.statfs()
+                    counters["osd_stat_bytes_total"] = total_b
+                    counters["osd_stat_bytes_used"] = used_b
+                    for pgid, st in self.pgs.items():
+                        if st.primary != self.osd_id:
+                            continue
+                        key = f"osd_pool_{pgid.pool}_objects"
+                        n = sum(1 for o in self.store.list_objects(
+                            _coll(pgid)) if o != PGMETA)
+                        counters[key] = counters.get(key, 0) + n
                     await self.messenger.send_message(M.MMgrReport(
                         daemon=f"osd.{self.osd_id}",
-                        counters=self.perf.dump()[f"osd.{self.osd_id}"],
-                        stamp=now), tuple(mgr_addr))
+                        counters=counters, stamp=now), tuple(mgr_addr))
                 except (ConnectionError, OSError, RuntimeError):
                     pass
             for osd, addr in list(m.osd_addrs.items()):

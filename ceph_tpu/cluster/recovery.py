@@ -57,6 +57,9 @@ class RecoveryMixin:
             for pgid in pending:
                 st = self.pgs.get(pgid)
                 if st is None or st.primary != self.osd_id:
+                    # no longer ours to recover: the new primary's
+                    # beacon carries the unclean claim now
+                    self._unclean_pgs.discard(pgid)
                     continue
                 track_task(waves, asyncio.get_event_loop().create_task(
                     self._peer_one(st, stagger)))
@@ -163,8 +166,10 @@ class RecoveryMixin:
                                self.clock.monotonic() - t0)
         if complete:
             self._recovery_backoffs.pop(st.pgid, None)
+            self._unclean_pgs.discard(st.pgid)
         else:
             self._queue_recovery_retry(st)
+            self._unclean_pgs.add(st.pgid)
 
     async def _recover_pg_locked(self, st: PGState) -> bool:
         m = self.osdmap
@@ -327,6 +332,34 @@ class RecoveryMixin:
             # the PG is not crash-consistent yet — retry (the members
             # behind them are still syncing, or unreachable)
             complete = False
+        # pg_temp handoff (round 21): this PG runs on a mon-minted temp
+        # acting set (the pre-reshape donors) while its REAL owners are
+        # the up-members outside acting.  Backfill them current, then
+        # ask the mon to clear the temp entry — the clear commits a new
+        # epoch that re-peers the PG onto its up set.  Returning
+        # incomplete keeps the capped-backoff retry armed until that
+        # map lands (a lost clear message just re-sends; the backfill
+        # pushes are idempotent via version guards).
+        if complete and st.pgid in m.pg_temp:
+            handoff = [o for o in st.up
+                       if o != CRUSH_ITEM_NONE and o not in st.acting]
+            for osd in handoff:
+                if backfill_gated:
+                    self.perf.inc("osd_backfill_blocked_full")
+                    complete = False
+                    break
+                reply = await self._query_pg(osd, st.pgid)
+                if reply is None:
+                    complete = False
+                    continue
+                complete &= await self._backfill_member(
+                    pool, st, osd, reply.objects or {})
+            if complete:
+                await self._mon_send(M.MOSDPGTemp(
+                    pgid=st.pgid, osds=(), epoch=m.epoch,
+                    osd_id=self.osd_id))
+                self.perf.inc("osd_pg_temp_clear_requested")
+                complete = False
         self.perf.inc("osd_pg_recoveries")
         return complete
 

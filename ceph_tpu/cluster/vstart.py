@@ -67,6 +67,9 @@ class Cluster:
     postmortems: List[Dict] = field(default_factory=list)
     _bb_seen: set = field(default_factory=set)
     _bb_tasks: set = field(default_factory=set)
+    # the boot-time store factory, kept so elastically-grown OSDs
+    # (add_osds) get the same backing-store flavor as the original set
+    store_factory: Optional[object] = None
 
     async def blackbox_trigger(self, kind: str, reason: str,
                                detail: Optional[Dict] = None,
@@ -377,6 +380,56 @@ class Cluster:
         self._arm_chaos_crash(osd)
         return osd
 
+    async def add_osds(self, count: int, osds_per_host: int = 1,
+                       timeout: float = 15.0) -> List[int]:
+        """Elastic growth (graft-balance round 21): mint ``count`` new
+        OSD ids + CRUSH hosts through the mon ('osd grow', one
+        Incremental), boot daemons into them, and wait until the map
+        shows them up — the live N->2N expansion primitive."""
+        if not self.clients:
+            await self.client()
+        data = await self.clients[0].objecter.mon_command(
+            {"prefix": "osd grow", "count": count,
+             "osds_per_host": osds_per_host})
+        new_ids = [int(o) for o in data["new_osds"]]
+        await self.boot_osds(new_ids, timeout=timeout)
+        return new_ids
+
+    async def boot_osds(self, osd_ids: List[int],
+                        timeout: float = 15.0) -> None:
+        """Boot daemons into already-minted ids (the mgr reshape path
+        mints them via 'balance grow'; this is the operator's side of
+        the handshake) and wait until the mon map shows them up."""
+        for o in osd_ids:
+            factory = self.store_factory
+            osd = OSDDaemon(o, self.mon_addr, config=self.config,
+                            store=factory(o) if factory else None)
+            await osd.start()
+            self.osds[o] = osd
+            self._arm_chaos_crash(osd)
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            if all(self.mon.osdmap.osd_up[o] for o in osd_ids):
+                return
+            await asyncio.sleep(0.02)
+        raise TimeoutError(f"grown osds never booted: {osd_ids}")
+
+    async def remove_osd(self, osd_id: int,
+                         timeout: float = 20.0) -> None:
+        """Finish a drain: stop the daemon, wait for the mon to see it
+        down, purge it from the maps.  The caller is responsible for
+        having drained data first ('osd out' + wait-clean — the
+        mgr Reshaper's drain op); this is the stop-and-purge tail."""
+        if osd_id in self.osds:
+            await self.kill_osd(osd_id)
+        self.osd_configs.pop(osd_id, None)
+        self.osd_stores.pop(osd_id, None)
+        await self.wait_down(osd_id, timeout=timeout)
+        if not self.clients:
+            await self.client()
+        await self.clients[0].objecter.mon_command(
+            {"prefix": "osd purge", "id": osd_id, "sure": True})
+
     async def wait_for_epoch(self, epoch: int, timeout: float = 10.0) -> None:
         deadline = asyncio.get_event_loop().time() + timeout
         while asyncio.get_event_loop().time() < deadline:
@@ -481,7 +534,7 @@ async def start_cluster(n_osds: int = 3, osds_per_host: int = 1,
         mon_addrs.append(await mon.start())
         mons.append(mon)
     cluster = Cluster(mons=mons, osds={}, config=config,
-                      mon_addrs=mon_addrs)
+                      mon_addrs=mon_addrs, store_factory=store_factory)
     cluster._initial_map_blob = map_blob
     for mon in mons:
         cluster._arm_blackbox(mon)

@@ -313,6 +313,79 @@ class CrushMap:
         self.rules.append(rule)
         return len(self.rules) - 1
 
+    # -- elastic mutation (reference CrushWrapper insert_item /
+    #    remove_item: grow adds device-bearing host buckets under an
+    #    existing root; drain unlinks a purged device and reweights the
+    #    ancestor chain).  Bucket ids stay DENSE — nothing is ever
+    #    deleted from ``buckets`` (the set_device_class shadow-tree
+    #    rule), only unlinked — so the vectorized mapper's dense-id
+    #    assumption survives every reshape.
+
+    def parent_of(self, item: int) -> Optional[int]:
+        for bid, b in self.buckets.items():
+            if item in b.items:
+                return bid
+        return None
+
+    def _reweight_item(self, parent: int, item: int, weight: int) -> None:
+        b = self.buckets[parent]
+        i = b.items.index(item)
+        if b.weights[i] == weight:
+            return
+        b.weights[i] = weight
+        gp = self.parent_of(parent)
+        if gp is not None:
+            self._reweight_item(gp, parent, b.weight)
+
+    def add_host(self, name: str, devices: List[int],
+                 weights: Optional[List[int]] = None,
+                 root: str = "default") -> int:
+        """Grow: a new host bucket holding ``devices``, linked under the
+        named root with the ancestor weights bumped (CrushWrapper
+        insert_item semantics: weights propagate to the top)."""
+        weights = weights or [0x10000] * len(devices)
+        root_id = next((bid for bid, n in self.item_names.items()
+                        if n == root), None)
+        if root_id is None:
+            raise KeyError(f"no root bucket named {root!r}")
+        hid = self.make_straw2(1, devices, weights, name=name)
+        rb = self.buckets[root_id]
+        rb.items.append(hid)
+        rb.weights.append(self.buckets[hid].weight)
+        gp = self.parent_of(root_id)
+        if gp is not None:
+            self._reweight_item(gp, root_id, rb.weight)
+        self._class_shadow.clear()
+        return hid
+
+    def remove_device(self, dev: int) -> bool:
+        """Drain: unlink a purged device from its holding bucket and
+        reweight the chain above it; a host left empty is unlinked from
+        its parent too (but stays in ``buckets`` — dense ids).  Returns
+        whether anything was unlinked."""
+        holder = self.parent_of(dev)
+        if holder is None:
+            return False
+        b = self.buckets[holder]
+        i = b.items.index(dev)
+        del b.items[i]
+        del b.weights[i]
+        parent = self.parent_of(holder)
+        if parent is not None:
+            if b.items:
+                self._reweight_item(parent, holder, b.weight)
+            else:
+                pb = self.buckets[parent]
+                j = pb.items.index(holder)
+                del pb.items[j]
+                del pb.weights[j]
+                gp = self.parent_of(parent)
+                if gp is not None:
+                    self._reweight_item(gp, parent, pb.weight)
+        self.device_class.pop(dev, None)
+        self._class_shadow.clear()
+        return True
+
     def bucket(self, item_id: int) -> Bucket:
         return self.buckets[item_id]
 

@@ -294,6 +294,26 @@ def judge(spec, result, before: TelemetrySnapshot,
          "" if integ_present
          else "integrity/full counters MISSING from scrape")
 
+    # balance counters (round 21): the mgr's balancer/autoscaler/reshape
+    # families must be ON the scrape even with the subsystem disabled
+    # (declared at mgr init — all-zeros is the provable-no-op witness),
+    # and an optional committed-moves floor gates convergence scenarios
+    # — steady-state specs leave it 0 (counters-present only)
+    moves_min = spec.gate("balance_moves_min", 0.0)
+    committed = counter_delta(before, after,
+                              "ceph_mgr_balancer_moves_committed",
+                              daemon_prefix="mgr.")
+    bal_present = all(
+        name in after.prom for name in (
+            "ceph_mgr_balancer_rounds", "ceph_mgr_balancer_candidates",
+            "ceph_mgr_balancer_moves_committed",
+            "ceph_mgr_balancer_throttled", "ceph_mgr_autoscale_rounds"))
+    _row(report, "balance", round(committed, 1), moves_min,
+         bal_present and committed >= moves_min,
+         "scrape:ceph_mgr_balancer_moves_committed",
+         "" if bal_present
+         else "mgr balance counters MISSING from scrape")
+
     # deadline: zero acks past the client budget (client-observed —
     # the one gate that cannot come from a scrape by definition)
     _row(report, "deadline", len(result.late_acks), 0,

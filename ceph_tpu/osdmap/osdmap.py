@@ -153,6 +153,11 @@ class Incremental:
     new_pools: Dict[int, "PGPool"] = field(default_factory=dict)
     new_rules: List[object] = field(default_factory=list)  # appended in order
     new_pg_temp: Dict["PGid", List[int]] = field(default_factory=dict)
+    # balancer-committed explicit remap pairs (reference
+    # OSDMap::Incremental new_pg_upmap_items): pg -> [(from, to), ...];
+    # an EMPTY list clears the pg's entry (like new_pg_temp)
+    new_pg_upmap_items: Dict["PGid", List[Tuple[int, int]]] = \
+        field(default_factory=dict)
     new_primary_temp: Dict["PGid", int] = field(default_factory=dict)
     new_primary_affinity: Dict[int, int] = field(default_factory=dict)
     new_mgr_addr: object = None  # mgr registration (reference MgrMap)
@@ -170,6 +175,15 @@ class Incremental:
     # LogMonitor is likewise a PaxosService on the shared paxos); the
     # OSDMap itself ignores them — the mon's log service consumes them
     new_log_entries: Tuple = ()        # of (who, stamp, prio, msg)
+    # elastic reshape (round 21, reference OSDMap::Incremental
+    # new_max_osd + full-crush replacement): grow extends the id space
+    # and ships the new device-bearing host buckets; purge retires ids.
+    # The crush delta rides as data, not a pickled CrushMap — every
+    # consumer applies the same mutation to ITS crush copy.
+    new_max_osd: int = 0               # 0 = unchanged
+    # of (host_name, (osd ids...), (16.16 weights...), root_name)
+    new_crush_hosts: Tuple = ()
+    old_osds: Tuple[int, ...] = ()     # purged ids (exists -> False)
 
 
 class OSDMap:
@@ -267,6 +281,50 @@ class OSDMap:
         if inc.epoch != self.epoch + 1:
             raise ValueError(
                 f"incremental {inc.epoch} does not follow epoch {self.epoch}")
+        # id-space growth FIRST: later fields of the same inc may
+        # reference the new ids (a grow inc carries crush hosts whose
+        # devices sit past the old max_osd)
+        new_max = getattr(inc, "new_max_osd", 0)
+        if new_max > self.max_osd:
+            grown = new_max - self.max_osd
+            self.osd_exists.extend([True] * grown)
+            # new ids boot "down" until they report in (the vstart rule)
+            self.osd_up.extend([False] * grown)
+            self.osd_weight.extend([0x10000] * grown)
+            if self.osd_primary_affinity is not None:
+                self.osd_primary_affinity.extend(
+                    [CEPH_OSD_DEFAULT_PRIMARY_AFFINITY] * grown)
+            self.max_osd = new_max
+        crush_dirty = False
+        for host in getattr(inc, "new_crush_hosts", ()):
+            hname, devs, weights, root = host
+            self.crush.add_host(hname, list(devs), list(weights),
+                                root=root)
+            crush_dirty = True
+        for osd in getattr(inc, "old_osds", ()):
+            if 0 <= osd < self.max_osd:
+                self.osd_exists[osd] = False
+                self.osd_up[osd] = False
+                self.osd_weight[osd] = 0
+                self.osd_addrs.pop(osd, None)
+                if self.crush.remove_device(osd):
+                    crush_dirty = True
+                # explicit mappings naming a retired id die with it
+                # (reference OSDMap::maybe_remove_pg_upmaps)
+                for pg in [p for p, v in self.pg_upmap.items()
+                           if osd in v]:
+                    del self.pg_upmap[pg]
+                for pg in [p for p, v in self.pg_upmap_items.items()
+                           if any(osd in pair for pair in v)]:
+                    del self.pg_upmap_items[pg]
+                for pg in [p for p, v in self.pg_temp.items()
+                           if osd in v]:
+                    del self.pg_temp[pg]
+                for pg in [p for p, v in self.primary_temp.items()
+                           if v == osd]:
+                    del self.primary_temp[pg]
+        if crush_dirty:
+            self.invalidate_mappers()
         for osd, addr in inc.new_up.items():
             if 0 <= osd < self.max_osd:
                 self.osd_up[osd] = True
@@ -301,6 +359,11 @@ class OSDMap:
                 self.pg_temp[pg] = list(temp)
             else:
                 self.pg_temp.pop(pg, None)
+        for pg, pairs in getattr(inc, "new_pg_upmap_items", {}).items():
+            if pairs:
+                self.pg_upmap_items[pg] = [tuple(p) for p in pairs]
+            else:
+                self.pg_upmap_items.pop(pg, None)
         for pg, tp in inc.new_primary_temp.items():
             if tp >= 0:
                 self.primary_temp[pg] = tp
@@ -319,6 +382,11 @@ class OSDMap:
             for pg in [p for p in self.pg_upmap_items
                        if p.pool == pool_id]:
                 del self.pg_upmap_items[pg]
+            for pg in [p for p in self.pg_temp if p.pool == pool_id]:
+                del self.pg_temp[pg]
+            for pg in [p for p in self.primary_temp
+                       if p.pool == pool_id]:
+                del self.primary_temp[pg]
         self.epoch = inc.epoch
 
     @property
@@ -454,6 +522,18 @@ class OSDMap:
             if acting_primary == -1:
                 acting_primary = up_primary
         return up, up_primary, acting, acting_primary
+
+    def pg_raw_up(self, pgid: PGid) -> List[int]:
+        """Down-BLIND placement: raw CRUSH + upmap, existence-filtered
+        but never up-filtered.  This is "where the map says the data
+        belongs" — the mon's pg_temp mint reasons about data location
+        across epochs, and an OSD's transient down-ness (a beacon blip)
+        must not read as the data having moved."""
+        pool = self.pools.get(pgid.pool)
+        if pool is None or pgid.seed >= pool.pg_num:
+            return []
+        raw, _ = self._pg_to_raw_osds(pool, pgid)
+        return self._apply_upmap(pool, pgid, raw)
 
     # -- whole-pool batched placement --------------------------------------
 

@@ -215,6 +215,48 @@ OPTIONS: List[Option] = [
     Option("mon_health_history", int, 128,
            "health-transition records kept in the mon's bounded "
            "history ring (served by 'health history')", min=1),
+    # graft-balance (ceph_tpu/balance/): the elastic-cluster policy
+    # subsystem — device-batched upmap balancer, pg_num autoscaler and
+    # grow/drain reshape ops, all mgr-hosted.  Default-off keeps the
+    # provable-no-op contract: no loops start, no mon commands are
+    # issued, and the mgr_balancer_*/mgr_autoscale_* counter families
+    # stay declared-but-zero on the Prometheus scrape.
+    Option("mgr_balancer_enabled", int, 0,
+           "mgr upmap balancer loop (0 = off: provable no-op, counters "
+           "declared but zero)", min=0, max=1),
+    Option("mgr_balancer_vectorized", int, 1,
+           "1 = device-batched candidate scorer (balance/scorer.py); "
+           "0 = the greedy scalar anchor (osdmap/balancer.py) — the "
+           "bisection anchor for the bit-exactness gate", min=0, max=1),
+    Option("mgr_balancer_interval", float, 5.0,
+           "seconds between balancer optimization rounds", min=0.05),
+    Option("mgr_balancer_max_moves", int, 16,
+           "pg_upmap_items moves committed per round (caps per-round "
+           "backfill churn, reference upmap_max_optimizations)", min=1),
+    Option("mgr_balancer_max_deviation_ratio", float, 0.05,
+           "per-OSD fill deviation ratio the balancer tolerates before "
+           "moving PGs (calc_pg_upmaps threshold)", min=0),
+    Option("mgr_balancer_primary_weight", float, 0.0,
+           "secondary objective weight on primary-count balance "
+           "(0 keeps the objective identical to the scalar anchor's "
+           "fill-variance energy)", min=0),
+    Option("mgr_balancer_move_cost", float, 0.0,
+           "projected-move-bytes penalty per candidate (0 = pure "
+           "balance objective)", min=0),
+    Option("mgr_balancer_require_clean", int, 1,
+           "pause optimization while PG_DEGRADED/OSD_DOWN health "
+           "checks fire (backfill pressure throttle)", min=0, max=1),
+    Option("mgr_autoscale_enabled", int, 0,
+           "mgr pg_num autoscaler loop (0 = off: provable no-op)",
+           min=0, max=1),
+    Option("mgr_autoscale_interval", float, 5.0,
+           "seconds between autoscaler rounds", min=0.05),
+    Option("mgr_autoscale_objects_per_pg", int, 64,
+           "grow a pool's pg_num once its PGs average this many "
+           "objects (load-derived target)", min=1),
+    Option("mgr_autoscale_pgs_per_osd", int, 100,
+           "cluster PG budget: pool pg_num*size summed must stay under "
+           "this per in-OSD (mon_max_pg_per_osd analog)", min=1),
     # graft-race (ceph_tpu/analysis/racecheck.py + utils/schedfuzz.py):
     # the seeded schedule-perturbation sanitizer.  Default-off keeps the
     # provable-no-op contract: the module-global probe target stays the

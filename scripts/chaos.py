@@ -48,6 +48,12 @@ def main() -> int:
                                 "postmortem bundles for this run")
     args = ap.parse_args()
 
+    from ceph_tpu.chaos.balance import (
+        ElasticScenario,
+        build_elastic_plan,
+        elastic_scenarios,
+        run_elastic,
+    )
     from ceph_tpu.chaos.frontdoor import (
         FrontdoorScenario,
         frontdoor_scenarios,
@@ -69,10 +75,12 @@ def main() -> int:
     scenarios = builtin_scenarios()
     scenarios.update(frontdoor_scenarios(1.0))
     scenarios.update(integrity_scenarios(1.0))
+    scenarios.update(elastic_scenarios(1.0))
     if getattr(args, "scale", 1.0) != 1.0:
         scenarios.update(storm_scenarios(args.scale))
         scenarios.update(frontdoor_scenarios(args.scale))
         scenarios.update(integrity_scenarios(args.scale))
+        scenarios.update(elastic_scenarios(args.scale))
     if args.cmd == "list":
         for name, sc in sorted(scenarios.items()):
             print(f"{name:24s} osds={sc.osds} rounds={sc.rounds} "
@@ -86,6 +94,9 @@ def main() -> int:
     if args.cmd == "schedule":
         if isinstance(sc, FillScenario):
             print(json.dumps(build_fill_plan(sc, args.seed), indent=2))
+        elif isinstance(sc, ElasticScenario):
+            print(json.dumps(build_elastic_plan(sc, args.seed),
+                             indent=2))
         else:
             print(json.dumps(build_schedule(sc, args.seed), indent=2))
         return 0
@@ -107,6 +118,9 @@ def main() -> int:
         elif isinstance(sc, FillScenario):
             verdict = asyncio.run(run_fill_drain(sc, args.seed,
                                                  tmpdir=tmpdir))
+        elif isinstance(sc, ElasticScenario):
+            verdict = asyncio.run(run_elastic(sc, args.seed,
+                                              tmpdir=tmpdir))
         else:
             verdict = asyncio.run(run_scenario(sc, args.seed,
                                                tmpdir=tmpdir))

@@ -1,0 +1,248 @@
+"""scripts/balance.py CLI: exit codes 0/1/2 (graft-balance satellite).
+
+Usage errors (2) and one real ``status`` boot run as subprocesses, like
+the trace/chaos CLI tests.  The operation-outcome codes (0 vs 1) are
+driven in-band against a fake cluster so a stuck reshape or a commit
+error doesn't need a real cluster wedged on purpose — the real grow /
+drain / optimize flows are exercised end-to-end by the elastic chaos
+scenarios (test_balance_elastic, scripts/chaos.py expand-drain-smoke).
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "scripts", "balance.py")
+
+
+def _load_cli():
+    spec = importlib.util.spec_from_file_location("balance_cli", SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ------------------------------------------------------------ fakes
+
+
+class _FakeIO:
+    async def write_full(self, oid, data):
+        pass
+
+
+class _FakeClient:
+    async def pool_create(self, name, kind, pg_num=8, size=3):
+        return 1
+
+    def ioctx(self, pool):
+        return _FakeIO()
+
+
+class _FakeMon:
+    def _health_data(self):
+        return {"status": "HEALTH_OK"}
+
+
+class _FakeCluster:
+    """Scripted mgr: each ``balance status`` poll pops the next canned
+    reshape-op snapshot (the last one repeats, like a stuck op)."""
+
+    def __init__(self, statuses, command_results=None):
+        self.statuses = list(statuses)
+        self.command_results = dict(command_results or {})
+        self.commands = []
+        self.booted = []
+        self.stopped = False
+        self.mon = _FakeMon()
+        self.osds = {}
+
+    async def daemon_command(self, name, cmd, timeout=30.0):
+        prefix = cmd if isinstance(cmd, str) else cmd["prefix"]
+        self.commands.append(cmd)
+        if prefix == "balance status":
+            ops = (self.statuses.pop(0) if len(self.statuses) > 1
+                   else self.statuses[0])
+            return {"reshape_ops": ops}
+        return self.command_results[prefix]
+
+    async def boot_osds(self, osd_ids, timeout=15.0):
+        self.booted = list(osd_ids)
+
+    async def stop(self):
+        self.stopped = True
+
+
+def _wire(mod, cluster):
+    async def fake_boot(n_osds, osds_per_host=1):
+        return cluster, _FakeClient()
+
+    mod._boot = fake_boot
+    mod.RESHAPE_DEADLINE = 2.0
+
+
+def _run_main(mod, argv):
+    old = sys.argv
+    sys.argv = ["balance.py"] + argv
+    try:
+        return mod.main()
+    finally:
+        sys.argv = old
+
+
+# --------------------------------------------------- exit 0 / 1 in-band
+
+
+def test_grow_exit0_boots_minted_osds_and_waits_done(capsys):
+    mod = _load_cli()
+    cluster = _FakeCluster(
+        statuses=[[{"id": 7, "kind": "grow", "osds": [3, 4],
+                    "phase": "waiting-up", "detail": ""}],
+                  [{"id": 7, "kind": "grow", "osds": [3, 4],
+                    "phase": "done", "detail": "all new osds up"}]],
+        command_results={"balance grow": {"id": 7, "kind": "grow",
+                                          "osds": [3, 4],
+                                          "phase": "waiting-up"}})
+    _wire(mod, cluster)
+    assert _run_main(mod, ["grow", "--count", "2"]) == 0
+    # the CLI played the operator: booted exactly the minted ids
+    assert cluster.booted == [3, 4]
+    assert cluster.stopped
+    assert "OK grew" in capsys.readouterr().out
+
+
+def test_grow_exit1_when_reshape_op_stuck(capsys):
+    mod = _load_cli()
+    cluster = _FakeCluster(
+        statuses=[[{"id": 7, "kind": "grow", "osds": [3],
+                    "phase": "waiting-up", "detail": "1 of 1 not up"}]],
+        command_results={"balance grow": {"id": 7, "kind": "grow",
+                                          "osds": [3],
+                                          "phase": "waiting-up"}})
+    _wire(mod, cluster)
+    assert _run_main(mod, ["grow", "--count", "1"]) == 1
+    assert cluster.stopped
+    assert "stuck in phase" in capsys.readouterr().err
+
+
+def test_drain_exit0_stops_daemons_at_wait_down():
+    mod = _load_cli()
+
+    class _FakeOSD:
+        def __init__(self):
+            self.stopped = False
+
+        async def stop(self):
+            self.stopped = True
+
+    osd = _FakeOSD()
+    cluster = _FakeCluster(
+        statuses=[[{"id": 2, "kind": "drain", "osds": [4],
+                    "phase": "wait-clean", "detail": ""}],
+                  [{"id": 2, "kind": "drain", "osds": [4],
+                    "phase": "wait-down", "detail": "stop daemons"}],
+                  [{"id": 2, "kind": "drain", "osds": [4],
+                    "phase": "done", "detail": "purged 1 osds"}]],
+        command_results={"balance drain": {"id": 2, "kind": "drain",
+                                           "osds": [4],
+                                           "phase": "wait-clean"}})
+    cluster.osds[4] = osd
+    _wire(mod, cluster)
+    assert _run_main(mod, ["drain", "--osds", "4"]) == 0
+    # the operator's half of the handshake happened: the retiring
+    # daemon was stopped once the op said wait-down
+    assert osd.stopped
+    assert 4 not in cluster.osds
+
+
+def test_drain_exit1_when_stuck_in_wait_clean():
+    mod = _load_cli()
+    cluster = _FakeCluster(
+        statuses=[[{"id": 2, "kind": "drain", "osds": [4],
+                    "phase": "wait-clean",
+                    "detail": "3 pg slots still mapped"}]],
+        command_results={"balance drain": {"id": 2, "kind": "drain",
+                                           "osds": [4],
+                                           "phase": "wait-clean"}})
+    _wire(mod, cluster)
+    assert _run_main(mod, ["drain", "--osds", "4"]) == 1
+
+
+def test_optimize_exit_codes_commit_error_vs_clean(capsys):
+    mod = _load_cli()
+    cluster = _FakeCluster(
+        statuses=[[]],
+        command_results={"balance optimize": {
+            "epoch": 9, "moves": 3, "dry_run": False,
+            "commit_error": "TimeoutError('mon')"}})
+    _wire(mod, cluster)
+    assert _run_main(mod, ["optimize"]) == 1
+    assert "FAIL commit" in capsys.readouterr().err
+
+    cluster = _FakeCluster(
+        statuses=[[]],
+        command_results={"balance optimize": {
+            "epoch": 9, "moves": 3, "dry_run": True}})
+    _wire(mod, cluster)
+    assert _run_main(mod, ["optimize", "--dry-run"]) == 0
+    assert "OK planned 3 moves" in capsys.readouterr().out
+
+
+def test_autoscale_exit0(capsys):
+    mod = _load_cli()
+    cluster = _FakeCluster(
+        statuses=[[]],
+        command_results={"balance autoscale": {
+            "epoch": 5, "dry_run": False, "actions": [],
+            "pools": {}}})
+    _wire(mod, cluster)
+    assert _run_main(mod, ["autoscale"]) == 0
+    assert "OK autoscale" in capsys.readouterr().out
+
+
+# ------------------------------------------------------- exit 2 (usage)
+
+
+def test_usage_errors_exit2():
+    """Bad arguments never boot a cluster and exit 2 — subprocess, so
+    argparse's own exit path is covered too."""
+    cases = [
+        ["grow", "--count", "0"],            # non-positive grow
+        ["grow", "--count", "-3"],
+        ["drain", "--osds", "abc"],          # unparsable id list
+        ["drain", "--osds", ""],             # empty id list
+        ["drain", "--osds", "9"],            # outside the cluster
+        ["drain", "--osds", "0,1,2,3,4"],    # would drain everything
+        ["bogus"],                           # unknown subcommand
+        ["grow"],                            # missing required --count
+    ]
+    for argv in cases:
+        proc = subprocess.run(
+            [sys.executable, SCRIPT] + argv,
+            capture_output=True, text=True, timeout=60, cwd=REPO)
+        assert proc.returncode == 2, (argv, proc.stdout, proc.stderr)
+        assert "Traceback" not in proc.stderr, argv
+
+
+# ------------------------------------------------------------ e2e smoke
+
+
+def test_status_subprocess_real_cluster():
+    """One real boot through the CLI: ``status --json`` against a live
+    3-OSD cluster reports the subsystem disabled (loops off is the CLI
+    contract) with the seeded pool visible to the autoscaler."""
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "status", "--osds", "3",
+         "--pg-num", "8", "--json"],
+        capture_output=True, text=True, timeout=300, cwd=REPO,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    doc = json.loads(proc.stdout)
+    assert doc["enabled"] is False and doc["autoscale_enabled"] is False
+    assert doc["reshape_ops"] == []
+    pools = doc["pools"]
+    assert any(p.get("pool") == "balance" for p in pools.values())

@@ -245,7 +245,9 @@ def test_health_and_df_commands():
             await cluster.osds.pop(victim).stop()
             for _ in range(100):
                 h = await client.objecter.mon_command({"prefix": "health"})
-                if h["status"] != "HEALTH_OK":
+                # poll for the down mark itself: survivors report
+                # transient PG_RECOVERING before the grace expires
+                if "OSD_DOWN" in h["checks"]:
                     break
                 await asyncio.sleep(0.1)
             assert h["status"] in ("HEALTH_WARN", "HEALTH_ERR")

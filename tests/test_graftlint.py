@@ -62,6 +62,28 @@ def test_repo_lints_clean_with_shipped_baseline():
     assert "messenger.session -> messenger.conn_send" in edges
 
 
+def test_balance_subsystem_in_scope_with_no_baseline_debt():
+    """Scope pin (graft-balance): every file of ceph_tpu/balance/ is in
+    the default lint file set — a package move or walker regression
+    can't silently drop the subsystem from the gate — and the shipped
+    baseline carries ZERO entries for it (the subsystem lints clean,
+    not suppressed)."""
+    paths = {os.path.relpath(p, REPO).replace(os.sep, "/")
+             for p in engine.default_paths()}
+    bal_dir = os.path.join(REPO, "ceph_tpu", "balance")
+    expected = {f"ceph_tpu/balance/{fn}" for fn in os.listdir(bal_dir)
+                if fn.endswith(".py")}
+    assert expected, "ceph_tpu/balance/ vanished"
+    assert expected <= paths, expected - paths
+    # the CLI entry point and the elastic scenario module ride along
+    assert "scripts/balance.py" in paths
+    assert "ceph_tpu/chaos/balance.py" in paths
+    baseline = baseline_mod.load_baseline(
+        baseline_mod.default_baseline_path())
+    debt = [k for k in baseline if "balance" in k]
+    assert debt == [], debt
+
+
 def test_cli_exits_zero_on_repo():
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "scripts", "graftlint.py")],

@@ -185,6 +185,14 @@ def _mk_snap(ops=0, cwnd=256, pushbacks=0, hist=None, checks=None,
         "ceph_osd_scrub_errors_repaired": [({"daemon": "osd.0"}, 0)],
         "ceph_osd_full_rejects": [({"daemon": "osd.0"}, 0)],
         "ceph_osd_read_batch_ticks": [({"daemon": "osd.0"}, 1)],
+        # round-21 mgr balance counters (the balance gate requires
+        # presence on the scrape — declared at mgr init, zero when the
+        # subsystem is disabled)
+        "ceph_mgr_balancer_rounds": [({"daemon": "mgr.x"}, 0)],
+        "ceph_mgr_balancer_candidates": [({"daemon": "mgr.x"}, 0)],
+        "ceph_mgr_balancer_moves_committed": [({"daemon": "mgr.x"}, 0)],
+        "ceph_mgr_balancer_throttled": [({"daemon": "mgr.x"}, 0)],
+        "ceph_mgr_autoscale_rounds": [({"daemon": "mgr.x"}, 0)],
     }
     if hist:
         prom["ceph_osd_op_lat_hist_bucket"] = [
@@ -266,7 +274,7 @@ def test_load_smoke_all_gates_and_bit_identical_replay():
     assert r1.offered == r2.offered == 180
     gates = {r["gate"] for r in rep1.rows}
     assert gates == {"goodput", "p99", "cwnd", "qos", "health",
-                     "map_churn", "integrity", "deadline"}
+                     "map_churn", "integrity", "balance", "deadline"}
     # every scrape-side gate really had scrape data behind it
     by = {r["gate"]: r for r in rep1.rows}
     assert by["goodput"]["value"] >= r1.offered * 0.5
@@ -287,6 +295,14 @@ def test_load_smoke_all_gates_and_bit_identical_replay():
     # gated by the bitrot-under-load scenario's repair invariant.
     assert by["integrity"]["passed"], by["integrity"]
     assert by["integrity"]["note"] == "", by["integrity"]
+    # round-21 satellite: the mgr balance counter families (balancer
+    # rounds/candidates/moves, autoscale rounds) are ON the scrape even
+    # though the subsystem is disabled in the smoke — declared at mgr
+    # init, all-zeros: the provable-no-op witness.  Moves MOVEMENT is
+    # gated by the balance-convergence scenario's balance_moves_min.
+    assert by["balance"]["passed"], by["balance"]
+    assert by["balance"]["note"] == "", by["balance"]
+    assert by["balance"]["value"] == 0  # disabled balancer commits nothing
 
 
 def test_mgr_scrape_carries_client_and_qos_counters():
